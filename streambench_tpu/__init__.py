@@ -1,0 +1,36 @@
+"""streambench_tpu — a TPU-native streaming-benchmark framework.
+
+A from-scratch re-design of the Yahoo Streaming Benchmark capability set
+(reference: francis0407/streaming-benchmarks) for TPU hardware:
+
+- the ad-analytics pipeline (deserialize -> filter "view" -> project ->
+  join ad->campaign -> count per (campaign, 10s window) -> Redis writeback,
+  per ``README.markdown:33-37`` of the reference) is executed as an
+  XLA-compiled micro-batch scan: events are int-encoded on the host into
+  fixed-shape columnar batches and aggregated with masked segment-sums
+  carried through ``jax.lax.scan``;
+- sketch variants (HyperLogLog, count-min, t-digest) replace the exact
+  count as pure-array aggregation kernels whose merges are psum-shaped,
+  so multi-device scale-out over an ICI mesh is a sharding annotation,
+  not a rewrite;
+- the harness contract of the reference is preserved: the same
+  ``benchmarkConf.yaml`` keys (``conf/benchmarkConf.yaml:1-39``), the same
+  canonical Redis output schema (``AdvertisingSpark.scala:184-208``), the
+  same generator/oracle modes (``data/src/setup/core.clj:259-286``), and a
+  ``stream-bench.sh``-compatible operation grammar.
+
+Layout (mirrors SURVEY.md section 7's build plan):
+
+- ``config``     — YAML config honoring every reference key
+- ``io``         — RESP client, fake Redis, canonical schema, journal broker
+- ``datagen``    — load generator + golden-model oracle (core.clj peer)
+- ``encode``     — host-side string->int32 interning and batch staging
+- ``ops``        — aggregation kernels (window counts, HLL, count-min, t-digest)
+- ``engine``     — window state carry, jitted step, scan, runner, flusher
+- ``models``     — the five benchmark topologies from BASELINE.json
+- ``parallel``   — mesh construction and shard_map'd multi-device step
+- ``metrics``    — stamped-timestamp tracing and latency decile reports
+- ``harness``    — stream-bench-compatible CLI operations
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,207 @@
+"""Benchmark configuration: one YAML loader honoring the reference keys.
+
+The reference reads a single YAML file three different ways (Java
+``Utils.findAndReadConfigFile`` at ``streaming-benchmark-common/.../Utils.java:29-63``,
+Scala manual casts at ``AdvertisingSpark.scala:33-59``, Clojure keywords at
+``data/src/setup/core.clj:250-257``).  Here there is exactly one loader and one
+frozen dataclass; every key of ``conf/benchmarkConf.yaml:1-39`` is honored with
+the reference's defaults, and engine-specific knobs for the TPU engine live
+under the ``jax.*`` prefix (same style as ``storm.*`` / ``spark.*`` knobs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Mapping
+
+import yaml
+
+
+class ConfigError(ValueError):
+    """Raised on a missing/duplicated/ill-typed configuration source."""
+
+
+def _as_list(v: Any) -> list[str]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [str(x) for x in v]
+    return [str(v)]
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchmarkConfig:
+    """Typed view of ``benchmarkConf.yaml``.
+
+    Field-by-field provenance is the reference config
+    (``conf/benchmarkConf.yaml``, line cited per field).  ``raw`` preserves
+    the full key->value map so harness code can read any ad-hoc key the same
+    way Flink's ``getFlinkConfs`` flattens YAML into a parameter map
+    (``AdvertisingTopologyNative.java:535-550``).
+    """
+
+    # --- fork keys (file-driven micro-batch experiments) ---
+    ad_to_campaign_path: str = ""          # :4
+    events_path: str = ""                  # :6
+    events_num: int = 10_000_000           # :30  (events.num)
+    redis_hashtable: str = "t1"            # :32  (redis.hashtable)
+    window_size: int = 5000                # :34  (window.size, count-based)
+    shared_file: str = "/"                 # :36
+    map_partitions: int = 3                # :38  (map.partitions)
+    reduce_partitions: int = 1             # :39  (reduce.partitions)
+
+    # --- pristine-YSB keys ---
+    kafka_brokers: tuple[str, ...] = ("localhost",)   # :8-9
+    zookeeper_servers: tuple[str, ...] = ("localhost",)  # :11-12
+    kafka_port: int = 9092                 # :14
+    zookeeper_port: int = 2181             # :15
+    redis_host: str = "localhost"          # :16
+    redis_port: int = 6379                 # (Jedis default, AdvertisingSpark.scala:177)
+    kafka_topic: str = "test1"             # :17
+    kafka_partitions: int = 1              # :18
+    process_hosts: int = 1                 # :20
+    process_cores: int = 4                 # :21
+    storm_workers: int = 1                 # :24
+    storm_ackers: int = 2                  # :25
+    spark_batchtime: int = 2000            # :28
+
+    # --- TPU-engine knobs (new; same namespacing style as storm.*/spark.*) ---
+    jax_batch_size: int = 8192             # events per device micro-batch
+    jax_buffer_timeout_ms: int = 100       # Flink bufferTimeout analog
+    #   (AdvertisingTopologyNative.java:77-79: latency/throughput tradeoff)
+    jax_num_campaigns: int = 100           # key cardinality (core.clj:15)
+    jax_ads_per_campaign: int = 10         # core.clj:56 / JsonGenerator.java:50-51
+    jax_window_slots: int = 16             # open tumbling windows kept on device
+    #   (CampaignProcessorCommon.java:37 keeps a 10-window LRU)
+    jax_time_divisor_ms: int = 10_000      # window length (CampaignProcessorCommon.java:28)
+    jax_flush_interval_ms: int = 1000      # flusher cadence (CampaignProcessorCommon.java:41-54)
+    jax_allowed_lateness_ms: int = 60_000  # generator's max late-by (core.clj:170-173)
+    jax_mesh_shape: tuple[int, ...] = (1,)  # device mesh (batch axis first)
+    jax_mesh_axes: tuple[str, ...] = ("data",)
+    jax_use_native_encoder: bool = True    # C++ fast-path when the .so is built
+
+    raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
+
+    # ------------------------------------------------------------------
+    @property
+    def kafka_host_list(self) -> str:
+        """``host:port,host:port`` string, as built at ``core.clj:252-254``."""
+        return ",".join(f"{b}:{self.kafka_port}" for b in self.kafka_brokers)
+
+    @property
+    def num_ads(self) -> int:
+        return self.jax_num_campaigns * self.jax_ads_per_campaign
+
+    def get(self, key: str, default: Any = None) -> Any:
+        """Raw-key access (``spark.batchtime`` style), like the JVM readers."""
+        return self.raw.get(key, default)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_mapping(conf: Mapping[str, Any]) -> "BenchmarkConfig":
+        def geti(key: str, default: int) -> int:
+            v = conf.get(key, default)
+            try:
+                return int(v)
+            except (TypeError, ValueError) as e:
+                raise ConfigError(f"config key {key!r} is not an int: {v!r}") from e
+
+        def gets(key: str, default: str) -> str:
+            v = conf.get(key, default)
+            return default if v is None else str(v)
+
+        def getb(key: str, default: bool) -> bool:
+            v = conf.get(key, default)
+            if isinstance(v, bool):
+                return v
+            if isinstance(v, str):
+                if v.lower() in ("true", "yes", "1"):
+                    return True
+                if v.lower() in ("false", "no", "0"):
+                    return False
+            if isinstance(v, int):
+                return bool(v)
+            raise ConfigError(f"config key {key!r} is not a bool: {v!r}")
+
+        mesh_shape = conf.get("jax.mesh.shape", (1,))
+        mesh_axes = conf.get("jax.mesh.axes", ("data",))
+        try:
+            mesh_shape_t = tuple(int(x) for x in _as_list(mesh_shape)) or (1,)
+        except (TypeError, ValueError) as e:
+            raise ConfigError(
+                f"config key 'jax.mesh.shape' is not a list of ints: {mesh_shape!r}"
+            ) from e
+        return BenchmarkConfig(
+            ad_to_campaign_path=gets("ad_to_campaign_path", ""),
+            events_path=gets("events_path", ""),
+            events_num=geti("events.num", 10_000_000),
+            redis_hashtable=gets("redis.hashtable", "t1"),
+            window_size=geti("window.size", 5000),
+            shared_file=gets("shared_file", "/"),
+            map_partitions=geti("map.partitions", 3),
+            reduce_partitions=geti("reduce.partitions", 1),
+            kafka_brokers=tuple(_as_list(conf.get("kafka.brokers", ["localhost"]))),
+            zookeeper_servers=tuple(_as_list(conf.get("zookeeper.servers", ["localhost"]))),
+            kafka_port=geti("kafka.port", 9092),
+            zookeeper_port=geti("zookeeper.port", 2181),
+            redis_host=gets("redis.host", "localhost"),
+            redis_port=geti("redis.port", 6379),
+            kafka_topic=gets("kafka.topic", "test1"),
+            kafka_partitions=geti("kafka.partitions", 1),
+            process_hosts=geti("process.hosts", 1),
+            process_cores=geti("process.cores", 4),
+            storm_workers=geti("storm.workers", 1),
+            storm_ackers=geti("storm.ackers", 2),
+            spark_batchtime=geti("spark.batchtime", 2000),
+            jax_batch_size=geti("jax.batch.size", 8192),
+            jax_buffer_timeout_ms=geti("jax.buffer.timeout.ms", 100),
+            jax_num_campaigns=geti("jax.num.campaigns", 100),
+            jax_ads_per_campaign=geti("jax.ads.per.campaign", 10),
+            jax_window_slots=geti("jax.window.slots", 16),
+            jax_time_divisor_ms=geti("jax.time.divisor.ms", 10_000),
+            jax_flush_interval_ms=geti("jax.flush.interval.ms", 1000),
+            jax_allowed_lateness_ms=geti("jax.allowed.lateness.ms", 60_000),
+            jax_mesh_shape=mesh_shape_t,
+            jax_mesh_axes=tuple(_as_list(mesh_axes)) or ("data",),
+            jax_use_native_encoder=getb("jax.use.native.encoder", True),
+            raw=dict(conf),
+        )
+
+
+def find_and_read_config_file(path: str | os.PathLike[str]) -> BenchmarkConfig:
+    """Load a YAML config from the filesystem.
+
+    Mirrors ``Utils.findAndReadConfigFile`` (``Utils.java:29-63``): the file
+    must exist, parse as a YAML mapping, and be non-empty; any failure raises
+    rather than silently proceeding.
+    """
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        raise ConfigError(f"config file not found: {path}")
+    with open(path, "r", encoding="utf-8") as f:
+        try:
+            data = yaml.safe_load(f)
+        except yaml.YAMLError as e:
+            raise ConfigError(f"config file is not valid YAML: {path}: {e}") from e
+    if data is None:
+        raise ConfigError(f"config file is empty: {path}")
+    if not isinstance(data, dict):
+        raise ConfigError(f"config file is not a YAML mapping: {path}")
+    return BenchmarkConfig.from_mapping(data)
+
+
+def default_config(**overrides: Any) -> BenchmarkConfig:
+    """A config with the checked-in ``benchmarkConf.yaml`` defaults.
+
+    ``overrides`` use dataclass field names (``redis_port=...``), mainly for
+    tests and embedded runs.
+    """
+    base = BenchmarkConfig.from_mapping({})
+    return dataclasses.replace(base, **overrides) if overrides else base
+
+
+def write_local_conf(path: str | os.PathLike[str], conf: Mapping[str, Any]) -> None:
+    """Generate a ``localConf.yaml``, as SETUP does (``stream-bench.sh:123-138``)."""
+    with open(path, "w", encoding="utf-8") as f:
+        yaml.safe_dump(dict(conf), f, default_flow_style=False, sort_keys=True)

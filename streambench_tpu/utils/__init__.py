@@ -1,0 +1,1 @@
+from streambench_tpu.utils.ids import make_ids, now_ms  # noqa: F401

@@ -1,0 +1,19 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Multi-chip hardware is not available in CI; sharding correctness is tested on
+a virtual 8-device CPU mesh, exactly like the reference tests multi-node
+behavior with an embedded in-process cluster
+(``ApplicationWithDCWithoutDeserializerTest.java:19-45``).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,108 @@
+"""Config loader tests: every reference key honored, reference defaults kept."""
+
+import pytest
+
+from streambench_tpu.config import (
+    BenchmarkConfig,
+    ConfigError,
+    default_config,
+    find_and_read_config_file,
+    write_local_conf,
+)
+
+REFERENCE_YAML = """\
+ad_to_campaign_path: "/tmp/ad-camp-map.txt"
+events_path: "/tmp/events.tbl"
+kafka.brokers:
+    - "broker1"
+    - "broker2"
+zookeeper.servers:
+    - "localhost"
+kafka.port: 9092
+zookeeper.port: 2181
+redis.host: "redishost"
+kafka.topic: "ad-events"
+kafka.partitions: 4
+process.hosts: 1
+process.cores: 4
+storm.workers: 1
+storm.ackers: 2
+spark.batchtime: 2000
+events.num: 10000000
+redis.hashtable: "t1"
+window.size: 5000
+shared_file: "/"
+map.partitions: 3
+reduce.partitions: 1
+"""
+
+
+def test_reference_yaml_roundtrip(tmp_path):
+    p = tmp_path / "benchmarkConf.yaml"
+    p.write_text(REFERENCE_YAML)
+    c = find_and_read_config_file(p)
+    assert c.ad_to_campaign_path == "/tmp/ad-camp-map.txt"
+    assert c.events_path == "/tmp/events.tbl"
+    assert c.kafka_brokers == ("broker1", "broker2")
+    assert c.kafka_port == 9092
+    assert c.zookeeper_port == 2181
+    assert c.redis_host == "redishost"
+    assert c.kafka_topic == "ad-events"
+    assert c.kafka_partitions == 4
+    assert c.process_hosts == 1 and c.process_cores == 4
+    assert c.storm_workers == 1 and c.storm_ackers == 2
+    assert c.spark_batchtime == 2000
+    assert c.events_num == 10_000_000
+    assert c.redis_hashtable == "t1"
+    assert c.window_size == 5000
+    assert c.shared_file == "/"
+    assert c.map_partitions == 3 and c.reduce_partitions == 1
+    assert c.kafka_host_list == "broker1:9092,broker2:9092"
+    # raw passthrough, like Flink's flattened ParameterTool map
+    assert c.get("spark.batchtime") == 2000
+
+
+def test_defaults_match_reference_conf():
+    c = default_config()
+    assert c.window_size == 5000
+    assert c.events_num == 10_000_000
+    assert c.redis_hashtable == "t1"
+    assert c.map_partitions == 3
+    assert c.jax_time_divisor_ms == 10_000  # CampaignProcessorCommon time_divisor
+    assert c.jax_num_campaigns == 100 and c.num_ads == 1000
+
+
+def test_missing_file_raises(tmp_path):
+    with pytest.raises(ConfigError):
+        find_and_read_config_file(tmp_path / "nope.yaml")
+
+
+def test_empty_file_raises(tmp_path):
+    p = tmp_path / "empty.yaml"
+    p.write_text("")
+    with pytest.raises(ConfigError):
+        find_and_read_config_file(p)
+
+
+def test_non_mapping_raises(tmp_path):
+    p = tmp_path / "list.yaml"
+    p.write_text("- a\n- b\n")
+    with pytest.raises(ConfigError):
+        find_and_read_config_file(p)
+
+
+def test_bad_int_raises():
+    with pytest.raises(ConfigError):
+        BenchmarkConfig.from_mapping({"kafka.port": "not-a-port"})
+
+
+def test_write_local_conf(tmp_path):
+    p = tmp_path / "localConf.yaml"
+    write_local_conf(p, {"redis.host": "h", "kafka.port": 9092})
+    c = find_and_read_config_file(p)
+    assert c.redis_host == "h"
+
+
+def test_overrides():
+    c = default_config(redis_port=7777, jax_batch_size=64)
+    assert c.redis_port == 7777 and c.jax_batch_size == 64
