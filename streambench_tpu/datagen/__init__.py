@@ -1,0 +1,11 @@
+from streambench_tpu.datagen.gen import (  # noqa: F401
+    AD_TYPES,
+    EVENT_TYPES,
+    EventSource,
+    check_correct,
+    do_new_setup,
+    do_setup,
+    dostats,
+    get_stats,
+    run_paced,
+)

@@ -1,0 +1,361 @@
+"""Load generator + golden-model oracle: the peer of ``data/src/setup/core.clj``.
+
+All five CLI modes of the reference generator are reimplemented
+(``core.clj:259-286``): ``-n`` seed Redis, ``-r -t N`` paced real-time
+emission, ``-g`` stats collection, ``-s`` catchup-dataset setup, ``-c``
+golden-model correctness check.  The event wire format is byte-compatible
+with ``make-kafka-event-at`` (``core.clj:163-181``): a JSON object with
+``user_id/page_id/ad_id/ad_type/event_type/event_time/ip_address``, where
+``event_time`` is a stringified ms timestamp.
+
+Deliberate fixes over the fork (capabilities, not bugs, are ported):
+
+- ``load-ids`` returning nil (``core.clj:36-45`` ends with a ``println``) is
+  fixed: ids actually load from the id files.
+- pacing is batched per tick instead of one ``Thread/sleep`` per event, so
+  the generator sustains >10^6 events/s; the ">100 ms behind" warning is kept
+  (``core.clj:200-202``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from streambench_tpu.config import BenchmarkConfig
+from streambench_tpu.io.journal import FileBroker, JournalWriter
+from streambench_tpu.io.redis_schema import (
+    RedisLike,
+    read_seen_counts,
+    read_stats,
+    seed_ad_mapping,
+    seed_campaigns,
+)
+from streambench_tpu.utils.ids import make_ids, now_ms
+
+AD_TYPES = ("banner", "modal", "sponsored-search", "mail", "mobile")
+EVENT_TYPES = ("view", "click", "purchase")
+
+# id-file names, exactly as the reference writes them (core.clj:24-33,47-59)
+CAMPAIGN_IDS_FILE = "campaign-ids.txt"
+AD_IDS_FILE = "ad-ids.txt"
+AD_TO_CAMPAIGN_FILE = "ad-to-campaign-ids.txt"
+KAFKA_JSON_FILE = "kafka-json.txt"
+SEEN_FILE = "seen.txt"
+UPDATED_FILE = "updated.txt"
+
+
+# ----------------------------------------------------------------------
+# id management
+# ----------------------------------------------------------------------
+
+def write_ids(campaigns: list[str], ads: list[str], workdir: str = ".") -> None:
+    """``write-ids`` (``core.clj:24-33``)."""
+    with open(os.path.join(workdir, CAMPAIGN_IDS_FILE), "w") as f:
+        f.write("".join(c + "\n" for c in campaigns))
+    with open(os.path.join(workdir, AD_IDS_FILE), "w") as f:
+        f.write("".join(a + "\n" for a in ads))
+
+
+def load_ids(workdir: str = ".") -> tuple[list[str], list[str]] | None:
+    """``load-ids`` with the nil-return bug fixed (``core.clj:36-45``)."""
+    try:
+        with open(os.path.join(workdir, CAMPAIGN_IDS_FILE)) as f:
+            campaigns = [l.strip() for l in f if l.strip()]
+        with open(os.path.join(workdir, AD_IDS_FILE)) as f:
+            ads = [l.strip() for l in f if l.strip()]
+        return campaigns, ads
+    except FileNotFoundError:
+        return None
+
+
+def write_ad_mapping_file(campaigns: list[str], ads: list[str],
+                          workdir: str = ".") -> dict[str, str]:
+    """``write-to-redis``'s journal side (``core.clj:47-59``): one JSON object
+    ``{"<ad>": "<campaign>"}`` per line; returns the mapping."""
+    per = len(ads) // len(campaigns)
+    mapping: dict[str, str] = {}
+    with open(os.path.join(workdir, AD_TO_CAMPAIGN_FILE), "w") as f:
+        for i, campaign in enumerate(campaigns):
+            for ad in ads[i * per : (i + 1) * per]:
+                mapping[ad] = campaign
+                f.write(json.dumps({ad: campaign}) + "\n")
+    return mapping
+
+
+def load_ad_mapping_file(path: str) -> dict[str, str]:
+    """Read ``ad-to-campaign-ids.txt`` (JSON-object-per-line) **or** the fork's
+    CSV format ``ad,campaign`` (``getAdCampaignMap``,
+    ``AdvertisingTopologyNative.java:47-56``)."""
+    mapping: dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("{"):
+                mapping.update(json.loads(line))
+            else:
+                ad, _, campaign = line.partition(",")
+                mapping[ad.strip()] = campaign.strip()
+    return mapping
+
+
+# ----------------------------------------------------------------------
+# event synthesis
+# ----------------------------------------------------------------------
+
+@dataclass
+class EventSource:
+    """Synthesizes wire-format ad events (``make-kafka-event-at``,
+    ``core.clj:163-181``)."""
+
+    ads: list[str]
+    user_ids: list[str]
+    page_ids: list[str]
+    with_skew: bool = False
+    rng: random.Random | None = None
+
+    def __post_init__(self) -> None:
+        if self.rng is None:
+            self.rng = random.Random()
+
+    def event_at(self, t_ms: int) -> str:
+        rng = self.rng
+        t = t_ms
+        if self.with_skew:
+            t += 50 - rng.randrange(100)           # ±50 ms skew
+            if rng.randrange(100_000) == 0:        # 1/100k late by ≤60 s
+                t -= rng.randrange(60_000)
+        return (
+            '{"user_id": "%s", "page_id": "%s", "ad_id": "%s", '
+            '"ad_type": "%s", "event_type": "%s", "event_time": "%d", '
+            '"ip_address": "1.2.3.4"}'
+            % (
+                rng.choice(self.user_ids),
+                rng.choice(self.page_ids),
+                rng.choice(self.ads),
+                rng.choice(AD_TYPES),
+                rng.choice(EVENT_TYPES),
+                t,
+            )
+        )
+
+    def events_at(self, ts_ms: Iterable[int]) -> list[str]:
+        return [self.event_at(t) for t in ts_ms]
+
+
+# ----------------------------------------------------------------------
+# modes
+# ----------------------------------------------------------------------
+
+def do_new_setup(r: RedisLike, num_campaigns: int = 100,
+                 rng: random.Random | None = None,
+                 workdir: str = ".") -> list[str]:
+    """``-n``: flush Redis, seed the campaigns set (``core.clj:206-213``);
+    also writes the id files so a following ``-r`` can load them."""
+    campaigns = make_ids(num_campaigns, rng)
+    seed_campaigns(r, campaigns)
+    ads = make_ids(num_campaigns * 10, rng)
+    write_ids(campaigns, ads, workdir)
+    mapping = write_ad_mapping_file(campaigns, ads, workdir)
+    seed_ad_mapping(r, mapping)
+    return campaigns
+
+
+def do_setup(r: RedisLike | None, cfg: BenchmarkConfig,
+             broker: FileBroker | None = None,
+             events_num: int | None = None,
+             num_campaigns: int = 100,
+             rng: random.Random | None = None,
+             workdir: str = ".",
+             topic: str | None = None,
+             progress: Callable[[int], None] | None = None) -> int:
+    """``-s``: catchup-simulation setup (``do-setup`` + ``write-to-kafka``,
+    ``core.clj:60-98,239-248``).
+
+    Generates ``events_num`` events at 10 ms spacing (``core.clj:94``:
+    ``event_time = start + 10*n``), journals every event to
+    ``kafka-json.txt``, and appends them to the broker topic when one is
+    given.  Seeds Redis (campaigns + join table) when ``r`` is given.
+    Returns the number of events written.
+    """
+    rng = rng or random.Random()
+    n_events = int(events_num if events_num is not None else cfg.events_num)
+    ids = load_ids(workdir)
+    if ids is None:
+        campaigns = make_ids(num_campaigns, rng)
+        ads = make_ids(num_campaigns * 10, rng)
+        write_ids(campaigns, ads, workdir)
+    else:
+        campaigns, ads = ids
+    mapping = write_ad_mapping_file(campaigns, ads, workdir)
+    if r is not None:
+        seed_campaigns(r, campaigns)
+        seed_ad_mapping(r, mapping)
+
+    src = EventSource(
+        ads=ads,
+        user_ids=make_ids(100, rng),
+        page_ids=make_ids(100, rng),
+        with_skew=False,
+        rng=rng,
+    )
+    start = now_ms()
+    topic = topic or cfg.kafka_topic
+    # Truncate the topic alongside the journal: -s defines a fresh dataset,
+    # and oracle (kafka-json.txt) and topic must stay in lockstep.
+    sink = broker.writer(topic, append=False) if broker is not None else None
+    written = 0
+    with open(os.path.join(workdir, KAFKA_JSON_FILE), "w") as journal:
+        batch = 100_000
+        for base in range(0, n_events, batch):
+            hi = min(base + batch, n_events)
+            lines = src.events_at(start + 10 * n for n in range(base, hi))
+            journal.write("".join(l + "\n" for l in lines))
+            if sink is not None:
+                sink.append_many(lines)
+            written = hi
+            if progress:
+                progress(written)
+    if sink is not None:
+        sink.close()
+    return written
+
+
+def run_paced(sink: JournalWriter, throughput: int,
+              duration_s: float | None = None,
+              max_events: int | None = None,
+              with_skew: bool = False,
+              workdir: str = ".",
+              rng: random.Random | None = None,
+              tick_s: float = 0.01,
+              on_behind: Callable[[float], None] | None = None) -> int:
+    """``-r -t N``: paced emission at ``throughput`` events/s (``run``,
+    ``core.clj:183-204``).
+
+    Event ``n`` is scheduled at ``start + n/throughput`` and carries that
+    scheduled time as its ``event_time`` — exactly the reference's pacing
+    contract (``times`` lazy seq, ``core.clj:190-191``).  Events due in the
+    same ~10 ms tick are emitted as one batch, which is what lets a single
+    Python process sustain rates the per-event-sleep Clojure loop cannot.
+    Returns events emitted.  Stops after ``duration_s`` or ``max_events``.
+    """
+    ids = load_ids(workdir)
+    if ids is None:
+        raise FileNotFoundError(
+            f"id files not found in {workdir!r}; run -n (new setup) first")
+    _, ads = ids
+    rng = rng or random.Random()
+    src = EventSource(ads=ads, user_ids=make_ids(100, rng),
+                      page_ids=make_ids(100, rng), with_skew=with_skew, rng=rng)
+
+    period_ns = int(1e9 / throughput)
+    start_ns = time.time_ns()
+    sent = 0
+    while True:
+        if max_events is not None and sent >= max_events:
+            break
+        now_ns = time.time_ns()
+        if duration_s is not None and now_ns - start_ns >= duration_s * 1e9:
+            break
+        due = min(
+            int((now_ns - start_ns) / period_ns) + 1,
+            max_events if max_events is not None else 1 << 62,
+        )
+        if due > sent:
+            behind_ms = (now_ns - (start_ns + sent * period_ns)) / 1e6
+            if behind_ms > 100 and on_behind:
+                on_behind(behind_ms)  # "Falling behind by: N ms"
+            ts = [(start_ns + n * period_ns) // 1_000_000
+                  for n in range(sent, due)]
+            sink.append_many(src.events_at(ts))
+            # Make the batch visible to tailing consumers immediately:
+            # producer buffering must not pollute end-to-end latency.
+            sink.flush()
+            sent = due
+        else:
+            time.sleep(tick_s)
+    sink.flush()
+    return sent
+
+
+def get_stats(r: RedisLike, workdir: str = ".") -> list[tuple[int, int]]:
+    """``-g``: collect (seen, latency) to ``seen.txt``/``updated.txt``
+    (``get-stats``, ``core.clj:130-149``)."""
+    stats = read_stats(r)
+    with open(os.path.join(workdir, SEEN_FILE), "w") as f:
+        f.write("".join(f"{seen}\n" for seen, _ in stats))
+    with open(os.path.join(workdir, UPDATED_FILE), "w") as f:
+        f.write("".join(f"{lat}\n" for _, lat in stats))
+    return stats
+
+
+def dostats(workdir: str = ".", time_divisor_ms: int = 10_000,
+            events: Iterable[bytes | str] | None = None,
+            mapping_path: str | None = None) -> dict[str, dict[int, int]]:
+    """The golden model (``dostats``, ``core.clj:101-128``): replay the
+    journal in pure Python, count "view" events per (campaign, bucket).
+
+    Returns ``campaign -> {time_bucket -> count}`` with *bucket indices*
+    (event_time // divisor), as the Clojure original does.
+    """
+    mapping = load_ad_mapping_file(
+        mapping_path or os.path.join(workdir, AD_TO_CAMPAIGN_FILE))
+    own_file = None
+    if events is None:
+        own_file = open(os.path.join(workdir, KAFKA_JSON_FILE), "rb")
+        events = own_file
+    acc: dict[str, dict[int, int]] = {}
+    try:
+        for line in events:
+            if not line.strip():
+                continue
+            ev = json.loads(line)
+            if ev["event_type"] != "view":
+                continue
+            campaign = mapping.get(ev["ad_id"])
+            if campaign is None:
+                continue
+            bucket = int(ev["event_time"]) // time_divisor_ms
+            per = acc.setdefault(campaign, {})
+            per[bucket] = per.get(bucket, 0) + 1
+    finally:
+        if own_file is not None:
+            own_file.close()
+    return acc
+
+
+def check_correct(r: RedisLike, workdir: str = ".",
+                  time_divisor_ms: int = 10_000,
+                  log: Callable[[str], None] = print
+                  ) -> tuple[int, int, int]:
+    """``-c``: diff the golden model against what the engine wrote to Redis
+    (``check-correct``, ``core.clj:215-237``).
+
+    Returns ``(correct, differ, missing)`` window counts; prints per-window
+    CORRECT/DIFFER lines like the original.
+    """
+    expected = dostats(workdir, time_divisor_ms)
+    actual = read_seen_counts(r)
+    correct = differ = missing = 0
+    for campaign, per_bucket in expected.items():
+        got = actual.get(campaign, {})
+        for bucket, want in per_bucket.items():
+            window_ts = bucket * time_divisor_ms
+            have = got.get(window_ts)
+            if have is None:
+                missing += 1
+                log(f"Campaign: {campaign!r} has no entry for Timestamp: "
+                    f"{window_ts}, was expecting {want}")
+            elif have != want:
+                differ += 1
+                log(f"Campaign: {campaign!r} Timestamp: {window_ts} DIFFER "
+                    f"in seen count: ({have}, {want})")
+            else:
+                correct += 1
+    return correct, differ, missing

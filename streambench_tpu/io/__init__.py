@@ -1,0 +1,2 @@
+from streambench_tpu.io.resp import RespClient, RespError  # noqa: F401
+from streambench_tpu.io.fakeredis import FakeRedisStore, FakeRedisServer  # noqa: F401

@@ -1,0 +1,234 @@
+"""Hermetic in-process Redis: a data store plus a real RESP socket server.
+
+The reference needs a live ``redis-server`` for every run
+(``stream-bench.sh:180-187`` downloads and compiles one).  For hermetic tests
+and single-process benchmark runs we provide the same command surface two
+ways:
+
+- ``FakeRedisStore`` — the data structures + command dispatch, callable
+  in-process (zero-copy path used by the engine when configured with
+  ``redis.host: ":inprocess:"``);
+- ``FakeRedisServer`` — a threaded TCP server speaking RESP2 on a real
+  socket, so ``RespClient`` and the wire protocol are exercised for real in
+  tests (the same embedded-cluster trick the reference uses with Apex
+  ``LocalMode``, ``ApplicationWithDCWithoutDeserializerTest.java:19-45``).
+
+Only the commands the benchmark uses are implemented; unknown commands
+return a RESP error, like real Redis.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Any
+
+from streambench_tpu.io.resp import _Reader, RespError
+
+
+def _s(v: Any) -> str:
+    return v.decode("utf-8") if isinstance(v, bytes) else str(v)
+
+
+class FakeRedisStore:
+    """Dict-backed implementation of the YSB Redis command surface."""
+
+    def __init__(self) -> None:
+        self._strings: dict[str, str] = {}
+        self._hashes: dict[str, dict[str, str]] = {}
+        self._sets: dict[str, set[str]] = {}
+        self._lists: dict[str, list[str]] = {}
+        self._lock = threading.RLock()
+
+    # ---- command handlers (names match Redis commands) ----
+    def ping(self) -> str:
+        return "PONG"
+
+    def flushall(self) -> str:
+        with self._lock:
+            self._strings.clear()
+            self._hashes.clear()
+            self._sets.clear()
+            self._lists.clear()
+        return "OK"
+
+    def set(self, key: str, value: str) -> str:
+        with self._lock:
+            self._check_type(key, self._strings)
+            self._strings[key] = value
+        return "OK"
+
+    def get(self, key: str) -> str | None:
+        with self._lock:
+            self._check_type(key, self._strings)
+            return self._strings.get(key)
+
+    def sadd(self, key: str, *members: str) -> int:
+        with self._lock:
+            self._check_type(key, self._sets)
+            s = self._sets.setdefault(key, set())
+            n = len(s)
+            s.update(members)
+            return len(s) - n
+
+    def smembers(self, key: str) -> list[str]:
+        with self._lock:
+            self._check_type(key, self._sets)
+            return sorted(self._sets.get(key, set()))
+
+    def hset(self, key: str, field: str, value: str) -> int:
+        with self._lock:
+            self._check_type(key, self._hashes)
+            h = self._hashes.setdefault(key, {})
+            new = 0 if field in h else 1
+            h[field] = value
+            return new
+
+    def hget(self, key: str, field: str) -> str | None:
+        with self._lock:
+            self._check_type(key, self._hashes)
+            return self._hashes.get(key, {}).get(field)
+
+    def hgetall(self, key: str) -> list[str]:
+        with self._lock:
+            self._check_type(key, self._hashes)
+            out: list[str] = []
+            for k, v in self._hashes.get(key, {}).items():
+                out.extend((k, v))
+            return out
+
+    def hincrby(self, key: str, field: str, amount: str) -> int:
+        with self._lock:
+            self._check_type(key, self._hashes)
+            h = self._hashes.setdefault(key, {})
+            cur = h.get(field, "0")
+            try:
+                nxt = int(cur) + int(amount)
+            except ValueError:
+                raise RespError("ERR hash value is not an integer")
+            h[field] = str(nxt)
+            return nxt
+
+    def lpush(self, key: str, *values: str) -> int:
+        with self._lock:
+            self._check_type(key, self._lists)
+            lst = self._lists.setdefault(key, [])
+            for v in values:
+                lst.insert(0, v)
+            return len(lst)
+
+    def llen(self, key: str) -> int:
+        with self._lock:
+            self._check_type(key, self._lists)
+            return len(self._lists.get(key, []))
+
+    def lrange(self, key: str, start: str, stop: str) -> list[str]:
+        with self._lock:
+            self._check_type(key, self._lists)
+            lst = self._lists.get(key, [])
+            i, j = int(start), int(stop)
+            n = len(lst)
+            if i < 0:
+                i += n
+            if j < 0:
+                j += n
+            # Redis LRANGE stop is inclusive; clamp like Redis does.
+            i = max(i, 0)
+            j = min(j, n - 1)
+            if i > j:
+                return []
+            return lst[i : j + 1]
+
+    # ---- plumbing ----
+    def _check_type(self, key: str, owner: dict) -> None:
+        holders = (self._strings, self._hashes, self._sets, self._lists)
+        for h in holders:
+            if h is not owner and key in h:
+                raise RespError(
+                    "WRONGTYPE Operation against a key holding the wrong "
+                    "kind of value"
+                )
+
+    def dispatch(self, args: list[Any]) -> Any:
+        if not args:
+            raise RespError("ERR empty command")
+        name = _s(args[0]).lower()
+        handler = getattr(self, name, None)
+        if handler is None or name.startswith("_"):
+            raise RespError(f"ERR unknown command '{_s(args[0])}'")
+        try:
+            return handler(*[_s(a) for a in args[1:]])
+        except TypeError as e:
+            raise RespError(f"ERR wrong number of arguments: {e}")
+
+
+def _encode_reply(v: Any) -> bytes:
+    if v is None:
+        return b"$-1\r\n"
+    if isinstance(v, int):
+        return b":%d\r\n" % v
+    if isinstance(v, str):
+        if v in ("OK", "PONG"):
+            return b"+%s\r\n" % v.encode()
+        b = v.encode("utf-8")
+        return b"$%d\r\n%s\r\n" % (len(b), b)
+    if isinstance(v, (list, tuple)):
+        return b"*%d\r\n" % len(v) + b"".join(_encode_reply(x) for x in v)
+    raise TypeError(f"cannot encode reply: {v!r}")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        reader = _Reader(self.request.recv)
+        store: FakeRedisStore = self.server.store  # type: ignore[attr-defined]
+        while True:
+            try:
+                cmd = reader.read_reply()
+            except (ConnectionError, OSError):
+                return
+            try:
+                reply = _encode_reply(store.dispatch(cmd))
+            except RespError as e:
+                reply = b"-%s\r\n" % str(e).encode("utf-8")
+            try:
+                self.request.sendall(reply)
+            except OSError:
+                return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FakeRedisServer:
+    """RESP2 socket server around a ``FakeRedisStore``.
+
+    Use as a context manager; ``port`` is OS-assigned so tests never collide.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 store: FakeRedisStore | None = None):
+        self.store = store if store is not None else FakeRedisStore()
+        self._server = _Server((host, port), _Handler)
+        self._server.store = self.store  # type: ignore[attr-defined]
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True, name="fake-redis",
+        )
+
+    def start(self) -> "FakeRedisServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "FakeRedisServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

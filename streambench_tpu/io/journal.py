@@ -1,0 +1,204 @@
+"""File-journal event broker: the hermetic Kafka stand-in.
+
+The reference fork already abandoned live Kafka for its controlled
+experiments and read events from a file (``FileBasedDataSource``,
+``AdvertisingTopologyNative.java:144-165``, fed by ``events_path``); the
+pristine generator likewise journals every event it sends to Kafka into
+``kafka-json.txt`` (``core.clj:75,96-97``) so the oracle can replay it.  This
+module makes that pattern first-class: a *topic* is an append-only
+newline-delimited file in a broker directory, writers append, readers tail
+from a byte offset.  Offsets are byte positions, so checkpoint/resume
+semantics match Kafka's ``(topic, offset)`` pairs
+(``setStartFromEarliest``, ``AdvertisingTopologyNative.java:92``).
+
+A real-Kafka adapter can implement the same two classes against
+confluent-kafka; that library is absent in this image, so it is gated behind
+an import guard in ``streambench_tpu.io.kafka``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Iterator
+
+
+class JournalWriter:
+    """Append-only writer for one topic file.  Thread-safe."""
+
+    def __init__(self, path: str, sync_every: int = 0, append: bool = True):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "ab" if append else "wb", buffering=1024 * 1024)
+        self._lock = threading.Lock()
+        self._sync_every = sync_every
+        self._since_sync = 0
+
+    def append(self, line: str | bytes) -> None:
+        data = line.encode("utf-8") if isinstance(line, str) else line
+        with self._lock:
+            self._f.write(data)
+            if not data.endswith(b"\n"):
+                self._f.write(b"\n")
+            self._since_sync += 1
+            if self._sync_every and self._since_sync >= self._sync_every:
+                self._f.flush()
+                self._since_sync = 0
+
+    def append_many(self, lines: list[str] | list[bytes]) -> None:
+        if not lines:
+            return
+        chunks = []
+        for line in lines:
+            data = line.encode("utf-8") if isinstance(line, str) else line
+            chunks.append(data if data.endswith(b"\n") else data + b"\n")
+        with self._lock:
+            self._f.write(b"".join(chunks))
+            self._since_sync += len(chunks)
+            if self._sync_every and self._since_sync >= self._sync_every:
+                self._f.flush()
+                self._since_sync = 0
+
+    def flush(self) -> None:
+        with self._lock:
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.flush()
+            self._f.close()
+
+    def __enter__(self) -> "JournalWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class JournalReader:
+    """Tailing reader over a topic file, starting at a byte ``offset``.
+
+    ``poll`` returns up to ``max_records`` complete lines (partial trailing
+    lines are left in the file until the writer finishes them) together with
+    the next offset — the unit a checkpoint persists.
+    """
+
+    def __init__(self, path: str, offset: int = 0,
+                 byte_budget: int = 16 * 1024 * 1024):
+        self.path = path
+        self.offset = offset
+        self._byte_budget = byte_budget
+        self._fh = None
+
+    def _ensure_open(self) -> bool:
+        if self._fh is None:
+            if not os.path.exists(self.path):
+                return False
+            self._fh = open(self.path, "rb")
+            self._fh.seek(self.offset)
+        return True
+
+    def poll(self, max_records: int = 65536) -> list[bytes]:
+        """Read up to ``max_records`` complete lines from the journal.
+
+        Reads a bounded chunk per call (``byte_budget``, grown only if a
+        single line exceeds it) so polling a multi-GB topic is O(consumed),
+        not O(file size).
+        """
+        if not self._ensure_open():
+            return []
+        budget = self._byte_budget
+        while True:
+            data = self._fh.read(budget)
+            if not data:
+                return []
+            end = data.rfind(b"\n")
+            if end >= 0:
+                break
+            if len(data) < budget:
+                # partial trailing line, writer not done yet; rewind
+                self._fh.seek(self.offset)
+                return []
+            budget *= 2  # one line longer than the budget: retry bigger
+            self._fh.seek(self.offset)
+        lines = data[: end + 1].splitlines()
+        if len(lines) > max_records:
+            lines = lines[:max_records]
+            consumed = sum(len(l) + 1 for l in lines)
+        else:
+            consumed = end + 1
+        self.offset += consumed
+        self._fh.seek(self.offset)
+        return lines
+
+    def poll_blocking(self, max_records: int = 65536,
+                      timeout_s: float = 1.0,
+                      poll_interval_s: float = 0.001) -> list[bytes]:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            lines = self.poll(max_records)
+            if lines or time.monotonic() >= deadline:
+                return lines
+            time.sleep(poll_interval_s)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "JournalReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class FileBroker:
+    """Directory of topic files; the process-local 'Kafka cluster'.
+
+    ``create_topic``/``writer``/``reader`` mirror the harness's topic
+    lifecycle (``create_kafka_topic``, ``stream-bench.sh:107-115``).
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def topic_path(self, topic: str, partition: int = 0) -> str:
+        return os.path.join(self.root, f"{topic}-{partition}.jsonl")
+
+    def create_topic(self, topic: str, partitions: int = 1) -> None:
+        for p in range(partitions):
+            path = self.topic_path(topic, p)
+            if not os.path.exists(path):
+                open(path, "ab").close()
+
+    def partitions(self, topic: str) -> list[int]:
+        pre = f"{topic}-"
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith(pre) and name.endswith(".jsonl"):
+                try:
+                    out.append(int(name[len(pre):-6]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def writer(self, topic: str, partition: int = 0,
+               append: bool = True) -> JournalWriter:
+        return JournalWriter(self.topic_path(topic, partition), append=append)
+
+    def reader(self, topic: str, partition: int = 0,
+               offset: int = 0) -> JournalReader:
+        return JournalReader(self.topic_path(topic, partition), offset)
+
+    def read_all(self, topic: str) -> Iterator[bytes]:
+        """Replay a whole topic (all partitions, offset 0) — oracle use."""
+        for p in self.partitions(topic):
+            with self.reader(topic, p) as r:
+                while True:
+                    lines = r.poll()
+                    if not lines:
+                        break
+                    yield from lines

@@ -1,0 +1,254 @@
+"""The canonical YSB Redis schema, plus the fork's latency-hash format.
+
+Schema (codified by the reader at ``data/src/setup/core.clj:130-149`` and the
+writer at ``AdvertisingSpark.scala:184-208``):
+
+- ``campaigns`` : SET of campaign ids (seeded by ``do-new-setup``,
+  ``core.clj:206-213``)
+- ``<ad_id>`` : STRING -> campaign id (join side-table, seeded per
+  ``RedisHelper.java:64-78`` / ``gen-ads`` ``core.clj:151-161``)
+- ``<campaign>`` : HASH { <window_ts> -> <windowUUID>, "windows" -> <listUUID> }
+- ``<listUUID>`` : LIST of window_ts strings
+- ``<windowUUID>`` : HASH { "seen_count" -> int, "time_updated" -> ms }
+
+Fork latency hash (``AdvertisingTopologyNative.java:521-532``): one HASH named
+by ``redis.hashtable`` holding ``thread_idx``, ``running_time:<idx>`` and
+``<event_ts>:<idx> -> latency_ms`` entries.
+
+All functions take either a ``RespClient`` or a ``FakeRedisStore`` (adapted
+in-process) so engine code and tests share one code path.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Iterable, Mapping
+
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.resp import RespClient, RespError
+from streambench_tpu.utils.ids import now_ms
+
+
+class StoreAdapter:
+    """RespClient-shaped convenience API over an in-process FakeRedisStore."""
+
+    def __init__(self, store: FakeRedisStore):
+        self._store = store
+
+    def execute(self, *args: Any) -> Any:
+        return self._store.dispatch(list(args))
+
+    def pipeline_execute(self, commands: Iterable[tuple]) -> list[Any]:
+        # Match RespClient semantics: per-command errors are returned
+        # in-list, not raised, and never abort the rest of the batch.
+        out: list[Any] = []
+        for c in commands:
+            try:
+                out.append(self._store.dispatch(list(c)))
+            except RespError as e:
+                out.append(e)
+        return out
+
+    def close(self) -> None:
+        pass
+
+    def __getattr__(self, name: str):
+        # ping/get/set/hget/... share names with FakeRedisStore methods.
+        attr = getattr(self._store, name)
+        if name == "hgetall":
+            def hgetall(key: str) -> dict[str, str]:
+                flat = attr(key)
+                return dict(zip(flat[0::2], flat[1::2]))
+            return hgetall
+        return attr
+
+
+RedisLike = RespClient | StoreAdapter
+
+
+def as_redis(obj: RespClient | StoreAdapter | FakeRedisStore) -> RedisLike:
+    if isinstance(obj, FakeRedisStore):
+        return StoreAdapter(obj)
+    return obj
+
+
+# ----------------------------------------------------------------------
+# Seeding (generator -n mode / RedisHelper.prepareRedis)
+# ----------------------------------------------------------------------
+
+def seed_campaigns(r: RedisLike, campaigns: Iterable[str],
+                   flush: bool = True) -> None:
+    """``do-new-setup`` (``core.clj:206-213``): FLUSHALL + SADD campaigns."""
+    if flush:
+        r.execute("FLUSHALL")
+    for c in campaigns:
+        r.execute("SADD", "campaigns", c)
+
+
+def seed_ad_mapping(r: RedisLike, ad_to_campaign: Mapping[str, str]) -> None:
+    """Join side-table: ``SET <ad_id> <campaign>`` (``RedisHelper.java:73-77``)."""
+    r.pipeline_execute([("SET", ad, camp) for ad, camp in ad_to_campaign.items()])
+
+
+def load_ad_mapping(r: RedisLike, ad_ids: Iterable[str]) -> dict[str, str]:
+    """Bulk ``GET`` of the join table (RedisAdCampaignCache warm-up path)."""
+    ads = list(ad_ids)
+    vals = r.pipeline_execute([("GET", a) for a in ads])
+    return {a: v for a, v in zip(ads, vals) if isinstance(v, str)}
+
+
+# ----------------------------------------------------------------------
+# Canonical window writeback (AdvertisingSpark.scala:184-208)
+# ----------------------------------------------------------------------
+
+def write_window(r: RedisLike, campaign: str, window_ts: int | str,
+                 seen_count: int, time_updated: int | None = None) -> None:
+    """One window's writeback, exactly the Spark ``writeWindow`` algorithm.
+
+    HINCRBY on ``seen_count`` (not SET) so partial flushes of a still-open
+    window accumulate, matching the reference semantics.
+    """
+    wts = str(window_ts)
+    window_uuid = r.execute("HGET", campaign, wts)
+    if window_uuid is None:
+        window_uuid = str(uuid.uuid4())
+        r.execute("HSET", campaign, wts, window_uuid)
+        window_list_uuid = r.execute("HGET", campaign, "windows")
+        if window_list_uuid is None:
+            window_list_uuid = str(uuid.uuid4())
+            r.execute("HSET", campaign, "windows", window_list_uuid)
+        r.execute("LPUSH", window_list_uuid, wts)
+    r.execute("HINCRBY", window_uuid, "seen_count", int(seen_count))
+    r.execute("HSET", window_uuid, "time_updated",
+              str(now_ms() if time_updated is None else int(time_updated)))
+
+
+def write_windows_pipelined(r: RedisLike,
+                            entries: Iterable[tuple[str, int, int]],
+                            time_updated: int | None = None) -> int:
+    """Flush many ``(campaign, window_ts, count)`` rows efficiently.
+
+    Same observable schema as ``write_window``, but the existence probes for
+    all rows ride one pipeline and the mutations another — two round trips
+    per flush instead of the reference's 5+ per window
+    (``AdvertisingSpark.scala:189-205``).  Returns the number of rows written.
+    """
+    rows = [(c, str(w), int(n)) for c, w, n in entries]
+    if not rows:
+        return 0
+    stamp = str(now_ms() if time_updated is None else int(time_updated))
+
+    probes = r.pipeline_execute(
+        [("HGET", c, w) for c, w, _ in rows]
+        + [("HGET", c, "windows") for c, w, _ in rows]
+    )
+    win_uuids = probes[: len(rows)]
+    list_uuids = probes[len(rows):]
+
+    # Assign UUIDs for missing structures; campaigns and even whole rows may
+    # repeat within one flush, so keep a local view of what we've created.
+    new_lists: dict[str, str] = {}
+    new_windows: dict[tuple[str, str], str] = {}
+    muts: list[tuple] = []
+    for i, (campaign, wts, count) in enumerate(rows):
+        wuuid = win_uuids[i] or new_windows.get((campaign, wts))
+        if wuuid is None:
+            wuuid = str(uuid.uuid4())
+            new_windows[(campaign, wts)] = wuuid
+            muts.append(("HSET", campaign, wts, wuuid))
+            luuid = list_uuids[i] or new_lists.get(campaign)
+            if luuid is None:
+                luuid = str(uuid.uuid4())
+                new_lists[campaign] = luuid
+                muts.append(("HSET", campaign, "windows", luuid))
+            muts.append(("LPUSH", luuid, wts))
+        muts.append(("HINCRBY", wuuid, "seen_count", count))
+        muts.append(("HSET", wuuid, "time_updated", stamp))
+    r.pipeline_execute(muts)
+    return len(rows)
+
+
+# ----------------------------------------------------------------------
+# Stats reader (core.clj:130-149 `get-stats`)
+# ----------------------------------------------------------------------
+
+def read_stats(r: RedisLike) -> list[tuple[int, int]]:
+    """All ``(seen_count, latency_ms)`` pairs, latency = time_updated − window_ts.
+
+    Walks the schema exactly as ``get-stats`` does: campaigns set → per
+    campaign "windows" list → per window UUID hash.
+    """
+    out: list[tuple[int, int]] = []
+    for campaign in r.execute("SMEMBERS", "campaigns"):
+        windows_key = r.execute("HGET", campaign, "windows")
+        if windows_key is None:
+            continue
+        n = r.execute("LLEN", windows_key)
+        for window_ts in r.execute("LRANGE", windows_key, 0, n):
+            window_key = r.execute("HGET", campaign, window_ts)
+            if window_key is None:
+                continue
+            seen = r.execute("HGET", window_key, "seen_count")
+            updated = r.execute("HGET", window_key, "time_updated")
+            if seen is None or updated is None:
+                continue
+            out.append((int(seen), int(updated) - int(window_ts)))
+    return out
+
+
+def read_seen_counts(r: RedisLike) -> dict[str, dict[int, int]]:
+    """campaign -> {window_ts -> seen_count}; the oracle's comparison view
+    (``check-correct``, ``core.clj:215-237``)."""
+    out: dict[str, dict[int, int]] = {}
+    for campaign in r.execute("SMEMBERS", "campaigns"):
+        windows_key = r.execute("HGET", campaign, "windows")
+        if windows_key is None:
+            continue
+        n = r.execute("LLEN", windows_key)
+        per: dict[int, int] = {}
+        for window_ts in r.execute("LRANGE", windows_key, 0, n):
+            window_key = r.execute("HGET", campaign, window_ts)
+            if window_key is None:
+                continue
+            seen = r.execute("HGET", window_key, "seen_count")
+            if seen is not None:
+                per[int(window_ts)] = int(seen)
+        out[campaign] = per
+    return out
+
+
+# ----------------------------------------------------------------------
+# Fork latency hash (AdvertisingTopologyNative.java:521-532)
+# ----------------------------------------------------------------------
+
+def dump_latency_hash(r: RedisLike, hashtable: str,
+                      latencies: Mapping[int, int], running_time_ms: int) -> int:
+    """Per-worker latency dump; returns this worker's 1-based index."""
+    idx = r.execute("HINCRBY", hashtable, "thread_idx", 1)
+    cmds: list[tuple] = [("HSET", hashtable, f"running_time:{idx}",
+                          str(int(running_time_ms)))]
+    cmds += [("HSET", hashtable, f"{ts}:{idx}", str(int(lat)))
+             for ts, lat in latencies.items()]
+    r.pipeline_execute(cmds)
+    return idx
+
+
+def read_latency_hash(r: RedisLike, hashtable: str
+                      ) -> tuple[dict[int, int], dict[int, dict[int, int]]]:
+    """Inverse of ``dump_latency_hash``.
+
+    Returns ``(running_time_by_idx, {idx: {event_ts: latency_ms}})``.
+    """
+    flat = r.hgetall(hashtable) if hasattr(r, "hgetall") else {}
+    running: dict[int, int] = {}
+    per_idx: dict[int, dict[int, int]] = {}
+    for field, value in flat.items():
+        if field == "thread_idx":
+            continue
+        name, _, idx_s = field.rpartition(":")
+        idx = int(idx_s)
+        if name == "running_time":
+            running[idx] = int(value)
+        else:
+            per_idx.setdefault(idx, {})[int(name)] = int(value)
+    return running, per_idx

@@ -1,0 +1,131 @@
+"""Generator + oracle tests: modes -n/-s/-r/-g/-c against the fake Redis."""
+
+import json
+import random
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis, write_window
+
+
+def test_new_setup_seeds_campaigns_and_mapping(tmp_path):
+    r = as_redis(FakeRedisStore())
+    campaigns = gen.do_new_setup(r, rng=random.Random(1), workdir=str(tmp_path))
+    assert len(campaigns) == 100
+    assert len(r.execute("SMEMBERS", "campaigns")) == 100
+    # id files exist and load (the fixed load-ids)
+    loaded = gen.load_ids(str(tmp_path))
+    assert loaded is not None
+    cs, ads = loaded
+    assert cs == campaigns and len(ads) == 1000
+    # join table seeded: every ad GETs to a campaign
+    assert r.execute("GET", ads[0]) in campaigns
+    # mapping file parses in both formats
+    m = gen.load_ad_mapping_file(str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    assert len(m) == 1000 and m[ads[0]] in campaigns
+
+
+def test_csv_mapping_format(tmp_path):
+    p = tmp_path / "map.csv"
+    p.write_text("ad1,campA\nad2 , campB\n")
+    assert gen.load_ad_mapping_file(str(p)) == {"ad1": "campA", "ad2": "campB"}
+
+
+def test_event_wire_format():
+    src = gen.EventSource(ads=["adX"], user_ids=["u"], page_ids=["p"],
+                          rng=random.Random(7))
+    ev = json.loads(src.event_at(123456))
+    assert set(ev) == {"user_id", "page_id", "ad_id", "ad_type",
+                       "event_type", "event_time", "ip_address"}
+    assert ev["ad_id"] == "adX"
+    assert ev["event_time"] == "123456"      # stringified ms, as in core.clj
+    assert ev["ip_address"] == "1.2.3.4"
+    assert ev["ad_type"] in gen.AD_TYPES
+    assert ev["event_type"] in gen.EVENT_TYPES
+
+
+def test_skew_injection_bounds():
+    rng = random.Random(3)
+    src = gen.EventSource(ads=["a"], user_ids=["u"], page_ids=["p"],
+                          with_skew=True, rng=rng)
+    t0 = 1_000_000
+    times = [int(json.loads(src.event_at(t0))["event_time"])
+             for _ in range(5000)]
+    assert all(t0 - 60_050 <= t <= t0 + 50 for t in times)
+    assert any(t != t0 for t in times)
+
+
+def test_setup_catchup_and_golden_model(tmp_path):
+    cfg = default_config()
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    n = gen.do_setup(r, cfg, broker=broker, events_num=5000,
+                     rng=random.Random(42), workdir=str(tmp_path))
+    assert n == 5000
+    journal = (tmp_path / gen.KAFKA_JSON_FILE).read_text().strip().splitlines()
+    assert len(journal) == 5000
+    # broker topic mirrors the journal
+    assert len(list(broker.read_all(cfg.kafka_topic))) == 5000
+    # event_time spacing is 10 ms (core.clj:94)
+    t0 = int(json.loads(journal[0])["event_time"])
+    t1 = int(json.loads(journal[1])["event_time"])
+    assert t1 - t0 == 10
+
+    golden = gen.dostats(str(tmp_path))
+    total = sum(sum(b.values()) for b in golden.values())
+    views = sum(1 for l in journal if json.loads(l)["event_type"] == "view")
+    assert total == views > 0
+
+
+def test_check_correct_detects_good_and_bad(tmp_path):
+    cfg = default_config()
+    r = as_redis(FakeRedisStore())
+    gen.do_setup(r, cfg, events_num=2000, rng=random.Random(9),
+                 workdir=str(tmp_path))
+    golden = gen.dostats(str(tmp_path))
+    # write the golden answers into Redis: everything must be CORRECT
+    for campaign, buckets in golden.items():
+        for bucket, count in buckets.items():
+            write_window(r, campaign, bucket * 10_000, count)
+    logs = []
+    correct, differ, missing = gen.check_correct(r, str(tmp_path),
+                                                 log=logs.append)
+    assert differ == 0 and missing == 0 and correct > 0
+
+    # corrupt one window -> exactly one DIFFER
+    camp = next(iter(golden))
+    bucket = next(iter(golden[camp]))
+    write_window(r, camp, bucket * 10_000, 999)
+    correct2, differ2, missing2 = gen.check_correct(r, str(tmp_path),
+                                                    log=logs.append)
+    assert differ2 == 1 and missing2 == 0
+
+
+def test_paced_run_rate_and_journal(tmp_path):
+    r = as_redis(FakeRedisStore())
+    gen.do_new_setup(r, rng=random.Random(5), workdir=str(tmp_path))
+    broker = FileBroker(str(tmp_path / "broker"))
+    broker.create_topic("ad-events")
+    with broker.writer("ad-events") as sink:
+        sent = gen.run_paced(sink, throughput=20_000, duration_s=0.3,
+                             workdir=str(tmp_path))
+    # ~6000 events expected in 0.3 s at 20k/s; allow generous slack
+    assert 3000 <= sent <= 9000
+    lines = list(broker.read_all("ad-events"))
+    assert len(lines) == sent
+    # event_time monotone non-decreasing (scheduled times)
+    times = [int(json.loads(l)["event_time"]) for l in lines[:200]]
+    assert times == sorted(times)
+
+
+def test_get_stats_files(tmp_path):
+    r = as_redis(FakeRedisStore())
+    from streambench_tpu.io.redis_schema import seed_campaigns
+    seed_campaigns(r, ["c1"])
+    write_window(r, "c1", 10_000, 5, time_updated=13_000)
+    stats = gen.get_stats(r, workdir=str(tmp_path))
+    assert stats == [(5, 3000)]
+    assert (tmp_path / "seen.txt").read_text() == "5\n"
+    assert (tmp_path / "updated.txt").read_text() == "3000\n"

@@ -1,0 +1,129 @@
+"""RESP client + fake server + canonical schema tests.
+
+The socket tests exercise the real wire protocol; the schema tests run both
+over the socket and in-process to prove the two paths are interchangeable.
+"""
+
+import pytest
+
+from streambench_tpu.io.fakeredis import FakeRedisServer, FakeRedisStore
+from streambench_tpu.io.resp import RespClient, RespError, encode_command
+from streambench_tpu.io import redis_schema as schema
+
+
+@pytest.fixture(scope="module")
+def server():
+    with FakeRedisServer() as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    c = RespClient("127.0.0.1", server.port)
+    c.flushall()
+    yield c
+    c.close()
+
+
+def test_encode_command():
+    assert encode_command("SET", "k", "v") == b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+    assert encode_command("HINCRBY", "h", "f", 5).endswith(b"$1\r\n5\r\n")
+
+
+def test_basic_commands_over_socket(client):
+    assert client.ping() == "PONG"
+    assert client.set("k", "v") == "OK"
+    assert client.get("k") == "v"
+    assert client.get("missing") is None
+    assert client.sadd("s", "a", "b") == 2
+    assert client.smembers("s") == ["a", "b"]
+    assert client.hset("h", "f", "1") == 1
+    assert client.hget("h", "f") == "1"
+    assert client.hincrby("h", "n", 5) == 5
+    assert client.hincrby("h", "n", 2) == 7
+    assert client.lpush("l", "x") == 1
+    assert client.lpush("l", "y") == 2
+    assert client.llen("l") == 2
+    assert client.lrange("l", 0, 2) == ["y", "x"]
+    assert client.hgetall("h") == {"f": "1", "n": "7"}
+
+
+def test_wrongtype_and_unknown(client):
+    client.set("k", "v")
+    with pytest.raises(RespError):
+        client.hget("k", "f")
+    with pytest.raises(RespError):
+        client.execute("SUBSCRIBE", "chan")
+
+
+def test_pipeline(client):
+    replies = client.pipeline_execute(
+        [("SET", "a", "1"), ("GET", "a"), ("GET", "nope"), ("HGET", "a", "f")]
+    )
+    assert replies[0] == "OK" and replies[1] == "1" and replies[2] is None
+    assert isinstance(replies[3], RespError)  # WRONGTYPE surfaced per-command
+
+
+def test_binary_safe_values(client):
+    client.set("bin", "sp ace\r\nnew{line}")
+    assert client.get("bin") == "sp ace\r\nnew{line}"
+
+
+@pytest.fixture(params=["socket", "inprocess"])
+def anyredis(request, server):
+    if request.param == "socket":
+        c = RespClient("127.0.0.1", server.port)
+        c.flushall()
+        yield c
+        c.close()
+    else:
+        yield schema.as_redis(FakeRedisStore())
+
+
+def test_canonical_schema_roundtrip(anyredis):
+    r = anyredis
+    schema.seed_campaigns(r, ["campA", "campB"])
+    schema.seed_ad_mapping(r, {"ad1": "campA", "ad2": "campB"})
+    assert schema.load_ad_mapping(r, ["ad1", "ad2", "ad3"]) == {
+        "ad1": "campA", "ad2": "campB"}
+
+    schema.write_window(r, "campA", 10000, 5, time_updated=12345)
+    schema.write_window(r, "campA", 10000, 3, time_updated=12999)  # accumulate
+    schema.write_window(r, "campA", 20000, 7, time_updated=25000)
+    schema.write_window(r, "campB", 10000, 1, time_updated=11000)
+
+    counts = schema.read_seen_counts(r)
+    assert counts["campA"] == {10000: 8, 20000: 7}
+    assert counts["campB"] == {10000: 1}
+
+    stats = sorted(schema.read_stats(r))
+    # (seen, time_updated - window_ts)
+    assert stats == [(1, 1000), (7, 5000), (8, 2999)]
+
+
+def test_pipelined_writeback_matches_single(anyredis):
+    r = anyredis
+    schema.seed_campaigns(r, ["c1", "c2"])
+    n = schema.write_windows_pipelined(
+        r,
+        [("c1", 10000, 4), ("c1", 20000, 2), ("c2", 10000, 9),
+         ("c1", 10000, 6)],  # same window twice in one flush
+        time_updated=50000,
+    )
+    assert n == 4
+    counts = schema.read_seen_counts(r)
+    assert counts["c1"] == {10000: 10, 20000: 2}
+    assert counts["c2"] == {10000: 9}
+    # windows list holds exactly one entry per distinct window
+    wl = r.execute("HGET", "c1", "windows")
+    assert sorted(r.execute("LRANGE", wl, 0, 10)) == ["10000", "20000"]
+
+
+def test_latency_hash_roundtrip(anyredis):
+    r = anyredis
+    idx1 = schema.dump_latency_hash(r, "t1", {100: 5, 200: 8}, 999)
+    idx2 = schema.dump_latency_hash(r, "t1", {100: 7}, 1234)
+    assert (idx1, idx2) == (1, 2)
+    running, per_idx = schema.read_latency_hash(r, "t1")
+    assert running == {1: 999, 2: 1234}
+    assert per_idx == {1: {100: 5, 200: 8}, 2: {100: 7}}
