@@ -82,17 +82,23 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     if args.setup:
         n = gen.do_setup(redis(), cfg, broker=broker,
-                         events_num=args.eventsNum, workdir=args.workdir,
+                         events_num=args.eventsNum,
+                         num_campaigns=cfg.jax_num_campaigns,
+                         ads_per_campaign=cfg.jax_ads_per_campaign,
+                         workdir=args.workdir,
                          progress=lambda k: print(k, flush=True)
                          if k % 1_000_000 == 0 else None)
         print(f"wrote {n} events")
     elif args.check:
-        correct, differ, missing = gen.check_correct(redis(),
-                                                     workdir=args.workdir)
+        correct, differ, missing = gen.check_correct(
+            redis(), workdir=args.workdir,
+            time_divisor_ms=cfg.jax_time_divisor_ms)
         print(f"CORRECT={correct} DIFFER={differ} MISSING={missing}")
         return 0 if differ == 0 and missing == 0 else 1
     elif args.new:
-        gen.do_new_setup(redis(), workdir=args.workdir)
+        gen.do_new_setup(redis(), num_campaigns=cfg.jax_num_campaigns,
+                         ads_per_campaign=cfg.jax_ads_per_campaign,
+                         workdir=args.workdir)
         print("Writing campaigns data to Redis.")
     elif args.run:
         if args.throughput <= 0:

@@ -37,8 +37,9 @@ from streambench_tpu.io.redis_schema import (
 )
 from streambench_tpu.utils.ids import make_ids, now_ms
 
-AD_TYPES = ("banner", "modal", "sponsored-search", "mail", "mobile")
-EVENT_TYPES = ("view", "click", "purchase")
+# Wire-format constants are shared with the encoder: generator emission and
+# device-side view_type must index the same tuples or counts silently zero.
+from streambench_tpu.encode.encoder import AD_TYPES, EVENT_TYPES
 
 # id-file names, exactly as the reference writes them (core.clj:24-33,47-59)
 CAMPAIGN_IDS_FILE = "campaign-ids.txt"
@@ -154,13 +155,14 @@ class EventSource:
 # ----------------------------------------------------------------------
 
 def do_new_setup(r: RedisLike, num_campaigns: int = 100,
+                 ads_per_campaign: int = 10,
                  rng: random.Random | None = None,
                  workdir: str = ".") -> list[str]:
     """``-n``: flush Redis, seed the campaigns set (``core.clj:206-213``);
     also writes the id files so a following ``-r`` can load them."""
     campaigns = make_ids(num_campaigns, rng)
     seed_campaigns(r, campaigns)
-    ads = make_ids(num_campaigns * 10, rng)
+    ads = make_ids(num_campaigns * ads_per_campaign, rng)
     write_ids(campaigns, ads, workdir)
     mapping = write_ad_mapping_file(campaigns, ads, workdir)
     seed_ad_mapping(r, mapping)
@@ -171,6 +173,7 @@ def do_setup(r: RedisLike | None, cfg: BenchmarkConfig,
              broker: FileBroker | None = None,
              events_num: int | None = None,
              num_campaigns: int = 100,
+             ads_per_campaign: int = 10,
              rng: random.Random | None = None,
              workdir: str = ".",
              topic: str | None = None,
@@ -189,7 +192,7 @@ def do_setup(r: RedisLike | None, cfg: BenchmarkConfig,
     ids = load_ids(workdir)
     if ids is None:
         campaigns = make_ids(num_campaigns, rng)
-        ads = make_ids(num_campaigns * 10, rng)
+        ads = make_ids(num_campaigns * ads_per_campaign, rng)
         write_ids(campaigns, ads, workdir)
     else:
         campaigns, ads = ids

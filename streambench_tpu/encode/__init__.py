@@ -1,0 +1,7 @@
+from streambench_tpu.encode.encoder import (  # noqa: F401
+    AD_TYPE_INDEX,
+    EVENT_TYPE_INDEX,
+    VIEW,
+    EncodedBatch,
+    EventEncoder,
+)

@@ -1,0 +1,2 @@
+from streambench_tpu.engine.pipeline import AdAnalyticsEngine  # noqa: F401
+from streambench_tpu.engine.runner import StreamRunner  # noqa: F401

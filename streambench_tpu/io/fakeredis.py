@@ -19,7 +19,6 @@ return a RESP error, like real Redis.
 
 from __future__ import annotations
 
-import socket
 import socketserver
 import threading
 from typing import Any

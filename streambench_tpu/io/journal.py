@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from typing import Iterator
 
 
@@ -85,11 +86,12 @@ class JournalReader:
     """
 
     def __init__(self, path: str, offset: int = 0,
-                 byte_budget: int = 16 * 1024 * 1024):
+                 byte_budget: int = 4 * 1024 * 1024):
         self.path = path
-        self.offset = offset
+        self.offset = offset          # consumed offset (the checkpoint unit)
         self._byte_budget = byte_budget
         self._fh = None
+        self._readahead: deque[bytes] = deque()  # parsed but not delivered
 
     def _ensure_open(self) -> bool:
         if self._fh is None:
@@ -102,35 +104,47 @@ class JournalReader:
     def poll(self, max_records: int = 65536) -> list[bytes]:
         """Read up to ``max_records`` complete lines from the journal.
 
-        Reads a bounded chunk per call (``byte_budget``, grown only if a
-        single line exceeds it) so polling a multi-GB topic is O(consumed),
-        not O(file size).
+        Reads bounded chunks and keeps surplus parsed lines in a read-ahead
+        buffer, so each journal byte is read and split exactly once no
+        matter the poll granularity; ``offset`` only advances over
+        *delivered* lines, preserving checkpoint/resume exactness.
         """
-        if not self._ensure_open():
-            return []
+        out: list[bytes] = []
+        ra = self._readahead
+        while ra and len(out) < max_records:
+            line = ra.popleft()
+            self.offset += len(line) + 1
+            out.append(line)
+        if len(out) >= max_records or not self._ensure_open():
+            return out
+
         budget = self._byte_budget
         while True:
             data = self._fh.read(budget)
             if not data:
-                return []
+                return out
             end = data.rfind(b"\n")
             if end >= 0:
                 break
             if len(data) < budget:
                 # partial trailing line, writer not done yet; rewind
-                self._fh.seek(self.offset)
-                return []
+                self._fh.seek(self._fh.tell() - len(data))
+                return out
             budget *= 2  # one line longer than the budget: retry bigger
-            self._fh.seek(self.offset)
-        lines = data[: end + 1].splitlines()
-        if len(lines) > max_records:
-            lines = lines[:max_records]
-            consumed = sum(len(l) + 1 for l in lines)
-        else:
-            consumed = end + 1
-        self.offset += consumed
-        self._fh.seek(self.offset)
-        return lines
+            self._fh.seek(self._fh.tell() - len(data))
+        # return unread tail (an incomplete line) to the file position
+        tail = len(data) - (end + 1)
+        if tail:
+            self._fh.seek(self._fh.tell() - tail)
+        # split on \n only: splitlines() would also split on \r/\v/\f etc.
+        # inside a record and corrupt the byte-offset accounting.
+        lines = data[:end].split(b"\n")
+        take = max_records - len(out)
+        for line in lines[:take]:
+            self.offset += len(line) + 1
+        out.extend(lines[:take])
+        ra.extend(lines[take:])
+        return out
 
     def poll_blocking(self, max_records: int = 65536,
                       timeout_s: float = 1.0,

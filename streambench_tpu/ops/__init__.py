@@ -1,0 +1,7 @@
+from streambench_tpu.ops.windowcount import (  # noqa: F401
+    WindowState,
+    flush_deltas,
+    init_state,
+    scan_steps,
+    step,
+)

@@ -1,0 +1,186 @@
+"""The hot op: masked per-(campaign, window) counting on device.
+
+In array terms the whole YSB pipeline stage chain
+(filter -> project -> join -> keyed window count;
+``AdvertisingTopology.java:228-233``) is, per micro-batch::
+
+    campaign = join_table[ad_idx]            # the Redis-join, as a gather
+    wid      = event_time // divisor         # 10 s tumbling window id
+    mask     = valid & (event_type == VIEW) & (campaign >= 0) & not-too-late
+    counts[campaign, wid % W] += mask        # keyed count, as a scatter-add
+
+State lives on device as a **ring of W open windows** (the reference keeps a
+10-window LRU per processor, ``CampaignProcessorCommon.java:37,110-146``):
+``window_ids[slot]`` tags which absolute window occupies each ring slot, and
+newer windows claim slots from older ones (a masked scatter-max).  Events
+whose window lost its slot — i.e. events later than the ring's span — are
+counted in ``dropped``, the analog of the reference LRU's silent eviction.
+
+Counts are **deltas since the last flush**: the flusher zeroes them and the
+Redis writeback accumulates with HINCRBY, exactly the reference's
+partial-flush semantics (``AdvertisingSpark.scala:203``,
+``CampaignProcessorCommon.java:91-98``).
+
+Two scatter strategies are provided (``method=``):
+
+- ``"scatter"`` — a flat ``.at[].add`` scatter-add; masked rows get index -1
+  which JAX scatters drop.
+- ``"onehot"``  — a one-hot f32 reduction, the classic MXU-friendly
+  formulation (f32 keeps integer exactness to 2^24; batch sizes are far
+  below that).
+
+``bench.py`` picks per backend; both are bit-identical (tested).
+
+All times are int32 ms relative to the encoder's ``base_time_ms``; window
+ids are int32.  Nothing here uses dynamic shapes or Python control flow, so
+the step jits once and scans cleanly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NEG = jnp.int32(-2_000_000_000)  # "minus infinity" for int32 maxes
+
+
+class WindowState(NamedTuple):
+    """Device-resident window state (all int32).
+
+    counts:     [C, W] view-count deltas since last flush
+    window_ids: [W]    absolute(relative-base) window id per ring slot; -1 empty
+    watermark:  []     max valid event_time seen (relative ms)
+    dropped:    []     events lost to lateness / ring eviction
+    """
+
+    counts: jax.Array
+    window_ids: jax.Array
+    watermark: jax.Array
+    dropped: jax.Array
+
+
+def init_state(num_campaigns: int, window_slots: int) -> WindowState:
+    return WindowState(
+        counts=jnp.zeros((num_campaigns, window_slots), jnp.int32),
+        window_ids=jnp.full((window_slots,), -1, jnp.int32),
+        watermark=jnp.int32(0),
+        dropped=jnp.int32(0),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("divisor_ms", "lateness_ms", "view_type", "method"))
+def step(state: WindowState, join_table: jax.Array,
+         ad_idx: jax.Array, event_type: jax.Array,
+         event_time: jax.Array, valid: jax.Array,
+         *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
+         view_type: int = 0, method: str = "scatter") -> WindowState:
+    """Fold one micro-batch into the window state.  Pure; jits once."""
+    C, W = state.counts.shape
+
+    campaign = join_table[ad_idx]                      # [B] gather-join
+    wid = event_time // divisor_ms                     # [B]
+    wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+    # Event-time watermark over the *valid* rows (not just counted ones).
+    batch_max = jnp.max(jnp.where(valid, event_time, NEG))
+    watermark = jnp.maximum(state.watermark, batch_max)
+
+    # Allowed lateness (generator can emit events up to 60 s late,
+    # core.clj:170-173); older events are dropped, not miscounted.
+    # Lateness is judged against the watermark AS OF BATCH START
+    # (state.watermark, not the post-batch one): watermarks flow between
+    # batches, so events can never be late relative to peers in their own
+    # batch — otherwise a catchup batch spanning >lateness of event time
+    # would drop its own oldest events.
+    # wid < 0 (events before the encoder's base window) must also be
+    # dropped: wid == -1 would alias the empty-slot sentinel and count
+    # into a phantom slot.  The encoder rebases base_time_ms a full
+    # lateness span early, so in practice this only fires for events
+    # beyond allowed lateness anyway.
+    min_wid = (state.watermark - lateness_ms) // divisor_ms
+    mask = wanted & (wid >= min_wid) & (wid >= 0)
+
+    # Claim ring slots: newer window ids win (masked scatter-max; masked
+    # rows scatter to index W which the padded buffer absorbs).
+    slot = wid % W
+    slot_or_pad = jnp.where(mask, slot, W)
+    padded_ids = jnp.concatenate([state.window_ids, jnp.full((1,), -1, jnp.int32)])
+    padded_ids = padded_ids.at[slot_or_pad].max(wid)
+    window_ids = padded_ids[:W]
+
+    # Count only events whose window owns its slot after claiming; events
+    # evicted by a newer window within the ring span are dropped.
+    owns = window_ids[slot] == wid
+    count_mask = mask & owns
+
+    # Masked rows get index C*W: out-of-bounds on the high side, which
+    # scatter mode="drop" discards (negative indices would *wrap*).
+    flat = jnp.where(count_mask, campaign * W + slot, C * W)
+    if method == "scatter":
+        counts = (state.counts.reshape(-1)
+                  .at[flat].add(1, mode="drop")
+                  .reshape(C, W))
+    elif method == "onehot":
+        onehot = (flat[:, None] == jnp.arange(C * W, dtype=jnp.int32)[None, :])
+        counts = state.counts + jnp.sum(
+            onehot.astype(jnp.float32), axis=0).astype(jnp.int32).reshape(C, W)
+    else:
+        raise ValueError(f"unknown method {method!r}")
+
+    dropped = state.dropped + (
+        jnp.sum(wanted.astype(jnp.int32)) - jnp.sum(count_mask.astype(jnp.int32)))
+    return WindowState(counts, window_ids, watermark, dropped)
+
+
+@functools.partial(jax.jit, static_argnames=("divisor_ms", "lateness_ms"))
+def flush_deltas(state: WindowState, *, divisor_ms: int = 10_000,
+                 lateness_ms: int = 60_000
+                 ) -> tuple[jax.Array, jax.Array, WindowState]:
+    """Drain count deltas for the host flusher.
+
+    Returns ``(delta_counts [C,W], window_ids [W], new_state)``; the new
+    state has all counts zeroed (they were handed to the host) and ring
+    slots of *closed* windows freed.  A window is closed once the watermark
+    passes its end plus allowed lateness — the event-time analog of the 10 s
+    window falling out of the reference's LRU.
+    """
+    closed = (state.window_ids + 1) * divisor_ms + lateness_ms <= state.watermark
+    still_open = jnp.where(closed | (state.window_ids < 0),
+                           jnp.int32(-1), state.window_ids)
+    new_state = WindowState(
+        counts=jnp.zeros_like(state.counts),
+        window_ids=still_open,
+        watermark=state.watermark,
+        dropped=state.dropped,
+    )
+    return state.counts, state.window_ids, new_state
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("divisor_ms", "lateness_ms", "view_type", "method"))
+def scan_steps(state: WindowState, join_table: jax.Array,
+               ad_idx: jax.Array, event_type: jax.Array,
+               event_time: jax.Array, valid: jax.Array,
+               *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
+               view_type: int = 0, method: str = "scatter") -> WindowState:
+    """Fold ``[N, B]`` stacked micro-batches via ``lax.scan``.
+
+    One compiled program processes N batches with the carry on device —
+    the streaming-scan idiom from SURVEY.md section 5.7 (the unbounded
+    stream, chunked; XLA sees a single loop, no per-batch dispatch).
+    """
+
+    def body(carry, xs):
+        a, e, t, v = xs
+        return step(carry, join_table, a, e, t, v,
+                    divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                    view_type=view_type, method=method), None
+
+    final, _ = jax.lax.scan(body, state, (ad_idx, event_type, event_time, valid))
+    return final

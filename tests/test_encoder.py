@@ -1,0 +1,102 @@
+"""Encoder tests: wire-format parsing, interning, fallback, tbl format."""
+
+import json
+import random
+
+import numpy as np
+
+from streambench_tpu.datagen import gen
+from streambench_tpu.encode import VIEW, EventEncoder
+
+
+def make_encoder(n_campaigns=3, ads_per=2):
+    campaigns = [f"camp{i}" for i in range(n_campaigns)]
+    mapping = {}
+    for i, c in enumerate(campaigns):
+        for j in range(ads_per):
+            mapping[f"ad{i}_{j}"] = c
+    return EventEncoder(mapping, campaigns), mapping
+
+
+def test_fast_path_parses_generator_output():
+    enc, mapping = make_encoder()
+    src = gen.EventSource(ads=list(mapping), user_ids=["u1", "u2"],
+                          page_ids=["p1"], rng=random.Random(0))
+    lines = [src.event_at(1_000_000 + 10 * i).encode() for i in range(100)]
+    batch = enc.encode(lines)
+    assert batch.n == 100 and enc.fallback_lines == 0 and enc.bad_lines == 0
+    # rebased to window start minus one lateness span (60 s)
+    assert batch.base_time_ms == 1_000_000 - 60_000
+    # cross-check each row against json.loads
+    for i, line in enumerate(lines):
+        ev = json.loads(line)
+        assert enc.ads[batch.ad_idx[i]] == ev["ad_id"]
+        assert batch.event_time[i] == int(ev["event_time"]) - 940_000
+        et = ["view", "click", "purchase"][batch.event_type[i]]
+        assert et == ev["event_type"]
+    assert batch.valid.all()
+
+
+def test_slow_path_reordered_json():
+    enc, _ = make_encoder()
+    line = json.dumps({"event_time": "5000", "ad_id": "ad0_0",
+                       "event_type": "view", "user_id": "u",
+                       "page_id": "p", "ad_type": "banner"}).encode()
+    batch = enc.encode([line])
+    assert batch.n == 1 and enc.fallback_lines == 1 and enc.bad_lines == 0
+    assert batch.event_type[0] == VIEW
+
+
+def test_bad_lines_masked():
+    enc, _ = make_encoder()
+    batch = enc.encode([b"not json at all", b'{"event_time": "nope"}'],
+                       batch_size=4)
+    assert batch.n == 0 and enc.bad_lines == 2
+    assert not batch.valid.any()
+
+
+def test_unknown_ad_maps_to_negative_campaign():
+    enc, _ = make_encoder()
+    line = json.dumps({"user_id": "u", "page_id": "p", "ad_id": "mystery",
+                       "ad_type": "banner", "event_type": "view",
+                       "event_time": "10000"}).encode()
+    b = enc.encode([line])
+    assert b.ad_idx[0] == enc.unknown_ad
+    assert enc.join_table[b.ad_idx[0]] == -1
+
+
+def test_padding_and_batch_size():
+    enc, mapping = make_encoder()
+    src = gen.EventSource(ads=list(mapping), user_ids=["u"], page_ids=["p"],
+                          rng=random.Random(1))
+    lines = [src.event_at(20_000 + i).encode() for i in range(3)]
+    b = enc.encode(lines, batch_size=8)
+    assert b.batch_size == 8 and b.n == 3
+    assert b.valid.sum() == 3 and not b.valid[3:].any()
+
+
+def test_user_interning_stable():
+    enc, mapping = make_encoder()
+    mk = lambda u: json.dumps({"user_id": u, "page_id": "p", "ad_id": "ad0_0",
+                               "ad_type": "mail", "event_type": "click",
+                               "event_time": "30000"}).encode()
+    b = enc.encode([mk("alice"), mk("bob"), mk("alice")])
+    assert b.user_idx[0] == b.user_idx[2] != b.user_idx[1]
+
+
+def test_tbl_format():
+    enc, _ = make_encoder()
+    lines = [b"u1|p1|ad0_0|banner|view|40000", b"u2|p2|ad1_0|mail|click|40010",
+             b"garbage-line"]
+    b = enc.encode_tbl(lines, batch_size=4)
+    assert b.n == 2 and enc.bad_lines == 1
+    # rebased to 40000 - 60000 lateness margin
+    assert b.event_time[0] == 60_000 and b.event_time[1] == 60_010
+    assert enc.join_table[b.ad_idx[1]] == 1
+
+
+def test_join_table_matches_mapping():
+    enc, mapping = make_encoder(n_campaigns=5, ads_per=3)
+    for ad, camp in mapping.items():
+        assert enc.campaigns[enc.join_table[enc.ad_index[ad.encode()]]] == camp
+    assert np.array_equal(enc.join_table[-1:], [-1])

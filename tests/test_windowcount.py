@@ -1,0 +1,204 @@
+"""Window-count op tests: golden-model equivalence, ring semantics, methods."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from streambench_tpu.datagen import gen
+from streambench_tpu.encode import EventEncoder
+from streambench_tpu.ops import windowcount as wc
+
+
+def encode_events(lines, enc, batch_size):
+    batches = []
+    for i in range(0, len(lines), batch_size):
+        batches.append(enc.encode(lines[i:i + batch_size], batch_size))
+    return batches
+
+
+def run_engine(lines, enc, W=64, B=256, method="scatter", lateness=60_000):
+    state = wc.init_state(enc.num_campaigns, W)
+    jt = jnp.asarray(enc.join_table)
+    for b in encode_events(lines, enc, B):
+        state = wc.step(state, jt, jnp.asarray(b.ad_idx),
+                        jnp.asarray(b.event_type), jnp.asarray(b.event_time),
+                        jnp.asarray(b.valid), method=method,
+                        lateness_ms=lateness)
+    return state
+
+
+def golden_counts(lines, mapping, base_ms):
+    """Pure-python oracle (dostats semantics) keyed by (campaign, abs wid)."""
+    acc = {}
+    import json
+    for line in lines:
+        ev = json.loads(line)
+        if ev["event_type"] != "view":
+            continue
+        c = mapping.get(ev["ad_id"])
+        if c is None:
+            continue
+        wid = int(ev["event_time"]) // 10_000
+        acc[(c, wid)] = acc.get((c, wid), 0) + 1
+    return acc
+
+
+def state_counts(state, enc, base_ms):
+    """Engine counts keyed the same way as the oracle."""
+    counts = np.asarray(state.counts)
+    wids = np.asarray(state.window_ids)
+    base_wid = base_ms // 10_000
+    out = {}
+    for slot, wid in enumerate(wids):
+        if wid < 0:
+            continue
+        for ci in np.nonzero(counts[:, slot])[0]:
+            out[(enc.campaigns[ci], base_wid + int(wid))] = int(counts[ci, slot])
+    return out
+
+
+def make_dataset(n=2000, seed=0, skew=False, start=1_700_000_000_000):
+    campaigns = [f"c{i}" for i in range(10)]
+    mapping = {f"ad{i}_{j}": campaigns[i] for i in range(10) for j in range(10)}
+    src = gen.EventSource(ads=list(mapping), user_ids=["u%d" % i for i in range(20)],
+                          page_ids=["p"], with_skew=skew,
+                          rng=random.Random(seed))
+    lines = [src.event_at(start + 10 * i).encode() for i in range(n)]
+    return lines, mapping, campaigns
+
+
+def test_counts_match_golden_model():
+    lines, mapping, campaigns = make_dataset(3000)
+    enc = EventEncoder(mapping, campaigns)
+    state = run_engine(lines, enc)
+    assert int(state.dropped) == 0
+    got = state_counts(state, enc, enc.base_time_ms)
+    want = golden_counts(lines, mapping, enc.base_time_ms)
+    assert got == want
+
+
+def test_methods_agree():
+    lines, mapping, campaigns = make_dataset(1500, seed=3)
+    enc1 = EventEncoder(mapping, campaigns)
+    s1 = run_engine(lines, enc1, method="scatter")
+    enc2 = EventEncoder(mapping, campaigns)
+    s2 = run_engine(lines, enc2, method="onehot")
+    assert np.array_equal(np.asarray(s1.counts), np.asarray(s2.counts))
+    assert np.array_equal(np.asarray(s1.window_ids), np.asarray(s2.window_ids))
+
+
+def test_skewed_data_matches_golden_within_lateness():
+    lines, mapping, campaigns = make_dataset(5000, seed=7, skew=True)
+    enc = EventEncoder(mapping, campaigns)
+    state = run_engine(lines, enc, W=64)
+    got = state_counts(state, enc, enc.base_time_ms)
+    want = golden_counts(lines, mapping, enc.base_time_ms)
+    # skew is ±50ms and rare 60s-late events; lateness=60s and W*10s=640s
+    # ring keeps everything countable -> dropped only if beyond lateness
+    dropped = int(state.dropped)
+    assert sum(want.values()) - sum(got.values()) == dropped
+    if dropped == 0:
+        assert got == want
+
+
+def test_late_event_beyond_lateness_dropped():
+    mapping = {"adX": "campX"}
+    enc = EventEncoder(mapping)
+    t0 = 1_000_000_000
+    mk = lambda t, et="view": (
+        '{"user_id": "u", "page_id": "p", "ad_id": "adX", "ad_type": "mail",'
+        ' "event_type": "%s", "event_time": "%d", "ip_address": "1.2.3.4"}'
+        % (et, t)).encode()
+    # advance watermark far, then send a 100s-late event (lateness=60s)
+    lines = [mk(t0), mk(t0 + 200_000), mk(t0 + 100_000)]
+    state = run_engine(lines, enc, W=64, B=1)
+    assert int(state.dropped) == 1
+    got = state_counts(state, enc, enc.base_time_ms)
+    assert sum(got.values()) == 2
+
+
+def test_negative_wid_never_aliases_empty_slot_sentinel():
+    """Regression: a relative window id of exactly -1 must not be counted
+    into a phantom slot via the empty-slot sentinel (-1 == -1)."""
+    import jax.numpy as jnp
+    state = wc.init_state(1, 8)
+    jt = jnp.asarray(np.array([0, -1], np.int32))
+    # hand-build a batch with event_time < 0 (wid = -1) then a real one
+    mk = lambda t: (jnp.asarray(np.array([0], np.int32)),
+                    jnp.asarray(np.array([0], np.int32)),
+                    jnp.asarray(np.array([t], np.int32)),
+                    jnp.asarray(np.array([True])))
+    for t in (-5_000, 75_000):  # wid -1, then wid 7 (slot 7 both)
+        a, e, tt, v = mk(t)
+        state = wc.step(state, jt, a, e, tt, v)
+    deltas, wids, _ = wc.flush_deltas(state)
+    # only the real event is counted; the wid=-1 event is dropped
+    assert int(np.asarray(deltas).sum()) == 1
+    assert int(state.dropped) == 1
+
+
+def test_non_view_events_not_counted():
+    mapping = {"adX": "campX"}
+    enc = EventEncoder(mapping)
+    mk = lambda et: (
+        '{"user_id": "u", "page_id": "p", "ad_id": "adX", "ad_type": "mail",'
+        ' "event_type": "%s", "event_time": "5000", "ip_address": "1.2.3.4"}'
+        % et).encode()
+    state = run_engine([mk("view"), mk("click"), mk("purchase")], enc)
+    assert int(np.asarray(state.counts).sum()) == 1
+    assert int(state.dropped) == 0  # non-views aren't "dropped", just filtered
+
+
+def test_flush_returns_deltas_and_frees_closed_slots():
+    lines, mapping, campaigns = make_dataset(1000, seed=5)
+    enc = EventEncoder(mapping, campaigns)
+    state = run_engine(lines, enc, W=8)
+    deltas, wids, cleared = wc.flush_deltas(state)
+    assert np.array_equal(np.asarray(deltas), np.asarray(state.counts))
+    assert np.asarray(cleared.counts).sum() == 0
+    # dataset spans 10s -> 1-2 windows; watermark ~ last event; windows
+    # whose end+lateness <= watermark are freed
+    wm = int(state.watermark)
+    for slot, wid in enumerate(np.asarray(wids)):
+        if wid < 0:
+            continue
+        closed = (wid + 1) * 10_000 + 60_000 <= wm
+        assert (np.asarray(cleared.window_ids)[slot] == -1) == closed
+
+
+def test_flush_then_more_events_accumulate_as_deltas():
+    mapping = {"adX": "campX"}
+    enc = EventEncoder(mapping)
+    mk = lambda t: (
+        '{"user_id": "u", "page_id": "p", "ad_id": "adX", "ad_type": "mail",'
+        ' "event_type": "view", "event_time": "%d", "ip_address": "1.2.3.4"}'
+        % t).encode()
+    import jax.numpy as jnp
+    state = run_engine([mk(5000), mk(5001)], enc)
+    d1, w1, state = wc.flush_deltas(state)
+    assert int(np.asarray(d1).sum()) == 2
+    # same window, more events after flush -> only the new delta remains
+    b = enc.encode([mk(5002)], 4)
+    state = wc.step(state, jnp.asarray(enc.join_table), jnp.asarray(b.ad_idx),
+                    jnp.asarray(b.event_type), jnp.asarray(b.event_time),
+                    jnp.asarray(b.valid))
+    d2, w2, _ = wc.flush_deltas(state)
+    assert int(np.asarray(d2).sum()) == 1
+
+
+def test_scan_steps_equals_loop():
+    lines, mapping, campaigns = make_dataset(1024, seed=11)
+    enc = EventEncoder(mapping, campaigns)
+    looped = run_engine(lines, enc, W=32, B=128)
+
+    enc2 = EventEncoder(mapping, campaigns)
+    batches = encode_events(lines, enc2, 128)
+    stack = lambda f: jnp.asarray(np.stack([f(b) for b in batches]))
+    state = wc.init_state(enc2.num_campaigns, 32)
+    scanned = wc.scan_steps(
+        state, jnp.asarray(enc2.join_table),
+        stack(lambda b: b.ad_idx), stack(lambda b: b.event_type),
+        stack(lambda b: b.event_time), stack(lambda b: b.valid))
+    assert np.array_equal(np.asarray(looped.counts), np.asarray(scanned.counts))
+    assert int(looped.watermark) == int(scanned.watermark)
