@@ -1,0 +1,100 @@
+"""Headline benchmark: sustained end-to-end events/sec, oracle-verified.
+
+Reproduces the reference's benchmark shape (SURVEY.md §6): the YSB
+ad-analytics pipeline — deserialize, filter "view", join ad->campaign,
+count per (campaign, 10 s window), write canonical Redis schema — driven
+from a journaled event stream, then checked window-by-window against the
+golden model (``check-correct``, ``core.clj:215-237``).  The metric is
+catchup-mode sustained throughput: how many events/sec the whole engine
+(host encode + XLA window step + Redis flush) folds while staying exactly
+correct.
+
+Baseline: 100k events/s, a representative published single-node Flink YSB
+operating point (the reference repo itself publishes no numbers,
+``README.markdown:39-42``; BASELINE.json "published" is empty).  The
+north-star target is 10x that.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+BASELINE_EVENTS_PER_S = 100_000.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    n_events = int(os.environ.get("STREAMBENCH_BENCH_EVENTS", "500000"))
+
+    import jax
+
+    from streambench_tpu.config import default_config
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.journal import FileBroker
+    from streambench_tpu.io.redis_schema import as_redis
+
+    log(f"backend={jax.default_backend()} devices={len(jax.devices())} "
+        f"events={n_events}")
+    cfg = default_config()
+
+    with tempfile.TemporaryDirectory() as wd:
+        r = as_redis(FakeRedisStore())
+        broker = FileBroker(os.path.join(wd, "broker"))
+        t0 = time.monotonic()
+        gen.do_setup(r, cfg, broker=broker, events_num=n_events,
+                     rng=random.Random(42), workdir=wd)
+        log(f"generated {n_events} events in {time.monotonic()-t0:.1f}s")
+        mapping = gen.load_ad_mapping_file(
+            os.path.join(wd, gen.AD_TO_CAMPAIGN_FILE))
+
+        # Warm the jit cache with a same-shape engine so compile time
+        # (~20-40 s on first TPU use) doesn't pollute the measurement.
+        warm = AdAnalyticsEngine(cfg, mapping)
+        warm_reader = broker.reader(cfg.kafka_topic)
+        warm.process_lines(warm_reader.poll(cfg.jax_batch_size))
+        warm.flush()
+        log("jit warmup done")
+
+        engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+        runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+        stats = runner.run_catchup()
+        engine.close()
+        log(f"processed {stats.events} events in {stats.wall_s:.2f}s; "
+            f"windows={stats.windows_written} dropped={engine.dropped}")
+
+        correct, differ, missing = gen.check_correct(
+            r, workdir=wd, log=lambda s: None,
+            time_divisor_ms=cfg.jax_time_divisor_ms)
+        log(f"oracle: CORRECT={correct} DIFFER={differ} MISSING={missing}")
+        if differ or missing or engine.dropped:
+            log("BENCH INVALID: engine output incorrect")
+            print(json.dumps({
+                "metric": "sustained events/sec (oracle-verified)",
+                "value": 0.0, "unit": "events/s", "vs_baseline": 0.0}))
+            return 1
+
+        value = round(stats.events_per_s, 1)
+        print(json.dumps({
+            "metric": "sustained events/sec (oracle-verified)",
+            "value": value,
+            "unit": "events/s",
+            "vs_baseline": round(value / BASELINE_EVENTS_PER_S, 4),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

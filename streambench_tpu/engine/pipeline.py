@@ -99,15 +99,21 @@ class AdAnalyticsEngine:
             if batch_max - self._span_start > self._span_guard:
                 self._drain_device()
                 self._span_start = batch_min
-            self.state = wc.step(
-                self.state, self.join_table,
+            self._device_step(
                 jnp.asarray(batch.ad_idx), jnp.asarray(batch.event_type),
-                jnp.asarray(batch.event_time), jnp.asarray(batch.valid),
-                divisor_ms=self.divisor, lateness_ms=self.lateness,
-                method=self.method)
+                jnp.asarray(batch.event_time), jnp.asarray(batch.valid))
             self.events_processed += batch.n
             self.last_event_ms = now_ms()
         return len(lines)
+
+    # ------------------------------------------------------------------
+    def _device_step(self, ad_idx, event_type, event_time, valid) -> None:
+        """Fold one encoded batch into device state (subclass hook: the
+        sharded engine swaps in the mesh version)."""
+        self.state = wc.step(
+            self.state, self.join_table, ad_idx, event_type, event_time,
+            valid, divisor_ms=self.divisor, lateness_ms=self.lateness,
+            method=self.method)
 
     # ------------------------------------------------------------------
     def _drain_device(self) -> None:

@@ -1,0 +1,14 @@
+from streambench_tpu.parallel.mesh import build_mesh, mesh_from_config
+from streambench_tpu.parallel.sharded import (
+    ShardedWindowEngine,
+    sharded_init_state,
+    sharded_step,
+)
+
+__all__ = [
+    "build_mesh",
+    "mesh_from_config",
+    "ShardedWindowEngine",
+    "sharded_init_state",
+    "sharded_step",
+]

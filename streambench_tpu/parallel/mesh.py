@@ -1,0 +1,63 @@
+"""Device-mesh construction for the sharded engine.
+
+The reference scales by partitioning the stream and hash-routing keys to
+stateful workers (Storm ``fieldsGrouping("campaign_id")``,
+``AdvertisingTopology.java:233``; Flink ``keyBy(0)``,
+``AdvertisingTopologyNative.java:118``; Spark ``reduceByKey`` shuffle,
+``AdvertisingSpark.scala:95``).  The TPU-native equivalent is a 2-D
+``jax.sharding.Mesh``:
+
+- ``data`` axis — the stream partition axis (``kafka.partitions`` /
+  ``map.partitions`` analog): each device folds its own slice of the
+  micro-batch; partial counts merge with ``psum`` over ICI, which replaces
+  the network shuffle entirely.
+- ``campaign`` axis — the keyed-state partition axis (``reduce.partitions``
+  analog): window-count state is sharded by campaign so multi-tenant key
+  spaces (BASELINE config #5: 1e6 campaigns) never replicate.
+
+Either axis may be size 1; ``(N,)``-shaped meshes collapse to pure data
+parallelism.  Multi-host runs get the same code over DCN via
+``jax.distributed`` — the mesh just spans more devices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from streambench_tpu.config import BenchmarkConfig
+
+DATA_AXIS = "data"
+CAMPAIGN_AXIS = "campaign"
+
+
+def build_mesh(data: int = 0, campaign: int = 1,
+               devices: list | None = None) -> Mesh:
+    """Build a ``(data, campaign)`` mesh.  ``data=0`` means "all remaining
+    devices": with 8 devices and ``campaign=2`` the mesh is 4x2."""
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    if campaign < 1:
+        raise ValueError(f"campaign axis must be >= 1, got {campaign}")
+    if data <= 0:
+        if n % campaign:
+            raise ValueError(f"{n} devices not divisible by campaign={campaign}")
+        data = n // campaign
+    need = data * campaign
+    if need > n:
+        raise ValueError(f"mesh {data}x{campaign} needs {need} devices, have {n}")
+    grid = np.asarray(devs[:need]).reshape(data, campaign)
+    return Mesh(grid, (DATA_AXIS, CAMPAIGN_AXIS))
+
+
+def mesh_from_config(cfg: BenchmarkConfig, devices: list | None = None) -> Mesh:
+    """Mesh from ``jax.mesh.shape``/``jax.mesh.axes`` config keys; a 1-D
+    shape is treated as pure data parallelism."""
+    shape = tuple(cfg.jax_mesh_shape)
+    if len(shape) == 1:
+        return build_mesh(data=shape[0], campaign=1, devices=devices)
+    if len(shape) == 2:
+        return build_mesh(data=shape[0], campaign=shape[1], devices=devices)
+    raise ValueError(f"jax.mesh.shape must be 1-D or 2-D, got {shape}")

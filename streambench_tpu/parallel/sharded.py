@@ -1,0 +1,178 @@
+"""Sharded window counting: ``shard_map`` over a (data, campaign) mesh.
+
+The reference's scale-out is a keyed network shuffle: every event is routed
+to the worker owning its campaign's window state (Storm
+``fieldsGrouping("campaign_id")``, ``AdvertisingTopology.java:233``; Flink
+``keyBy(0)`` into ``reduce.partitions`` processors,
+``AdvertisingTopologyNative.java:118-119``).  Here no event moves: each
+device folds its *local* batch shard into a local count delta, and the
+deltas merge with ``psum`` over ICI — the allreduce replaces the shuffle
+(SURVEY.md §2, parallelism census).  Window-slot claims and the event-time
+watermark merge with ``pmax``; per-shard drop counts merge with ``psum``.
+
+Semantics are bit-identical to the single-device ``ops.windowcount.step``
+(tested), because integer add/max reductions are associative and
+commutative — order of partial merges cannot change any count.
+
+Layouts (global view):
+- ``counts [C, W]``     — sharded on campaign axis, replicated on data axis
+- ``window_ids [W]``    — replicated (window claims are global facts)
+- ``watermark/dropped`` — replicated scalars
+- batch columns ``[B]`` — sharded on data axis
+- ``join_table [A+1]``  — replicated (1,000 ads; tiny)
+
+``C`` must divide by the campaign-axis size (``sharded_init_state`` pads)
+and ``B`` by the data-axis size (the encoder already pads to a fixed B).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from streambench_tpu.config import BenchmarkConfig
+from streambench_tpu.engine.pipeline import AdAnalyticsEngine
+from streambench_tpu.io.redis_schema import RedisLike
+from streambench_tpu.ops.windowcount import NEG, WindowState
+from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
+
+try:  # jax >= 0.6 top-level export
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def pad_campaigns(num_campaigns: int, mesh: Mesh) -> int:
+    """Campaign count padded up to a multiple of the campaign axis."""
+    nc = mesh.shape[CAMPAIGN_AXIS]
+    return ((num_campaigns + nc - 1) // nc) * nc
+
+
+def sharded_init_state(num_campaigns: int, window_slots: int,
+                       mesh: Mesh) -> WindowState:
+    """Device-placed initial state with the layouts described above."""
+    C = pad_campaigns(num_campaigns, mesh)
+    counts = jax.device_put(
+        jnp.zeros((C, window_slots), jnp.int32),
+        NamedSharding(mesh, P(CAMPAIGN_AXIS, None)))
+    rep = NamedSharding(mesh, P())
+    return WindowState(
+        counts=counts,
+        window_ids=jax.device_put(
+            jnp.full((window_slots,), -1, jnp.int32), rep),
+        watermark=jax.device_put(jnp.int32(0), rep),
+        dropped=jax.device_put(jnp.int32(0), rep),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                view_type: int):
+    """Compile-cached sharded step for one mesh + static params."""
+
+    def body(counts, window_ids, watermark, dropped, join_table,
+             ad_idx, event_type, event_time, valid):
+        Cl, W = counts.shape
+
+        campaign = join_table[ad_idx]                 # local [b] gather-join
+        wid = event_time // divisor_ms
+        wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+        batch_max = jnp.max(jnp.where(valid, event_time, NEG))
+        new_wm = jax.lax.pmax(jnp.maximum(watermark, batch_max), DATA_AXIS)
+
+        # Lateness vs the watermark as of batch start (see ops.windowcount).
+        min_wid = (watermark - lateness_ms) // divisor_ms
+        mask = wanted & (wid >= min_wid) & (wid >= 0)
+
+        # Global ring-slot claim: local masked scatter-max, then pmax so
+        # every device agrees which window owns each slot.
+        slot = wid % W
+        slot_or_pad = jnp.where(mask, slot, W)
+        padded = jnp.concatenate(
+            [window_ids, jnp.full((1,), -1, jnp.int32)])
+        padded = padded.at[slot_or_pad].max(wid)
+        new_ids = jax.lax.pmax(padded[:W], DATA_AXIS)
+
+        owns = new_ids[slot] == wid
+        count_mask = mask & owns
+
+        # Keyed-state routing without moving events: each device counts
+        # only campaigns in its shard, into a local delta; psum over the
+        # data axis completes every (campaign, window) cell.
+        c0 = jax.lax.axis_index(CAMPAIGN_AXIS) * Cl
+        local_c = campaign - c0
+        in_shard = count_mask & (local_c >= 0) & (local_c < Cl)
+        flat = jnp.where(in_shard, local_c * W + slot, Cl * W)
+        delta = (jnp.zeros((Cl * W,), jnp.int32)
+                 .at[flat].add(1, mode="drop"))
+        delta = jax.lax.psum(delta, DATA_AXIS).reshape(Cl, W)
+        new_counts = counts + delta
+
+        counted = jax.lax.psum(
+            jnp.sum(in_shard.astype(jnp.int32)), (DATA_AXIS, CAMPAIGN_AXIS))
+        wanted_total = jax.lax.psum(
+            jnp.sum(wanted.astype(jnp.int32)), DATA_AXIS)
+        new_dropped = dropped + wanted_total - counted
+        return new_counts, new_ids, new_wm, new_dropped
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
+                  P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
+    )
+    return jax.jit(mapped)
+
+
+def sharded_step(mesh: Mesh, state: WindowState, join_table: jax.Array,
+                 ad_idx, event_type, event_time, valid,
+                 *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
+                 view_type: int = 0) -> WindowState:
+    """Fold one global micro-batch into sharded state.  Pure; jits once
+    per (mesh, statics, shapes)."""
+    fn = _build_step(mesh, divisor_ms, lateness_ms, view_type)
+    counts, ids, wm, dropped = fn(
+        state.counts, state.window_ids, state.watermark, state.dropped,
+        join_table, ad_idx, event_type, event_time, valid)
+    return WindowState(counts, ids, wm, dropped)
+
+
+class ShardedWindowEngine(AdAnalyticsEngine):
+    """AdAnalyticsEngine with state + batches sharded over a device mesh.
+
+    Drop-in: same host loop, same Redis writeback; only the device step and
+    state placement change.  The campaign axis makes BASELINE config #5
+    (1e6-campaign multi-tenant) fit without replicating state.
+    """
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 mesh: Mesh, campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 input_format: str = "json"):
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, input_format=input_format)
+        self.mesh = mesh
+        n_data = mesh.shape[DATA_AXIS]
+        if self.batch_size % n_data:
+            raise ValueError(
+                f"batch size {self.batch_size} not divisible by data-axis "
+                f"size {n_data}")
+        # Re-place state sharded (padded on the campaign axis) and the join
+        # table replicated.
+        self.state = sharded_init_state(
+            self.encoder.num_campaigns, self.W, mesh)
+        self.join_table = jax.device_put(
+            jnp.asarray(self.encoder.join_table),
+            NamedSharding(mesh, P()))
+
+    def _device_step(self, ad_idx, event_type, event_time, valid) -> None:
+        self.state = sharded_step(
+            self.mesh, self.state, self.join_table,
+            ad_idx, event_type, event_time, valid,
+            divisor_ms=self.divisor, lateness_ms=self.lateness)

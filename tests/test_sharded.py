@@ -1,0 +1,114 @@
+"""Sharded (mesh) window counting vs the single-device op: bit-exact.
+
+The virtual 8-device CPU mesh is the stand-in for real multi-chip
+hardware, mirroring how the reference validates multi-node behavior with
+an embedded in-process cluster (SURVEY.md §4.3).
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.ops import windowcount as wc
+from streambench_tpu.parallel import (
+    ShardedWindowEngine,
+    build_mesh,
+    sharded_init_state,
+    sharded_step,
+)
+from streambench_tpu.engine import StreamRunner
+
+
+def rand_batches(rng, n_batches, B, n_ads, span_ms=200_000):
+    out = []
+    t = 70_000
+    for _ in range(n_batches):
+        ad = rng.integers(0, n_ads, B).astype(np.int32)
+        et = rng.integers(0, 3, B).astype(np.int32)
+        tm = (t + np.sort(rng.integers(0, span_ms // n_batches, B))
+              ).astype(np.int32)
+        valid = (rng.random(B) < 0.95)
+        t += span_ms // n_batches
+        out.append((ad, et, tm, valid))
+    return out
+
+
+MESHES = [(8, 1), (4, 2), (2, 4), (1, 8), (2, 2)]
+
+
+@pytest.mark.parametrize("dshape", MESHES)
+def test_sharded_step_matches_single_device(dshape):
+    nd, nc = dshape
+    mesh = build_mesh(data=nd, campaign=nc,
+                      devices=jax.devices()[: nd * nc])
+    rng = np.random.default_rng(7)
+    C, W, B = 96, 16, 64  # C divisible by every nc in MESHES
+    n_ads = C * 3
+    join = np.concatenate(
+        [rng.integers(0, C, n_ads).astype(np.int32), [-1]])
+
+    ref = wc.init_state(C, W)
+    sh = sharded_init_state(C, W, mesh)
+    jt = np.asarray(join)
+    for ad, et, tm, valid in rand_batches(rng, 6, B, n_ads):
+        ref = wc.step(ref, jt, ad, et, tm, valid)
+        sh = sharded_step(mesh, sh, jt, ad, et, tm, valid)
+
+    assert np.array_equal(np.asarray(ref.counts), np.asarray(sh.counts))
+    assert np.array_equal(np.asarray(ref.window_ids),
+                          np.asarray(sh.window_ids))
+    assert int(ref.watermark) == int(sh.watermark)
+    assert int(ref.dropped) == int(sh.dropped)
+
+
+def test_sharded_state_is_actually_sharded():
+    mesh = build_mesh(data=1, campaign=8)
+    st = sharded_init_state(100, 16, mesh)
+    # 100 campaigns pad to 104 (= 8 x 13); each campaign shard holds 13.
+    assert st.counts.shape == (104, 16)
+    shard_shapes = {s.data.shape for s in st.counts.addressable_shards}
+    assert shard_shapes == {(13, 16)}
+
+
+def test_sharded_flush_deltas_works():
+    mesh = build_mesh(data=4, campaign=2)
+    st = sharded_init_state(10, 16, mesh)
+    rng = np.random.default_rng(0)
+    join = np.concatenate([rng.integers(0, 10, 30).astype(np.int32), [-1]])
+    ad, et, tm, valid = rand_batches(rng, 1, 64, 30)[0]
+    st = sharded_step(mesh, st, join, ad, et, tm, valid)
+    deltas, wids, st2 = wc.flush_deltas(st)
+    total = int(np.asarray(deltas).sum())
+    views = int(((et == 0) & valid).sum()) - int(st.dropped)
+    assert total == views
+    assert int(np.asarray(st2.counts).sum()) == 0
+
+
+def test_sharded_engine_end_to_end_oracle(tmp_path):
+    cfg = default_config(jax_batch_size=512, jax_window_slots=16)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=20_000,
+                 rng=random.Random(5), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+
+    mesh = build_mesh(data=4, campaign=2)
+    engine = ShardedWindowEngine(cfg, mapping, mesh, redis=r)
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+    stats = runner.run_catchup()
+    engine.close()
+    assert stats.events == 20_000
+    assert engine.dropped == 0
+
+    logs = []
+    correct, differ, missing = gen.check_correct(r, str(tmp_path),
+                                                 log=logs.append)
+    assert differ == 0 and missing == 0, logs[:5]
+    assert correct >= 20
