@@ -1,0 +1,147 @@
+"""Native-backed EventEncoder: same contract, C++ hot path.
+
+Drop-in subclass of ``EventEncoder``: the fixed-layout JSON scan, string
+interning, and column fill run in ``libsbnative.so``; only lines the native
+scanner rejects (layout mismatch) take the Python ``json.loads`` fallback,
+interned through the same native maps so indices stay consistent.
+
+Use ``make_encoder()`` to get the native version when the library builds
+and the pure-Python one otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+
+import numpy as np
+
+from streambench_tpu import native
+from streambench_tpu.encode.encoder import (
+    AD_TYPE_INDEX,
+    EVENT_TYPE_INDEX,
+    EncodedBatch,
+    EventEncoder,
+)
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeEventEncoder(EventEncoder):
+    def __init__(self, ad_to_campaign: dict[str, str],
+                 campaigns: list[str] | None = None,
+                 divisor_ms: int = 10_000, lateness_ms: int = 60_000):
+        super().__init__(ad_to_campaign, campaigns,
+                         divisor_ms=divisor_ms, lateness_ms=lateness_ms)
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native encoder library unavailable")
+        self._lib = lib
+        ads_b = [a.encode() for a in self.ads]
+        offsets = np.zeros(len(ads_b) + 1, np.int64)
+        np.cumsum([len(a) for a in ads_b], out=offsets[1:])
+        self._enc = lib.sb_encoder_new(
+            b"".join(ads_b),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(ads_b), divisor_ms, lateness_ms)
+
+    def __del__(self):  # pragma: no cover - interpreter teardown order
+        lib = getattr(self, "_lib", None)
+        enc = getattr(self, "_enc", None)
+        if lib is not None and enc is not None:
+            lib.sb_encoder_free(enc)
+
+    def encode(self, lines: list[bytes], batch_size: int | None = None
+               ) -> EncodedBatch:
+        B = batch_size if batch_size is not None else len(lines)
+        nl = len(lines)
+        if nl > B:
+            raise ValueError(f"{nl} lines exceed batch size {B}")
+        buf = b"".join(lines)
+        offsets = np.zeros(nl + 1, np.int64)
+        np.cumsum([len(l) for l in lines], out=offsets[1:])
+
+        ad_idx = np.zeros(B, np.int32)
+        etype = np.full(B, -1, np.int32)
+        etime = np.zeros(B, np.int32)
+        user_idx = np.zeros(B, np.int32)
+        page_idx = np.zeros(B, np.int32)
+        ad_type = np.full(B, -1, np.int32)
+        status = np.zeros(B, np.uint8)
+
+        self._lib.sb_encode_json(
+            self._enc, buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), nl,
+            _i32p(ad_idx), _i32p(etype), _i32p(etime), _i32p(user_idx),
+            _i32p(page_idx), _i32p(ad_type),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+
+        # Python fallback for layout-mismatch lines (rare: hand-written or
+        # re-ordered JSON), through the native intern maps.
+        for i in np.flatnonzero(status[:nl] == 2).tolist():
+            self.fallback_lines += 1
+            rec = self._parse_fallback(lines[i])
+            if rec is None:
+                self.bad_lines += 1
+                status[i] = 0
+                continue
+            (ad_idx[i], etype[i], etime[i], user_idx[i], page_idx[i],
+             ad_type[i]) = rec
+            status[i] = 1
+
+        valid = status == 1
+        n = int(valid.sum())
+        if n != nl:
+            # compact valid rows to the front (engine reads [:n]); tail
+            # rows revert to the padding defaults (ad 0 / types -1 / t 0)
+            keep = np.flatnonzero(valid)
+            for col, pad in ((ad_idx, 0), (etype, -1), (etime, 0),
+                             (user_idx, 0), (page_idx, 0), (ad_type, -1)):
+                col[:n] = col[keep]
+                col[n:] = pad
+            valid = np.zeros(B, bool)
+            valid[:n] = True
+        self.base_time_ms = base = self._lib.sb_encoder_base_time(self._enc)
+        if base < 0:
+            self.base_time_ms = None
+        return EncodedBatch(ad_idx, etype, etime, user_idx, page_idx,
+                            ad_type, valid, n=n,
+                            base_time_ms=self.base_time_ms or 0)
+
+    def _parse_fallback(self, line: bytes):
+        try:
+            ev = json.loads(line)
+            t = int(ev["event_time"])
+        except (KeyError, ValueError, TypeError):
+            return None
+        if self._lib.sb_encoder_base_time(self._enc) < 0:
+            self._lib.sb_encoder_set_base_time(
+                self._enc,
+                t - (t % self.divisor_ms) - self.lateness_ms)
+        base = self._lib.sb_encoder_base_time(self._enc)
+        ad = str(ev.get("ad_id", "")).encode()
+        u = str(ev.get("user_id", "")).encode()
+        p = str(ev.get("page_id", "")).encode()
+        return (
+            self.ad_index.get(ad, self.unknown_ad),
+            EVENT_TYPE_INDEX.get(str(ev.get("event_type", "")), -1),
+            t - base,
+            self._lib.sb_intern_user(self._enc, u, len(u)),
+            self._lib.sb_intern_page(self._enc, p, len(p)),
+            AD_TYPE_INDEX.get(str(ev.get("ad_type", "")), -1),
+        )
+
+
+def make_encoder(ad_to_campaign: dict[str, str],
+                 campaigns: list[str] | None = None,
+                 divisor_ms: int = 10_000, lateness_ms: int = 60_000,
+                 use_native: bool = True) -> EventEncoder:
+    """Native encoder when available, else the pure-Python one."""
+    if use_native and native.load() is not None:
+        return NativeEventEncoder(ad_to_campaign, campaigns,
+                                  divisor_ms=divisor_ms,
+                                  lateness_ms=lateness_ms)
+    return EventEncoder(ad_to_campaign, campaigns,
+                        divisor_ms=divisor_ms, lateness_ms=lateness_ms)

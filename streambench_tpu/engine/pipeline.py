@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from streambench_tpu.config import BenchmarkConfig
-from streambench_tpu.encode import EventEncoder
+from streambench_tpu.encode.native_encoder import make_encoder
 from streambench_tpu.io.redis_schema import (
     RedisLike,
     dump_latency_hash,
@@ -52,9 +52,10 @@ class AdAnalyticsEngine:
         self.method = method or default_method()
         self.divisor = cfg.jax_time_divisor_ms
         self.lateness = cfg.jax_allowed_lateness_ms
-        self.encoder = EventEncoder(ad_to_campaign, campaigns,
+        self.encoder = make_encoder(ad_to_campaign, campaigns,
                                     divisor_ms=self.divisor,
-                                    lateness_ms=self.lateness)
+                                    lateness_ms=self.lateness,
+                                    use_native=cfg.jax_use_native_encoder)
         self.join_table = jnp.asarray(self.encoder.join_table)
         self.W = cfg.jax_window_slots
         self.batch_size = cfg.jax_batch_size

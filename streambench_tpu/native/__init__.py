@@ -1,0 +1,68 @@
+"""ctypes binding for the native encoder, with build-on-demand.
+
+``load()`` returns the shared library handle or None (no toolchain / build
+failure) — callers fall back to the pure-Python encoder.  The .so is built
+next to this file by ``make`` on first use; pybind11 isn't in this image,
+so the ABI is plain C and all buffers are numpy arrays passed by pointer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libsbnative.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_i64 = ctypes.c_int64
+    c_p = ctypes.c_void_p
+    lib.sb_encoder_new.restype = c_p
+    lib.sb_encoder_new.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(c_i64), ctypes.c_int32, c_i64, c_i64]
+    lib.sb_encoder_free.argtypes = [c_p]
+    lib.sb_encoder_base_time.restype = c_i64
+    lib.sb_encoder_base_time.argtypes = [c_p]
+    lib.sb_encoder_set_base_time.argtypes = [c_p, c_i64]
+    lib.sb_encoder_n_users.restype = c_i64
+    lib.sb_encoder_n_users.argtypes = [c_p]
+    lib.sb_encoder_n_pages.restype = c_i64
+    lib.sb_encoder_n_pages.argtypes = [c_p]
+    lib.sb_intern_user.restype = ctypes.c_int32
+    lib.sb_intern_user.argtypes = [c_p, ctypes.c_char_p, c_i64]
+    lib.sb_intern_page.restype = ctypes.c_int32
+    lib.sb_intern_page.argtypes = [c_p, ctypes.c_char_p, c_i64]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.sb_encode_json.restype = c_i64
+    lib.sb_encode_json.argtypes = [
+        c_p, ctypes.c_char_p, ctypes.POINTER(c_i64), ctypes.c_int32,
+        i32p, i32p, i32p, i32p, i32p, i32p,
+        ctypes.POINTER(ctypes.c_uint8)]
+    return lib
+
+
+def load(rebuild: bool = False) -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None and not rebuild:
+            return _lib
+        if _tried and not rebuild:
+            return _lib
+        _tried = True
+        src = os.path.join(_HERE, "encoder.cpp")
+        try:
+            if rebuild or not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < os.path.getmtime(src)):
+                subprocess.run(["make", "-C", _HERE], check=True,
+                               capture_output=True, timeout=120)
+            _lib = _configure(ctypes.CDLL(_SO))
+        except (OSError, subprocess.SubprocessError):
+            _lib = None
+        return _lib

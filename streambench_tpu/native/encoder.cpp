@@ -1,0 +1,194 @@
+// Native columnar event encoder: the host-side deserialize stage at line rate.
+//
+// TPU-native peer of the JVM engines' deserialize bolts
+// (storm-benchmarks/.../AdvertisingTopology.java:44-70): parses the
+// generator's fixed-field-order JSON wire format
+// (make-kafka-event-at, data/src/setup/core.clj:175-181) straight into
+// int32 column buffers that the XLA window step gathers/scatters on.
+// Strings (ad/user/page UUIDs) are interned to dense indices here, in C++,
+// so nothing string-shaped crosses into Python or onto the device.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+// Lines whose layout the fast scan rejects get status=2 and are re-parsed
+// by the Python json.loads fallback; hard-bad lines get status=0.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct StringInterner {
+  std::unordered_map<std::string, int32_t> map;
+  int32_t next = 0;
+
+  int32_t intern(const char* s, size_t len) {
+    auto r = map.emplace(std::string(s, len), next);
+    if (r.second) ++next;
+    return r.first->second;
+  }
+};
+
+struct Encoder {
+  std::unordered_map<std::string, int32_t> ad_index;
+  StringInterner users;
+  StringInterner pages;
+  int64_t base_time_ms = -1;  // -1: unset
+  int64_t divisor_ms = 10000;
+  int64_t lateness_ms = 60000;
+  int32_t unknown_ad = 0;
+};
+
+// token positions when splitting the generator's line on '"':
+//  1:user_id 3:<u> 5:page_id 7:<p> 9:ad_id 11:<ad> 13:ad_type 15:<at>
+// 17:event_type 19:<et> 21:event_time 23:<t>
+struct Tok {
+  const char* p;
+  size_t len;
+};
+
+inline bool tok_eq(const Tok& t, const char* lit, size_t n) {
+  return t.len == n && std::memcmp(t.p, lit, n) == 0;
+}
+
+// ad_type table (encode/encoder.py AD_TYPES) and event_type table
+// (EVENT_TYPES); event "view" == 0 is the device-side filter constant.
+inline int32_t ad_type_code(const Tok& t) {
+  switch (t.len) {
+    case 6:
+      if (tok_eq(t, "banner", 6)) return 0;
+      if (tok_eq(t, "mobile", 6)) return 4;
+      return -1;
+    case 5:  return tok_eq(t, "modal", 5) ? 1 : -1;
+    case 16: return tok_eq(t, "sponsored-search", 16) ? 2 : -1;
+    case 4:  return tok_eq(t, "mail", 4) ? 3 : -1;
+    default: return -1;
+  }
+}
+
+inline int32_t event_type_code(const Tok& t) {
+  if (tok_eq(t, "view", 4)) return 0;
+  if (tok_eq(t, "click", 5)) return 1;
+  if (tok_eq(t, "purchase", 8)) return 2;
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sb_encoder_new(const char* ads_buf, const int64_t* ad_offsets,
+                     int32_t n_ads, int64_t divisor_ms, int64_t lateness_ms) {
+  auto* e = new Encoder();
+  e->ad_index.reserve(static_cast<size_t>(n_ads) * 2);
+  for (int32_t i = 0; i < n_ads; ++i) {
+    const char* s = ads_buf + ad_offsets[i];
+    size_t len = static_cast<size_t>(ad_offsets[i + 1] - ad_offsets[i]);
+    e->ad_index.emplace(std::string(s, len), i);
+  }
+  e->unknown_ad = n_ads;
+  e->divisor_ms = divisor_ms;
+  e->lateness_ms = lateness_ms;
+  return e;
+}
+
+void sb_encoder_free(void* enc) { delete static_cast<Encoder*>(enc); }
+
+int64_t sb_encoder_base_time(void* enc) {
+  return static_cast<Encoder*>(enc)->base_time_ms;
+}
+
+void sb_encoder_set_base_time(void* enc, int64_t base) {
+  static_cast<Encoder*>(enc)->base_time_ms = base;
+}
+
+int64_t sb_encoder_n_users(void* enc) {
+  return static_cast<Encoder*>(enc)->users.next;
+}
+
+int64_t sb_encoder_n_pages(void* enc) {
+  return static_cast<Encoder*>(enc)->pages.next;
+}
+
+// Intern one id through the same maps the fast path uses, so Python
+// fallback-parsed lines stay index-consistent.
+int32_t sb_intern_user(void* enc, const char* s, int64_t len) {
+  return static_cast<Encoder*>(enc)->users.intern(
+      s, static_cast<size_t>(len));
+}
+
+int32_t sb_intern_page(void* enc, const char* s, int64_t len) {
+  return static_cast<Encoder*>(enc)->pages.intern(
+      s, static_cast<size_t>(len));
+}
+
+// Parse n_lines lines (buf + line_offsets, offsets[n] = end) into columns.
+// status[i]: 1 = parsed, 2 = layout mismatch (python fallback), 0 = bad.
+// Returns the number of status==1 rows.
+int64_t sb_encode_json(void* enc_, const char* buf,
+                       const int64_t* line_offsets, int32_t n_lines,
+                       int32_t* ad_idx, int32_t* etype, int32_t* etime,
+                       int32_t* user_idx, int32_t* page_idx,
+                       int32_t* ad_type, uint8_t* status) {
+  auto* enc = static_cast<Encoder*>(enc_);
+  int64_t ok = 0;
+  Tok toks[24];
+  for (int32_t i = 0; i < n_lines; ++i) {
+    const char* p = buf + line_offsets[i];
+    const char* end = buf + line_offsets[i + 1];
+    // split on '"' into the first 24 tokens
+    int nt = 0;
+    const char* start = p;
+    const char* q = p;
+    while (q < end && nt < 24) {
+      if (*q == '"') {
+        toks[nt].p = start;
+        toks[nt].len = static_cast<size_t>(q - start);
+        ++nt;
+        start = q + 1;
+      }
+      ++q;
+    }
+    if (nt < 24 || !tok_eq(toks[1], "user_id", 7) ||
+        !tok_eq(toks[5], "page_id", 7) || !tok_eq(toks[9], "ad_id", 5) ||
+        !tok_eq(toks[13], "ad_type", 7) ||
+        !tok_eq(toks[17], "event_type", 10) ||
+        !tok_eq(toks[21], "event_time", 10)) {
+      status[i] = 2;
+      continue;
+    }
+    // event_time digits
+    int64_t t = 0;
+    bool tok_ok = toks[23].len > 0 && toks[23].len <= 15;
+    if (tok_ok) {
+      for (size_t k = 0; k < toks[23].len; ++k) {
+        char c = toks[23].p[k];
+        if (c < '0' || c > '9') { tok_ok = false; break; }
+        t = t * 10 + (c - '0');
+      }
+    }
+    if (!tok_ok) {
+      status[i] = 2;
+      continue;
+    }
+    if (enc->base_time_ms < 0) {
+      enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
+    }
+    auto ad_it = enc->ad_index.find(
+        std::string(toks[11].p, toks[11].len));
+    ad_idx[i] = ad_it == enc->ad_index.end() ? enc->unknown_ad
+                                             : ad_it->second;
+    etype[i] = event_type_code(toks[19]);
+    etime[i] = static_cast<int32_t>(t - enc->base_time_ms);
+    user_idx[i] = enc->users.intern(toks[3].p, toks[3].len);
+    page_idx[i] = enc->pages.intern(toks[7].p, toks[7].len);
+    ad_type[i] = ad_type_code(toks[15]);
+    status[i] = 1;
+    ++ok;
+  }
+  return ok;
+}
+
+}  // extern "C"
