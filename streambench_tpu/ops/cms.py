@@ -1,0 +1,92 @@
+"""Count-min sketch as a TPU scatter-add kernel.
+
+BASELINE config #4's heavy-hitter structure: approximate per-key counts
+(clicks per user) in ``D`` hash rows x ``Wd`` counters.  Update is a
+masked scatter-add — same shape as the exact window count — and the
+cross-device merge is ``psum`` (counter add is associative/commutative:
+sharded merge exact, SURVEY.md §2 "Reduce/unifier" row).
+
+Point query = min over rows; heavy-hitter candidates are maintained on the
+host (classic CMS + candidate-set idiom) from the interned key universe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from streambench_tpu.ops.hll import splitmix32
+
+
+class CMSState(NamedTuple):
+    table: jax.Array   # [D, Wd] int32
+    total: jax.Array   # [] int32 — total weight folded in
+
+
+# Distinct odd salts decorrelate the D rows of one splitmix stream.
+_SALTS = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F,
+          0x165667B1, 0xFC545C4F, 0x2545F491, 0x61C88647)
+
+
+def init_state(depth: int = 4, width: int = 2048) -> CMSState:
+    if width & (width - 1):
+        raise ValueError("width must be a power of two")
+    if depth > len(_SALTS):
+        raise ValueError(f"depth <= {len(_SALTS)}")
+    return CMSState(table=jnp.zeros((depth, width), jnp.int32),
+                    total=jnp.int32(0))
+
+
+def _row_cols(keys: jax.Array, depth: int, width: int) -> jax.Array:
+    """[D, B] column index per row: salted splitmix32, low log2(Wd) bits."""
+    cols = []
+    for d in range(depth):
+        h = splitmix32(keys.astype(jnp.uint32) ^ jnp.uint32(_SALTS[d]))
+        cols.append((h & jnp.uint32(width - 1)).astype(jnp.int32))
+    return jnp.stack(cols)
+
+
+@jax.jit
+def update(state: CMSState, keys: jax.Array, weights: jax.Array,
+           mask: jax.Array) -> CMSState:
+    """Add ``weights`` for ``keys`` (masked rows dropped)."""
+    D, Wd = state.table.shape
+    cols = _row_cols(keys, D, Wd)                       # [D, B]
+    w = jnp.where(mask, weights, 0).astype(jnp.int32)   # [B]
+    flat = (jnp.arange(D, dtype=jnp.int32)[:, None] * Wd + cols)
+    flat = jnp.where(mask[None, :], flat, D * Wd)
+    table = (state.table.reshape(-1)
+             .at[flat.reshape(-1)]
+             .add(jnp.broadcast_to(w, (D, w.shape[0])).reshape(-1),
+                  mode="drop")
+             .reshape(D, Wd))
+    return CMSState(table, state.total + jnp.sum(w))
+
+
+@jax.jit
+def query(state: CMSState, keys: jax.Array) -> jax.Array:
+    """Point estimates (upper bounds) for ``keys``: min over rows."""
+    D, Wd = state.table.shape
+    cols = _row_cols(keys, D, Wd)
+    rows = jnp.arange(D, dtype=jnp.int32)[:, None]
+    return jnp.min(state.table[rows, cols], axis=0)
+
+
+def merge(a: CMSState, b: CMSState) -> CMSState:
+    """Sketch union: elementwise add (dimensions must match)."""
+    return CMSState(a.table + b.table, a.total + b.total)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def heavy_hitters(state: CMSState, candidate_keys: jax.Array, *,
+                  k: int = 16):
+    """Top-k candidates by CMS estimate: (values, indices into candidates).
+
+    The candidate set is the interned key universe (dense ids from the
+    encoder) — query them all, take top-k on device.
+    """
+    est = query(state, candidate_keys)
+    return jax.lax.top_k(est, k)

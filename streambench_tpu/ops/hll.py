@@ -1,0 +1,147 @@
+"""HyperLogLog distinct counting as a windowed TPU aggregation kernel.
+
+BASELINE config #2: the YSB topology with distinct-user-per-campaign in
+place of the exact view count.  Per event the update is a scatter-max of
+the rank (leading-zero count) into a register array — exactly the shape of
+the exact-count scatter-add, so it shares ``assign_windows`` and the same
+ring/watermark semantics, and the cross-device merge is ``pmax`` (register
+max is associative/commutative, so sharded merge is exact, SURVEY.md §2
+"Reduce/unifier" row).
+
+Registers are int32 ``[C, W, R]`` with R a power of two.  The hash is
+splitmix32 over the interned user index (dense ids hash as well as UUIDs
+once mixed).  Estimation runs on device: the classic alpha_m bias-corrected
+harmonic mean with linear-counting small-range correction.
+
+Unlike exact counts (flushed as HINCRBY-able deltas), HLL registers are
+NOT deltas: the flush snapshots estimates for occupied slots and zeroes
+only *closed* slots; the Redis writeback overwrites (HSET) instead of
+accumulating.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from streambench_tpu.ops.windowcount import assign_windows
+
+
+class HLLState(NamedTuple):
+    """registers: [C, W, R] int32; ring metadata as in WindowState."""
+
+    registers: jax.Array
+    window_ids: jax.Array
+    watermark: jax.Array
+    dropped: jax.Array
+
+
+def init_state(num_campaigns: int, window_slots: int,
+               num_registers: int = 256) -> HLLState:
+    if num_registers & (num_registers - 1):
+        raise ValueError("num_registers must be a power of two")
+    return HLLState(
+        registers=jnp.zeros((num_campaigns, window_slots, num_registers),
+                            jnp.int32),
+        window_ids=jnp.full((window_slots,), -1, jnp.int32),
+        watermark=jnp.int32(0),
+        dropped=jnp.int32(0),
+    )
+
+
+def splitmix32(x: jax.Array) -> jax.Array:
+    """32-bit splitmix finalizer (public-domain constant schedule)."""
+    x = x.astype(jnp.uint32)
+    x = (x + jnp.uint32(0x9E3779B9)).astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x21F0AAAD)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x735A2D97)
+    x = x ^ (x >> 15)
+    return x
+
+
+def _rank(h: jax.Array, p: int) -> jax.Array:
+    """1 + leading-zero count of the top (32-p) hash bits, in [1, 33-p].
+
+    Computed via float32 frexp bit-length; exact because w < 2^(32-p)
+    <= 2^24 for p >= 8 (init_state enforces R=2^p with p <= 14 in
+    practice; callers should keep p >= 8 for exactness, or accept
+    float32-rounding slack above that).
+    """
+    bits = 32 - p
+    w = (h >> jnp.uint32(p)).astype(jnp.int32)
+    _, exp = jnp.frexp(w.astype(jnp.float32))
+    bitlen = jnp.where(w > 0, exp, 0)
+    return (bits - bitlen + 1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("divisor_ms", "lateness_ms", "view_type"))
+def step(state: HLLState, join_table: jax.Array,
+         ad_idx: jax.Array, user_idx: jax.Array, event_type: jax.Array,
+         event_time: jax.Array, valid: jax.Array,
+         *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
+         view_type: int = 0) -> HLLState:
+    """Fold one micro-batch: registers[campaign, slot, j] = max(., rank)."""
+    C, W, R = state.registers.shape
+    p = R.bit_length() - 1
+
+    campaign = join_table[ad_idx]
+    wid = event_time // divisor_ms
+    wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+    slot, count_mask, window_ids, watermark = assign_windows(
+        state.window_ids, state.watermark, wid, wanted, valid, event_time,
+        divisor_ms=divisor_ms, lateness_ms=lateness_ms)
+
+    h = splitmix32(user_idx)
+    j = (h & jnp.uint32(R - 1)).astype(jnp.int32)
+    rank = _rank(h, p)
+
+    flat = jnp.where(count_mask, (campaign * W + slot) * R + j, C * W * R)
+    registers = (state.registers.reshape(-1)
+                 .at[flat].max(rank, mode="drop")
+                 .reshape(C, W, R))
+
+    dropped = state.dropped + (
+        jnp.sum(wanted.astype(jnp.int32))
+        - jnp.sum(count_mask.astype(jnp.int32)))
+    return HLLState(registers, window_ids, watermark, dropped)
+
+
+@jax.jit
+def estimate(registers: jax.Array) -> jax.Array:
+    """Distinct-count estimates, any leading batch dims over last axis R.
+
+    alpha_m * R^2 / sum(2^-M) with linear counting below 2.5R when empty
+    registers remain (Flajolet et al. 2007 operating points).
+    """
+    R = registers.shape[-1]
+    alpha = {16: 0.673, 32: 0.697, 64: 0.709}.get(
+        R, 0.7213 / (1 + 1.079 / R))
+    inv = jnp.sum(jnp.exp2(-registers.astype(jnp.float32)), axis=-1)
+    raw = alpha * R * R / inv
+    zeros = jnp.sum((registers == 0).astype(jnp.float32), axis=-1)
+    linear = R * jnp.log(jnp.where(zeros > 0, R / jnp.maximum(zeros, 1.0),
+                                   1.0))
+    return jnp.where((raw <= 2.5 * R) & (zeros > 0), linear, raw)
+
+
+@functools.partial(jax.jit, static_argnames=("divisor_ms", "lateness_ms"))
+def flush(state: HLLState, *, divisor_ms: int = 10_000,
+          lateness_ms: int = 60_000):
+    """Snapshot estimates ``[C, W]`` + window ids; zero registers of
+    *closed* slots (watermark past end + lateness) and free their slots.
+    Open slots keep their registers — estimates are absolute, not deltas.
+    """
+    est = estimate(state.registers)
+    closed = ((state.window_ids + 1) * divisor_ms + lateness_ms
+              <= state.watermark)
+    freed = closed | (state.window_ids < 0)
+    new_ids = jnp.where(freed, jnp.int32(-1), state.window_ids)
+    regs = jnp.where(freed[None, :, None], 0, state.registers)
+    return est, state.window_ids, HLLState(
+        regs, new_ids, state.watermark, state.dropped)

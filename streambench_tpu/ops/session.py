@@ -1,0 +1,175 @@
+"""Session windows (gap-based), fully vectorized with carried user state.
+
+BASELINE config #4: per-user click aggregation over 30 s-gap sessions.
+Sessionization is inherently per-key-sequential; the TPU formulation makes
+it data-parallel per micro-batch:
+
+1. sort the batch by (user, time) — two stable argsorts, no dynamic shapes;
+2. a session boundary is a user change or an intra-user gap > ``gap_ms``;
+   segment ids come from a cumsum over boundary flags;
+3. per-segment aggregates (start, end, clicks) via ``segment_sum``-style
+   scatters with a static segment capacity of B;
+4. the *last* segment per user merges into the carried state
+   ``(last_time, sess_start, clicks)[user]``; earlier segments close and
+   are emitted as fixed-shape ``[B]`` arrays with validity masks, as is a
+   carried session whose user reappears after the gap.
+
+Sessions also close by time: ``flush`` emits every carried session whose
+``last_time + gap + lateness`` the watermark has passed (no event can
+extend it anymore, since older events are dropped as late).
+
+State capacity is static (``capacity`` users = interned ids); events whose
+user index overflows it are dropped and counted, like ring eviction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from streambench_tpu.ops.windowcount import NEG
+
+
+class SessionState(NamedTuple):
+    last_time: jax.Array   # [U] int32; -1 = no open session
+    sess_start: jax.Array  # [U] int32
+    clicks: jax.Array      # [U] int32
+    watermark: jax.Array   # [] int32
+    dropped: jax.Array     # [] int32
+
+
+class ClosedSessions(NamedTuple):
+    """Fixed-shape emission: one row per (potential) closed session."""
+
+    user: jax.Array    # [N] int32
+    start: jax.Array   # [N] int32
+    end: jax.Array     # [N] int32
+    clicks: jax.Array  # [N] int32
+    valid: jax.Array   # [N] bool
+
+
+def init_state(capacity: int) -> SessionState:
+    return SessionState(
+        last_time=jnp.full((capacity,), -1, jnp.int32),
+        sess_start=jnp.zeros((capacity,), jnp.int32),
+        clicks=jnp.zeros((capacity,), jnp.int32),
+        watermark=jnp.int32(0),
+        dropped=jnp.int32(0),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("gap_ms", "lateness_ms", "click_type"))
+def step(state: SessionState, user_idx: jax.Array, event_type: jax.Array,
+         event_time: jax.Array, valid: jax.Array,
+         *, gap_ms: int = 30_000, lateness_ms: int = 60_000,
+         click_type: int = 1
+         ) -> tuple[SessionState, ClosedSessions, ClosedSessions]:
+    """Fold one micro-batch; returns (state, closed_in_batch, closed_carry)."""
+    U = state.last_time.shape[0]
+    B = user_idx.shape[0]
+
+    # Lateness vs watermark as of batch start (see ops.windowcount) plus
+    # capacity overflow.
+    min_t = state.watermark - lateness_ms
+    mask = valid & (event_time >= min_t) & (user_idx >= 0) & (user_idx < U)
+    batch_max = jnp.max(jnp.where(valid, event_time, NEG))
+    new_wm = jnp.maximum(state.watermark, batch_max)
+    dropped = state.dropped + (
+        jnp.sum(valid.astype(jnp.int32)) - jnp.sum(mask.astype(jnp.int32)))
+
+    # Sort by (user, time); masked rows sort to the end via user key U.
+    ukey = jnp.where(mask, user_idx, U)
+    order = jnp.argsort(event_time, stable=True)
+    order = order[jnp.argsort(ukey[order], stable=True)]
+    su = user_idx[order]
+    st = event_time[order]
+    sm = mask[order]
+    sclick = (event_type[order] == click_type) & sm
+
+    prev_su = jnp.concatenate([jnp.full((1,), -1, jnp.int32), su[:-1]])
+    prev_st = jnp.concatenate([jnp.full((1,), 0, jnp.int32), st[:-1]])
+    prev_sm = jnp.concatenate([jnp.zeros((1,), bool), sm[:-1]])
+    same_user = sm & prev_sm & (su == prev_su)
+    first_of_user = sm & ~same_user
+
+    # Carried-session link for each user's first in-batch event.
+    carry_last = state.last_time[jnp.clip(su, 0, U - 1)]
+    carry_open = first_of_user & (carry_last >= 0)
+    cont_carry = carry_open & (st - carry_last <= gap_ms)
+
+    boundary = first_of_user | (same_user & (st - prev_st > gap_ms))
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1       # [B] segment id
+    seg = jnp.where(sm, seg, B)                            # masked → pad seg
+
+    ar = jnp.arange(B, dtype=jnp.int32)
+    seg_clicks = jnp.zeros((B,), jnp.int32).at[seg].add(
+        sclick.astype(jnp.int32), mode="drop")
+    seg_start = jnp.full((B,), 2**31 - 1, jnp.int32).at[seg].min(
+        jnp.where(sm, st, 2**31 - 1), mode="drop")
+    seg_end = jnp.full((B,), NEG, jnp.int32).at[seg].max(
+        jnp.where(sm, st, NEG), mode="drop")
+    # per-segment metadata from its boundary row
+    bseg = jnp.where(boundary, seg, B)
+    seg_user = jnp.full((B,), -1, jnp.int32).at[bseg].set(su, mode="drop")
+    seg_cont = jnp.zeros((B,), bool).at[bseg].set(
+        cont_carry, mode="drop")
+    seg_exists = jnp.zeros((B,), bool).at[bseg].set(True, mode="drop")
+
+    # Merge carried session into each user's first segment when continuing.
+    cseg_user = jnp.clip(seg_user, 0, U - 1)
+    seg_start = jnp.where(seg_cont, state.sess_start[cseg_user], seg_start)
+    seg_clicks = seg_clicks + jnp.where(
+        seg_cont, state.clicks[cseg_user], 0)
+
+    # A segment closes if a later segment of the same user exists in the
+    # batch — i.e. it is not its user's last segment.
+    next_boundary_same = jnp.zeros((B,), bool).at[
+        jnp.where(boundary & same_user, seg - 1, B)].set(True, mode="drop")
+    seg_closed = seg_exists & next_boundary_same
+
+    closed_in_batch = ClosedSessions(
+        user=seg_user, start=seg_start, end=seg_end, clicks=seg_clicks,
+        valid=seg_closed)
+
+    # Carried sessions whose user reappeared after the gap close now.
+    closed_carry = ClosedSessions(
+        user=su,
+        start=state.sess_start[jnp.clip(su, 0, U - 1)],
+        end=carry_last,
+        clicks=state.clicks[jnp.clip(su, 0, U - 1)],
+        valid=carry_open & ~cont_carry)
+
+    # Update carry from each user's LAST (open) segment.
+    seg_open = seg_exists & ~seg_closed
+    open_user = jnp.where(seg_open, seg_user, U)
+    last_time = state.last_time.at[open_user].set(seg_end, mode="drop")
+    sess_start = state.sess_start.at[open_user].set(seg_start, mode="drop")
+    clicks = state.clicks.at[open_user].set(seg_clicks, mode="drop")
+
+    new_state = SessionState(last_time, sess_start, clicks, new_wm, dropped)
+    return new_state, closed_in_batch, closed_carry
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("gap_ms", "lateness_ms", "force"))
+def flush(state: SessionState, *, gap_ms: int = 30_000,
+          lateness_ms: int = 60_000,
+          force: bool = False) -> tuple[SessionState, ClosedSessions]:
+    """Close sessions the watermark has passed (or all, when ``force``)."""
+    U = state.last_time.shape[0]
+    open_ = state.last_time >= 0
+    expired = open_ & (state.watermark > state.last_time + gap_ms
+                       + lateness_ms)
+    if force:
+        expired = open_
+    closed = ClosedSessions(
+        user=jnp.arange(U, dtype=jnp.int32),
+        start=state.sess_start, end=state.last_time, clicks=state.clicks,
+        valid=expired)
+    last_time = jnp.where(expired, jnp.int32(-1), state.last_time)
+    return SessionState(last_time, state.sess_start, state.clicks,
+                        state.watermark, state.dropped), closed

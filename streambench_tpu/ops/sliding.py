@@ -1,0 +1,66 @@
+"""Sliding (hopping) windows: size S, slide s, as S tumbling memberships.
+
+BASELINE config #3's windowing (10 s window / 1 s slide).  An event at t
+belongs to the S = size/slide windows whose ids end at floor(t/slide); the
+ring machinery is reused with ``divisor = slide`` and an *effective
+lateness* of ``lateness + size - slide`` so a window closes exactly when
+the watermark passes ``start + size + lateness`` (the generic ring closes
+at ``(wid+1)*divisor + lateness``; the widened lateness makes those equal).
+
+The membership loop is a static Python ``for`` over S — under jit XLA
+unrolls it into S masked scatters, no dynamic control flow.  State shape
+is identical to the tumbling ``WindowState``; ``flush_deltas`` works
+unchanged when called with the same effective lateness.  ``dropped``
+counts lost *memberships* (an event has S of them), not events.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from streambench_tpu.ops.windowcount import WindowState, assign_windows
+
+
+def effective_lateness(size_ms: int, slide_ms: int, lateness_ms: int) -> int:
+    return lateness_ms + size_ms - slide_ms
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("size_ms", "slide_ms", "lateness_ms", "view_type"))
+def step(state: WindowState, join_table: jax.Array,
+         ad_idx: jax.Array, event_type: jax.Array,
+         event_time: jax.Array, valid: jax.Array,
+         *, size_ms: int = 10_000, slide_ms: int = 1_000,
+         lateness_ms: int = 60_000, view_type: int = 0) -> WindowState:
+    if size_ms % slide_ms:
+        raise ValueError("size_ms must be a multiple of slide_ms")
+    S = size_ms // slide_ms
+    late_eff = effective_lateness(size_ms, slide_ms, lateness_ms)
+    C, W = state.counts.shape
+
+    campaign = join_table[ad_idx]
+    base_wid = event_time // slide_ms
+    wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+    counts = state.counts
+    ids = state.window_ids
+    dropped = state.dropped
+    watermark = state.watermark
+    for k in range(S):
+        wid = base_wid - k
+        slot, count_mask, ids, wm = assign_windows(
+            ids, state.watermark, wid, wanted, valid, event_time,
+            divisor_ms=slide_ms, lateness_ms=late_eff)
+        watermark = wm
+        flat = jnp.where(count_mask, campaign * W + slot, C * W)
+        counts = (counts.reshape(-1)
+                  .at[flat].add(1, mode="drop")
+                  .reshape(C, W))
+        dropped = dropped + (
+            jnp.sum(wanted.astype(jnp.int32))
+            - jnp.sum(count_mask.astype(jnp.int32)))
+    return WindowState(counts, ids, watermark, dropped)

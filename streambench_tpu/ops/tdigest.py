@@ -1,0 +1,158 @@
+"""t-digest quantile sketches as fixed-shape TPU kernels.
+
+BASELINE config #3's latency-quantile structure.  Classic t-digest keeps a
+variable-length centroid list per key — hostile to XLA.  This formulation
+is fixed-shape throughout, keyed over ``N`` digests (the caller maps
+(campaign, window-slot) -> key):
+
+- state: ``means [N, K]``, ``weights [N, K]`` (weight 0 = empty centroid);
+- batch fold: sort events by (key, value); within-key ranks by a
+  segment-cumsum; each event lands in centroid
+  ``floor(K * k1(q))`` where ``q`` is its within-key mid-rank quantile and
+  ``k1(q) = asin(2q-1)/pi + 1/2`` is t-digest's tail-accurate scale
+  function (Dunning & Ertl); scatter-add (weight, weight*value);
+- merge: concat old and new centroids to ``[N, 2K]``, sort by mean,
+  re-bucket by cumulative-weight mid-quantile through the same scale, and
+  scatter back to ``[N, K]``.  Merge is associative *approximately* — the
+  usual t-digest property — and weight totals are conserved exactly.
+
+Quantile query sorts centroids by mean and linearly interpolates on the
+cumulative-weight midpoints.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class TDigestState(NamedTuple):
+    means: jax.Array    # [N, K] float32
+    weights: jax.Array  # [N, K] float32
+
+
+def init_state(num_keys: int, compression: int = 64) -> TDigestState:
+    return TDigestState(
+        means=jnp.zeros((num_keys, compression), jnp.float32),
+        weights=jnp.zeros((num_keys, compression), jnp.float32),
+    )
+
+
+def _k1_bucket(q: jax.Array, K: int) -> jax.Array:
+    """Scale-function bucketing: tails get narrow centroids."""
+    q = jnp.clip(q, 0.0, 1.0)
+    k = (jnp.arcsin(2.0 * q - 1.0) / jnp.pi + 0.5) * K
+    return jnp.clip(k.astype(jnp.int32), 0, K - 1)
+
+
+def _fold(key, value, w, N: int, K: int):
+    """Batch-local digest: scatter (w, w*value) into fresh ``[N, K]``
+    buffers, bucketed by within-key mid-rank quantile."""
+    B = key.shape[0]
+    # sort by (key, value): stable value sort, then stable key sort
+    order = jnp.argsort(value, stable=True)
+    order = order[jnp.argsort(key[order], stable=True)]
+    sk = key[order]
+    sv = value[order]
+    sw = w[order]
+
+    # within-key cumulative weight (exclusive) via global cumsum minus the
+    # key's starting cumsum, taken from the first row of each key run
+    csum = jnp.cumsum(sw) - sw                      # exclusive prefix
+    first = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    # broadcast each run's starting csum to its rows: running max of
+    # (csum at run starts), since csum is nondecreasing
+    run_base = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, csum, 0.0))
+    within = csum - run_base
+    total = jnp.zeros((N,), jnp.float32).at[sk].add(sw, mode="drop")
+    tot_here = total[jnp.clip(sk, 0, N - 1)]
+    q = (within + sw * 0.5) / jnp.maximum(tot_here, 1e-9)
+    bucket = _k1_bucket(q, K)
+
+    flat = jnp.where(sw > 0, sk * K + bucket, N * K)
+    weights = (jnp.zeros((N * K,), jnp.float32)
+               .at[flat].add(sw, mode="drop").reshape(N, K))
+    means_num = (jnp.zeros((N * K,), jnp.float32)
+                 .at[flat].add(sw * sv, mode="drop").reshape(N, K))
+    return means_num, weights
+
+
+@jax.jit
+def update(state: TDigestState, key: jax.Array, value: jax.Array,
+           mask: jax.Array) -> TDigestState:
+    """Fold one batch of (key, value) points, then compress back to K."""
+    N, K = state.means.shape
+    w = jnp.where(mask, 1.0, 0.0).astype(jnp.float32)
+    key = jnp.where(mask, key, N)
+
+    new_num, new_w = _fold(key, value.astype(jnp.float32), w, N, K)
+    new_mean = new_num / jnp.maximum(new_w, 1e-9)
+    return _compress(
+        jnp.concatenate([state.means, new_mean], axis=1),
+        jnp.concatenate([state.weights, new_w], axis=1), K)
+
+
+def _compress(m2: jax.Array, w2: jax.Array, K: int) -> TDigestState:
+    """Re-bucket ``[N, M]`` centroids down to ``[N, K]`` via the k1 scale."""
+    N = m2.shape[0]
+    order = jnp.argsort(jnp.where(w2 > 0, m2, jnp.inf), axis=1)
+    m2 = jnp.take_along_axis(m2, order, axis=1)
+    w2 = jnp.take_along_axis(w2, order, axis=1)
+    csum = jnp.cumsum(w2, axis=1) - w2
+    tot = jnp.sum(w2, axis=1, keepdims=True)
+    q = (csum + 0.5 * w2) / jnp.maximum(tot, 1e-9)
+    bucket = _k1_bucket(q, K)
+    rows = jnp.broadcast_to(jnp.arange(N)[:, None], bucket.shape)
+    flat = jnp.where(w2 > 0, rows * K + bucket, N * K)
+    weights = (jnp.zeros((N * K,), jnp.float32)
+               .at[flat.reshape(-1)].add(w2.reshape(-1), mode="drop")
+               .reshape(N, K))
+    nums = (jnp.zeros((N * K,), jnp.float32)
+            .at[flat.reshape(-1)].add((w2 * m2).reshape(-1), mode="drop")
+            .reshape(N, K))
+    means = nums / jnp.maximum(weights, 1e-9)
+    return TDigestState(means, weights)
+
+
+@jax.jit
+def quantile(state: TDigestState, qs: jax.Array) -> jax.Array:
+    """Per-key quantiles: returns ``[N, len(qs)]``.
+
+    Linear interpolation between centroid means at cumulative-weight
+    midpoints; empty digests return 0.
+    """
+    N, K = state.means.shape
+    order = jnp.argsort(jnp.where(state.weights > 0, state.means, jnp.inf),
+                        axis=1)
+    m = jnp.take_along_axis(state.means, order, axis=1)
+    w = jnp.take_along_axis(state.weights, order, axis=1)
+    tot = jnp.sum(w, axis=1, keepdims=True)            # [N, 1]
+    mid = (jnp.cumsum(w, axis=1) - 0.5 * w) / jnp.maximum(tot, 1e-9)
+
+    def one_key(mids, mns, wts, total):
+        def one_q(q):
+            idx = jnp.searchsorted(mids, q)
+            lo = jnp.clip(idx - 1, 0, K - 1)
+            hi = jnp.clip(idx, 0, K - 1)
+            t = jnp.where(
+                mids[hi] > mids[lo],
+                (q - mids[lo]) / jnp.maximum(mids[hi] - mids[lo], 1e-9),
+                0.0)
+            v = mns[lo] + t * (mns[hi] - mns[lo])
+            return jnp.where(total[0] > 0, v, 0.0)
+        return jax.vmap(one_q)(qs)
+
+    return jax.vmap(one_key)(mid, m, w, tot)
+
+
+@jax.jit
+def merge(a: TDigestState, b: TDigestState) -> TDigestState:
+    """Digest union (e.g. cross-device): exact in total weight."""
+    K = a.means.shape[1]
+    return _compress(
+        jnp.concatenate([a.means, b.means], axis=1),
+        jnp.concatenate([a.weights, b.weights], axis=1), K)

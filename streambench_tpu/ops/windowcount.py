@@ -71,6 +71,53 @@ def init_state(num_campaigns: int, window_slots: int) -> WindowState:
     )
 
 
+def assign_windows(window_ids: jax.Array, watermark: jax.Array,
+                   wid: jax.Array, wanted: jax.Array, valid: jax.Array,
+                   event_time: jax.Array, *, divisor_ms: int,
+                   lateness_ms: int):
+    """The shared windowing core: lateness mask, ring-slot claim, ownership.
+
+    Every windowed aggregator (exact count, HLL, count-min, t-digest) uses
+    this identically; only the state update differs.  Returns
+    ``(slot, count_mask, new_window_ids, new_watermark)`` where
+    ``count_mask`` marks events whose window owns its ring slot.
+    """
+    W = window_ids.shape[0]
+
+    # Event-time watermark over the *valid* rows (not just counted ones).
+    batch_max = jnp.max(jnp.where(valid, event_time, NEG))
+    new_watermark = jnp.maximum(watermark, batch_max)
+
+    # Allowed lateness (generator can emit events up to 60 s late,
+    # core.clj:170-173); older events are dropped, not miscounted.
+    # Lateness is judged against the watermark AS OF BATCH START
+    # (the passed-in watermark, not the post-batch one): watermarks flow
+    # between batches, so events can never be late relative to peers in
+    # their own batch — otherwise a catchup batch spanning >lateness of
+    # event time would drop its own oldest events.
+    # wid < 0 (events before the encoder's base window) must also be
+    # dropped: wid == -1 would alias the empty-slot sentinel and count
+    # into a phantom slot.  The encoder rebases base_time_ms a full
+    # lateness span early, so in practice this only fires for events
+    # beyond allowed lateness anyway.
+    min_wid = (watermark - lateness_ms) // divisor_ms
+    mask = wanted & (wid >= min_wid) & (wid >= 0)
+
+    # Claim ring slots: newer window ids win (masked scatter-max; masked
+    # rows scatter to index W which the padded buffer absorbs).
+    slot = wid % W
+    slot_or_pad = jnp.where(mask, slot, W)
+    padded_ids = jnp.concatenate([window_ids, jnp.full((1,), -1, jnp.int32)])
+    padded_ids = padded_ids.at[slot_or_pad].max(wid)
+    new_window_ids = padded_ids[:W]
+
+    # Aggregate only events whose window owns its slot after claiming;
+    # events evicted by a newer window within the ring span are dropped.
+    owns = new_window_ids[slot] == wid
+    count_mask = mask & owns
+    return slot, count_mask, new_window_ids, new_watermark
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("divisor_ms", "lateness_ms", "view_type", "method"))
@@ -86,37 +133,9 @@ def step(state: WindowState, join_table: jax.Array,
     wid = event_time // divisor_ms                     # [B]
     wanted = valid & (event_type == view_type) & (campaign >= 0)
 
-    # Event-time watermark over the *valid* rows (not just counted ones).
-    batch_max = jnp.max(jnp.where(valid, event_time, NEG))
-    watermark = jnp.maximum(state.watermark, batch_max)
-
-    # Allowed lateness (generator can emit events up to 60 s late,
-    # core.clj:170-173); older events are dropped, not miscounted.
-    # Lateness is judged against the watermark AS OF BATCH START
-    # (state.watermark, not the post-batch one): watermarks flow between
-    # batches, so events can never be late relative to peers in their own
-    # batch — otherwise a catchup batch spanning >lateness of event time
-    # would drop its own oldest events.
-    # wid < 0 (events before the encoder's base window) must also be
-    # dropped: wid == -1 would alias the empty-slot sentinel and count
-    # into a phantom slot.  The encoder rebases base_time_ms a full
-    # lateness span early, so in practice this only fires for events
-    # beyond allowed lateness anyway.
-    min_wid = (state.watermark - lateness_ms) // divisor_ms
-    mask = wanted & (wid >= min_wid) & (wid >= 0)
-
-    # Claim ring slots: newer window ids win (masked scatter-max; masked
-    # rows scatter to index W which the padded buffer absorbs).
-    slot = wid % W
-    slot_or_pad = jnp.where(mask, slot, W)
-    padded_ids = jnp.concatenate([state.window_ids, jnp.full((1,), -1, jnp.int32)])
-    padded_ids = padded_ids.at[slot_or_pad].max(wid)
-    window_ids = padded_ids[:W]
-
-    # Count only events whose window owns its slot after claiming; events
-    # evicted by a newer window within the ring span are dropped.
-    owns = window_ids[slot] == wid
-    count_mask = mask & owns
+    slot, count_mask, window_ids, watermark = assign_windows(
+        state.window_ids, state.watermark, wid, wanted, valid, event_time,
+        divisor_ms=divisor_ms, lateness_ms=lateness_ms)
 
     # Masked rows get index C*W: out-of-bounds on the high side, which
     # scatter mode="drop" discards (negative indices would *wrap*).

@@ -1,0 +1,156 @@
+"""Sliding + session window ops vs pure-Python reference models."""
+
+import numpy as np
+
+from streambench_tpu.ops import session, sliding
+from streambench_tpu.ops import windowcount as wc
+
+
+# ------------------------------------------------------------ sliding
+def ref_sliding_counts(events, join, size, slide):
+    """events: (ad, etype, t, valid); returns {(campaign, wid): count}."""
+    out = {}
+    for ad, et, t, v in events:
+        if not v or et != 0 or join[ad] < 0:
+            continue
+        base = t // slide
+        for k in range(size // slide):
+            wid = base - k
+            if wid < 0:
+                continue
+            out[(join[ad], wid)] = out.get((join[ad], wid), 0) + 1
+    return out
+
+
+def test_sliding_counts_match_reference():
+    rng = np.random.default_rng(21)
+    C, W = 5, 96  # ring must cover lateness_eff + span at slide granularity
+    n_ads = 15
+    join = np.concatenate(
+        [rng.integers(0, C, n_ads).astype(np.int32), [-1]])
+    st = wc.init_state(C, W)
+    all_events = []
+    for _ in range(6):
+        B = 256
+        ad = rng.integers(0, n_ads, B).astype(np.int32)
+        et = rng.integers(0, 3, B).astype(np.int32)
+        tm = np.sort(rng.integers(70_000, 82_000, B)).astype(np.int32)
+        valid = rng.random(B) < 0.9
+        st = sliding.step(st, join, ad, et, tm, valid,
+                          size_ms=10_000, slide_ms=1_000)
+        all_events += list(zip(ad.tolist(), et.tolist(), tm.tolist(),
+                               valid.tolist()))
+    assert int(st.dropped) == 0
+    expected = ref_sliding_counts(all_events, join, 10_000, 1_000)
+    counts = np.asarray(st.counts)
+    wids = np.asarray(st.window_ids)
+    got = {}
+    for s in range(W):
+        if wids[s] < 0:
+            continue
+        for c in range(C):
+            if counts[c, s]:
+                got[(c, int(wids[s]))] = int(counts[c, s])
+    assert got == expected
+
+
+def test_sliding_flush_uses_effective_lateness():
+    late_eff = sliding.effective_lateness(10_000, 1_000, 60_000)
+    C, W = 2, 96
+    join = np.array([0, 1, -1], np.int32)
+    st = wc.init_state(C, W)
+    tm = np.array([70_000, 70_000 + late_eff + 1_500], np.int32)
+    st = sliding.step(st, join, np.array([0, 1], np.int32),
+                      np.zeros(2, np.int32), tm, np.ones(2, bool),
+                      size_ms=10_000, slide_ms=1_000)
+    deltas, wids, st2 = wc.flush_deltas(st, divisor_ms=1_000,
+                                        lateness_ms=late_eff)
+    # the first event's earliest window (wid 61) is now closed
+    w2 = np.asarray(st2.window_ids)
+    assert (w2[np.asarray(wids) == 61] == -1).all()
+
+
+# ------------------------------------------------------------ session
+def ref_sessions(events, gap):
+    """events: (user, etype, t) sorted arbitrarily; returns list of
+    (user, start, end, clicks) for ALL sessions (closed + open)."""
+    per_user: dict[int, list[int]] = {}
+    from collections import defaultdict
+    evs = defaultdict(list)
+    for u, et, t in events:
+        evs[u].append((t, et))
+    out = []
+    for u, rows in evs.items():
+        rows.sort()
+        start, last, clicks = None, None, 0
+        for t, et in rows:
+            if start is None:
+                start, last, clicks = t, t, 0
+            elif t - last > gap:
+                out.append((u, start, last, clicks))
+                start, last, clicks = t, t, 0
+            last = t
+            clicks += 1 if et == 1 else 0
+        if start is not None:
+            out.append((u, start, last, clicks))
+    return sorted(out)
+
+
+def collect_closed(*closed_batches):
+    out = []
+    for cb in closed_batches:
+        v = np.asarray(cb.valid)
+        for i in np.flatnonzero(v):
+            out.append((int(cb.user[i]), int(cb.start[i]),
+                        int(cb.end[i]), int(cb.clicks[i])))
+    return out
+
+
+def test_session_windows_match_reference():
+    rng = np.random.default_rng(31)
+    U, B = 16, 128
+    st = session.init_state(U)
+    gap = 30_000
+    all_events = []
+    emitted = []
+    t0 = 70_000
+    for step_i in range(8):
+        user = rng.integers(0, U, B).astype(np.int32)
+        et = rng.integers(0, 3, B).astype(np.int32)
+        # spread events so some gaps exceed 30 s per user
+        tm = np.sort(t0 + rng.integers(0, 60_000, B)).astype(np.int32)
+        t0 += 60_000
+        valid = np.ones(B, bool)
+        st, cb, cc = session.step(st, user, et, tm, valid, gap_ms=gap)
+        emitted += collect_closed(cb, cc)
+        all_events += list(zip(user.tolist(), et.tolist(), tm.tolist()))
+    st, fin = session.flush(st, gap_ms=gap, force=True)
+    emitted += collect_closed(fin)
+    assert int(st.dropped) == 0
+    assert sorted(emitted) == ref_sessions(all_events, gap)
+
+
+def test_session_flush_by_watermark():
+    st = session.init_state(4)
+    user = np.array([1, 2], np.int32)
+    et = np.ones(2, np.int32)
+    tm = np.array([70_000, 71_000], np.int32)
+    st, cb, cc = session.step(st, user, et, tm, np.ones(2, bool))
+    # advance watermark far past user 1+2's last events
+    st, cb2, cc2 = session.step(
+        st, np.array([3], np.int32), np.ones(1, np.int32),
+        np.array([200_000], np.int32), np.ones(1, bool))
+    st, closed = session.flush(st, gap_ms=30_000, lateness_ms=60_000)
+    got = collect_closed(closed)
+    assert (1, 70_000, 70_000, 1) in got and (2, 71_000, 71_000, 1) in got
+    # user 3's session is still open
+    assert all(u != 3 for u, *_ in got)
+
+
+def test_session_capacity_overflow_drops():
+    st = session.init_state(2)
+    user = np.array([0, 1, 5], np.int32)   # 5 >= capacity
+    st, cb, cc = session.step(st, user, np.ones(3, np.int32),
+                              np.array([70_000, 70_001, 70_002], np.int32),
+                              np.ones(3, bool))
+    assert int(st.dropped) == 1
