@@ -1,0 +1,314 @@
+#!/usr/bin/env python3
+"""Benchmark harness — the ``stream-bench.sh`` peer (reference: 409-line bash
+``run()`` case dispatch, ``stream-bench.sh:117-409``).
+
+Same operation grammar: a list of operation names, each dispatched by
+``run()``; composite ``JAX_TEST`` mirrors ``FLINK_TEST``
+(``stream-bench.sh:301-315``): start services -> start engine -> start load
+-> sleep TEST_TIME -> stop load (collect stats) -> stop engine -> stop
+services.  Same knobs via env vars (``stream-bench.sh:9-40``): ``TOPIC``,
+``PARTITIONS``, ``LOAD``, ``TEST_TIME``, ``REDIS_HOST``, ``REDIS_PORT``,
+``WORKDIR``, ``CONF_FILE``.
+
+Differences by design:
+- services are Python subprocesses with pidfiles (no process-grep
+  ``pid_match``, ``stream-bench.sh:42-46`` — pidfiles are exact);
+- there is no ZooKeeper/Kafka daemon: the broker is the file journal
+  (``streambench_tpu.io.journal``), and Redis is the in-repo RESP server
+  (``streambench_tpu.io.fakeredis``) unless ``REDIS_HOST`` points elsewhere;
+- SETUP compiles nothing to download: it only writes ``localConf.yaml``
+  (``stream-bench.sh:123-138``) and pre-builds the native encoder.
+
+Usage:  python stream_bench.py SETUP START_REDIS ... | JAX_TEST | STOP_ALL
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# --- env knobs (names per stream-bench.sh:9-40) ---
+TOPIC = os.environ.get("TOPIC", "ad-events")
+PARTITIONS = int(os.environ.get("PARTITIONS", "1"))
+LOAD = int(os.environ.get("LOAD", "1000"))               # events/sec
+TEST_TIME = float(os.environ.get("TEST_TIME", "240"))    # seconds
+REDIS_HOST = os.environ.get("REDIS_HOST", "127.0.0.1")
+REDIS_PORT = int(os.environ.get("REDIS_PORT", "6379"))
+WORKDIR = os.path.abspath(os.environ.get("WORKDIR", "./bench-run"))
+CONF_FILE = os.environ.get("CONF_FILE", os.path.join(WORKDIR, "localConf.yaml"))
+SHARDED = os.environ.get("SHARDED", "") not in ("", "0", "false", "no")
+STOP_STATS_GRACE_S = float(os.environ.get("STOP_STATS_GRACE", "2.5"))
+
+PID_DIR = os.path.join(WORKDIR, "pids")
+LOG_DIR = os.path.join(WORKDIR, "logs")
+BROKER_DIR = os.path.join(WORKDIR, "broker")
+
+
+def log(msg: str) -> None:
+    print(msg, flush=True)
+
+
+# ----------------------------------------------------------------------
+# process lifecycle (pidfile versions of start_if_needed / stop_if_needed,
+# stream-bench.sh:47-81)
+# ----------------------------------------------------------------------
+
+def _pidfile(name: str) -> str:
+    return os.path.join(PID_DIR, f"{name}.pid")
+
+
+def _alive(pid: int) -> bool:
+    # Reap if it's our own child (else an exited child stays a zombie and
+    # would look alive to kill(pid, 0) forever).
+    try:
+        os.waitpid(pid, os.WNOHANG)
+    except ChildProcessError:
+        pass
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    try:  # a zombie of some other parent is not "running"
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().rsplit(")", 1)[1].split()[0] != "Z"
+    except (FileNotFoundError, IndexError):
+        return False
+
+
+def running_pid(name: str) -> int | None:
+    try:
+        with open(_pidfile(name)) as f:
+            pid = int(f.read().strip())
+    except (FileNotFoundError, ValueError):
+        return None
+    return pid if _alive(pid) else None
+
+
+def start_if_needed(name: str, argv: list[str]) -> int:
+    pid = running_pid(name)
+    if pid is not None:
+        log(f"{name} is already running (pid {pid})...")
+        return pid
+    os.makedirs(PID_DIR, exist_ok=True)
+    os.makedirs(LOG_DIR, exist_ok=True)
+    env = dict(os.environ, PYTHONUNBUFFERED="1")
+    logf = open(os.path.join(LOG_DIR, f"{name}.log"), "ab")
+    proc = subprocess.Popen(argv, cwd=REPO_ROOT, stdout=logf, stderr=logf,
+                            env=env, start_new_session=True)
+    with open(_pidfile(name), "w") as f:
+        f.write(str(proc.pid))
+    log(f"started {name} (pid {proc.pid})")
+    return proc.pid
+
+
+def stop_if_needed(name: str, timeout_s: float = 30.0) -> None:
+    pid = running_pid(name)
+    if pid is None:
+        log(f"No running instances of {name}")
+        return
+    os.kill(pid, signal.SIGTERM)
+    deadline = time.monotonic() + timeout_s
+    while _alive(pid) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if _alive(pid):
+        log(f"{name} (pid {pid}) did not exit; killing")
+        os.kill(pid, signal.SIGKILL)
+    try:
+        os.remove(_pidfile(name))
+    except FileNotFoundError:
+        pass
+    log(f"stopped {name}")
+
+
+def _run_tool(argv: list[str], name: str) -> int:
+    """Run a foreground step (seeding, stats), teeing output to its log."""
+    os.makedirs(LOG_DIR, exist_ok=True)
+    with open(os.path.join(LOG_DIR, f"{name}.log"), "ab") as logf:
+        proc = subprocess.run(argv, cwd=REPO_ROOT, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+        logf.write(proc.stdout)
+    sys.stdout.write(proc.stdout.decode("utf-8", "replace"))
+    return proc.returncode
+
+
+def _py(mod: str, *args: str) -> list[str]:
+    return [sys.executable, "-m", mod, *args]
+
+
+def _datagen(*args: str) -> list[str]:
+    return _py("streambench_tpu.datagen", *args,
+               "--configPath", CONF_FILE, "--workdir", WORKDIR,
+               "--brokerDir", BROKER_DIR)
+
+
+# ----------------------------------------------------------------------
+# operations (the run() case arms, stream-bench.sh:117-398)
+# ----------------------------------------------------------------------
+
+def op_setup() -> None:
+    """Write localConf.yaml from env vars (stream-bench.sh:123-138) and
+    pre-build the native encoder (the only thing to 'compile')."""
+    os.makedirs(WORKDIR, exist_ok=True)
+    sys.path.insert(0, REPO_ROOT)
+    from streambench_tpu.config import write_local_conf
+    write_local_conf(CONF_FILE, {
+        "kafka.brokers": ["localhost"],
+        "zookeeper.servers": ["localhost"],
+        "kafka.port": 9092,
+        "zookeeper.port": 2181,
+        "redis.host": REDIS_HOST,
+        "redis.port": REDIS_PORT,
+        "kafka.topic": TOPIC,
+        "kafka.partitions": PARTITIONS,
+        "process.hosts": 1,
+        "process.cores": 4,
+    })
+    log(f"wrote {CONF_FILE}")
+    rc = subprocess.run(["make", "-s"], cwd=os.path.join(
+        REPO_ROOT, "streambench_tpu", "native")).returncode
+    log("native encoder ready" if rc == 0 else
+        "native encoder build failed (python encoder will be used)")
+
+
+def op_start_redis() -> None:
+    start_if_needed("redis", _py("streambench_tpu.io.fakeredis",
+                                 "--host", REDIS_HOST,
+                                 "--port", str(REDIS_PORT)))
+    _wait_redis()
+    # seed campaigns, like `lein run -n` right after redis start
+    # (stream-bench.sh:182-186)
+    rc = _run_tool(_datagen("-n"), "seed")
+    if rc != 0:
+        raise SystemExit(f"redis seeding failed (rc={rc})")
+
+
+def _wait_redis(timeout_s: float = 15.0) -> None:
+    sys.path.insert(0, REPO_ROOT)
+    from streambench_tpu.io.resp import RespClient
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            with RespClient(REDIS_HOST, REDIS_PORT, timeout_s=1.0) as c:
+                if c.ping() == "PONG":
+                    return
+        except OSError:
+            pass
+        if time.monotonic() > deadline:
+            raise SystemExit("redis did not come up")
+        time.sleep(0.1)
+
+
+def op_stop_redis() -> None:
+    stop_if_needed("redis")
+
+
+def op_start_load() -> None:
+    start_if_needed("load", _datagen("-r", "-t", str(LOAD)))
+
+
+def op_stop_load() -> None:
+    """Kill the generator, then collect stats -> seen.txt/updated.txt
+    (stream-bench.sh:231-236)."""
+    had_load = running_pid("load") is not None
+    stop_if_needed("load")
+    if had_load:
+        # let the engine's 1 Hz flusher drain the tail windows first
+        time.sleep(STOP_STATS_GRACE_S)
+    rc = _run_tool(_datagen("-g"), "stats")
+    if rc != 0:
+        log(f"stats collection failed (rc={rc})")
+
+
+def op_start_jax_processing() -> None:
+    args = ["--confPath", CONF_FILE, "--workdir", WORKDIR,
+            "--brokerDir", BROKER_DIR]
+    if SHARDED:
+        args.append("--sharded")
+    if running_pid("engine") is not None:
+        log("engine is already running...")
+        return
+    logpath = os.path.join(LOG_DIR, "engine.log")
+    log_start = os.path.getsize(logpath) if os.path.exists(logpath) else 0
+    pid = start_if_needed("engine", _py("streambench_tpu.engine", *args))
+    # Wait until the engine has pre-compiled and printed its ready marker,
+    # so a following START_LOAD measures the stream, not XLA compilation.
+    # Only look at log bytes written by THIS instance (the log appends).
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        try:
+            with open(logpath) as f:
+                f.seek(log_start)
+                if "engine up:" in f.read():
+                    return
+        except FileNotFoundError:
+            pass
+        if not _alive(pid):
+            raise SystemExit(f"engine died during startup; see {logpath}")
+        time.sleep(0.2)
+    raise SystemExit("engine did not become ready within 300s")
+
+
+def op_stop_jax_processing() -> None:
+    stop_if_needed("engine")
+
+
+def op_jax_test() -> None:
+    """Composite run, same sequence as FLINK_TEST (stream-bench.sh:301-315)."""
+    op_setup()
+    op_start_redis()
+    op_start_jax_processing()
+    op_start_load()
+    log(f"sleeping {TEST_TIME:.0f}s")
+    time.sleep(TEST_TIME)
+    op_stop_load()
+    op_stop_jax_processing()
+    op_stop_redis()
+
+
+def op_stop_all() -> None:
+    for name in ("load", "engine", "redis"):
+        stop_if_needed(name)
+
+
+OPS: dict[str, object] = {
+    "SETUP": op_setup,
+    "START_REDIS": op_start_redis,
+    "STOP_REDIS": op_stop_redis,
+    "START_LOAD": op_start_load,
+    "STOP_LOAD": op_stop_load,
+    "START_JAX_PROCESSING": op_start_jax_processing,
+    "STOP_JAX_PROCESSING": op_stop_jax_processing,
+    "JAX_TEST": op_jax_test,
+    "STOP_ALL": op_stop_all,
+}
+
+
+def run(op: str) -> None:
+    """Dispatch one operation (the run() case statement,
+    stream-bench.sh:117-398)."""
+    fn = OPS.get(op)
+    if fn is None:
+        names = "|".join(OPS)
+        log(f"UNKNOWN OPERATION '{op}'")
+        log(f"Supported operations: {names}")
+        raise SystemExit(1)
+    fn()  # type: ignore[operator]
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        log("Usage: stream_bench.py OPERATION [...]")
+        log(f"Supported operations: {'|'.join(OPS)}")
+        return 1
+    for op in argv:
+        run(op)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -104,7 +104,16 @@ def main(argv: list[str] | None = None) -> int:
         if args.throughput <= 0:
             print("-r requires -t THROUGHPUT > 0")
             return 2
-        print(f"Running, emitting {args.throughput} tuples per second.")
+        print(f"Running, emitting {args.throughput} tuples per second.",
+              flush=True)
+        # STOP_LOAD kills the generator with SIGTERM (stream-bench.sh:231);
+        # exit through SystemExit so the journal writer context flushes.
+        import signal
+
+        def _term(*_):
+            raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, _term)
         broker.create_topic(cfg.kafka_topic)
         with broker.writer(cfg.kafka_topic) as sink:
             sent = gen.run_paced(

@@ -1,0 +1,145 @@
+"""Engine process CLI — the peer of one engine-topology launch.
+
+In the reference, ``START_FLINK_PROCESSING`` submits the topology jar to a
+running cluster (``stream-bench.sh:254``: ``flink run … --confPath
+conf/localConf.yaml``) and ``STOP_*_PROCESSING`` cancels it.  Here the
+"topology" is one OS process: it loads the config, builds the
+``AdAnalyticsEngine`` (or its sharded variant), tails the broker topic, and
+flushes the canonical Redis window schema until it receives SIGTERM, at
+which point it drains, closes (final flush + fork-style latency dump,
+``AdvertisingTopologyNative.java:521-532``), and prints one JSON stats line.
+
+    python -m streambench_tpu.engine --confPath conf/localConf.yaml \
+        --workdir RUN_DIR [--brokerDir DIR] [--duration S] [--sharded]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+# The image's sitecustomize may force a hardware backend via jax.config,
+# overriding the JAX_PLATFORMS env var; re-pin it so harness-driven test
+# runs (JAX_PLATFORMS=cpu) actually land on the requested platform.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from streambench_tpu.config import ConfigError, find_and_read_config_file
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine.pipeline import AdAnalyticsEngine
+from streambench_tpu.engine.runner import StreamRunner
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.io.resp import RespClient
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="streambench-engine")
+    p.add_argument("--confPath", default="./benchmarkConf.yaml")
+    p.add_argument("--workdir", default=".",
+                   help="where the id/mapping files from -n/-s live")
+    p.add_argument("--brokerDir", default=None)
+    p.add_argument("--duration", type=float, default=None,
+                   help="seconds to run (default: until SIGTERM)")
+    p.add_argument("--idleTimeout", type=float, default=None,
+                   help="exit after this many idle seconds (catchup runs)")
+    p.add_argument("--maxEvents", type=int, default=None)
+    p.add_argument("--catchup", action="store_true",
+                   help="drain the journal at full speed, then exit")
+    p.add_argument("--sharded", action="store_true",
+                   help="run the mesh-sharded engine (jax.mesh.* config)")
+    return p
+
+
+def load_mapping(cfg, workdir: str) -> tuple[dict[str, str], list[str] | None]:
+    """Resolve the ad->campaign join table the way the fork does: an explicit
+    ``ad_to_campaign_path`` wins (``AdvertisingTopologyNative.java:47-56``),
+    else the workdir files written by the generator's ``-n``/``-s`` modes."""
+    path = cfg.ad_to_campaign_path or os.path.join(
+        workdir, gen.AD_TO_CAMPAIGN_FILE)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"ad->campaign mapping not found at {path}; run the generator "
+            "-n or -s mode first (or set ad_to_campaign_path)")
+    mapping = gen.load_ad_mapping_file(path)
+    ids = gen.load_ids(workdir)
+    campaigns = ids[0] if ids else None
+    return mapping, campaigns
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        cfg = find_and_read_config_file(args.confPath)
+    except ConfigError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    mapping, campaigns = load_mapping(cfg, args.workdir)
+    if cfg.redis_host == ":inprocess:":
+        redis = as_redis(FakeRedisStore())
+    else:
+        redis = RespClient(cfg.redis_host, cfg.redis_port)
+
+    def make_engine(r) -> AdAnalyticsEngine:
+        if args.sharded:
+            from streambench_tpu.parallel import (
+                ShardedWindowEngine,
+                mesh_from_config,
+            )
+            return ShardedWindowEngine(cfg, mapping, mesh_from_config(cfg),
+                                       campaigns=campaigns, redis=r)
+        return AdAnalyticsEngine(cfg, mapping, campaigns=campaigns, redis=r)
+
+    engine = make_engine(redis)
+
+    broker = FileBroker(args.brokerDir or os.path.join(args.workdir, "broker"))
+    broker.create_topic(cfg.kafka_topic)
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+
+    signal.signal(signal.SIGTERM, lambda *_: runner.stop())
+    signal.signal(signal.SIGINT, lambda *_: runner.stop())
+
+    # Pre-compile the device step on a throwaway same-shape engine before
+    # announcing readiness, so the load phase never races XLA compilation
+    # (~20-40 s on first TPU use) — the JVM engines likewise deploy their
+    # tasks before the harness starts the generator.
+    import random as _random
+
+    from streambench_tpu.utils.ids import now_ms
+
+    _rng = _random.Random(0)
+    src = gen.EventSource(ads=list(mapping), user_ids=gen.make_ids(4, _rng),
+                          page_ids=gen.make_ids(4, _rng), rng=_rng)
+    warm = make_engine(None)
+    warm.process_lines([ln.encode("utf-8") if isinstance(ln, str) else ln
+                        for ln in src.events_at([now_ms()] * 8)])
+    warm.flush()
+    del warm
+    print(f"engine up: topic={cfg.kafka_topic} redis={cfg.redis_host}:"
+          f"{cfg.redis_port} batch={engine.batch_size}", flush=True)
+
+    if args.catchup:
+        stats = runner.run_catchup(max_events=args.maxEvents)
+    else:
+        stats = runner.run(duration_s=args.duration,
+                           idle_timeout_s=args.idleTimeout,
+                           max_events=args.maxEvents)
+    engine.close()
+    print(json.dumps({
+        "events": stats.events, "batches": stats.batches,
+        "windows_written": stats.windows_written,
+        "events_per_s": round(stats.events_per_s, 1),
+        "dropped": engine.dropped, "wall_s": round(stats.wall_s, 2),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

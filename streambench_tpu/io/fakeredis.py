@@ -231,3 +231,28 @@ class FakeRedisServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Run the server as a standalone process — the harness's
+    ``redis-server`` stand-in (``start_if_needed redis-server``,
+    ``stream-bench.sh:180-187``).  Exits cleanly on SIGTERM/SIGINT."""
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(prog="streambench-redis")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6379)
+    args = p.parse_args(argv)
+    srv = FakeRedisServer(args.host, args.port).start()
+    print(f"ready {srv.host}:{srv.port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -96,12 +96,30 @@ def step(state: SessionState, user_idx: jax.Array, event_type: jax.Array,
     same_user = sm & prev_sm & (su == prev_su)
     first_of_user = sm & ~same_user
 
-    # Carried-session link for each user's first in-batch event.
-    carry_last = state.last_time[jnp.clip(su, 0, U - 1)]
+    # Carried-session link.  A user's carry merges into their FIRST
+    # in-batch segment iff the first event lies within ``gap_ms`` of the
+    # carried span on EITHER side: at most gap after the last activity,
+    # and at most gap before the carried session's start — a very late
+    # event predating the span by more than the gap is its own session.
+    # (If only a LATER in-batch event is near the carry, the merge is
+    # missed — an accepted approximation: carry merges only at the first
+    # segment.)
+    cu = jnp.clip(su, 0, U - 1)
+    user_first_t = jnp.full((U,), 2**31 - 1, jnp.int32).at[
+        jnp.where(first_of_user, su, U)].min(st, mode="drop")
+    ucont = ((state.last_time >= 0)
+             & (user_first_t - state.last_time <= gap_ms)
+             & (state.sess_start - user_first_t <= gap_ms))
+    carry_last = state.last_time[cu]
     carry_open = first_of_user & (carry_last >= 0)
-    cont_carry = carry_open & (st - carry_last <= gap_ms)
+    cont_carry = first_of_user & ucont[cu]
 
-    boundary = first_of_user | (same_user & (st - prev_st > gap_ms))
+    # Gap test: the session's last activity before row i is
+    # max(previous in-batch time, carried last_time when the carry merges
+    # into this user's first segment).  A late event can sort before the
+    # carried last_time, so prev_st alone would split sessions spuriously.
+    eff_prev = jnp.maximum(prev_st, jnp.where(ucont[cu], carry_last, NEG))
+    boundary = first_of_user | (same_user & (st - eff_prev > gap_ms))
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1       # [B] segment id
     seg = jnp.where(sm, seg, B)                            # masked → pad seg
 
@@ -120,8 +138,14 @@ def step(state: SessionState, user_idx: jax.Array, event_type: jax.Array,
     seg_exists = jnp.zeros((B,), bool).at[bseg].set(True, mode="drop")
 
     # Merge carried session into each user's first segment when continuing.
+    # The merged end must not regress below the carried last activity (the
+    # whole batch may consist of late events older than it).
     cseg_user = jnp.clip(seg_user, 0, U - 1)
-    seg_start = jnp.where(seg_cont, state.sess_start[cseg_user], seg_start)
+    seg_start = jnp.where(
+        seg_cont, jnp.minimum(seg_start, state.sess_start[cseg_user]),
+        seg_start)
+    seg_end = jnp.where(
+        seg_cont, jnp.maximum(seg_end, state.last_time[cseg_user]), seg_end)
     seg_clicks = seg_clicks + jnp.where(
         seg_cont, state.clicks[cseg_user], 0)
 
@@ -138,9 +162,9 @@ def step(state: SessionState, user_idx: jax.Array, event_type: jax.Array,
     # Carried sessions whose user reappeared after the gap close now.
     closed_carry = ClosedSessions(
         user=su,
-        start=state.sess_start[jnp.clip(su, 0, U - 1)],
+        start=state.sess_start[cu],
         end=carry_last,
-        clicks=state.clicks[jnp.clip(su, 0, U - 1)],
+        clicks=state.clicks[cu],
         valid=carry_open & ~cont_carry)
 
     # Update carry from each user's LAST (open) segment.

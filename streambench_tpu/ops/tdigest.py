@@ -132,12 +132,17 @@ def quantile(state: TDigestState, qs: jax.Array) -> jax.Array:
     w = jnp.take_along_axis(state.weights, order, axis=1)
     tot = jnp.sum(w, axis=1, keepdims=True)            # [N, 1]
     mid = (jnp.cumsum(w, axis=1) - 0.5 * w) / jnp.maximum(tot, 1e-9)
+    # Empty centroids sort last with mean 0; mask their midpoints to +inf
+    # and clamp interpolation to the last OCCUPIED centroid, else any q
+    # above the last occupied midpoint interpolates toward 0.
+    mid = jnp.where(w > 0, mid, jnp.inf)
+    last = jnp.maximum(jnp.sum((w > 0).astype(jnp.int32), axis=1) - 1, 0)
 
-    def one_key(mids, mns, wts, total):
+    def one_key(mids, mns, total, last_i):
         def one_q(q):
             idx = jnp.searchsorted(mids, q)
-            lo = jnp.clip(idx - 1, 0, K - 1)
-            hi = jnp.clip(idx, 0, K - 1)
+            lo = jnp.clip(idx - 1, 0, last_i)
+            hi = jnp.clip(idx, 0, last_i)
             t = jnp.where(
                 mids[hi] > mids[lo],
                 (q - mids[lo]) / jnp.maximum(mids[hi] - mids[lo], 1e-9),
@@ -146,7 +151,7 @@ def quantile(state: TDigestState, qs: jax.Array) -> jax.Array:
             return jnp.where(total[0] > 0, v, 0.0)
         return jax.vmap(one_q)(qs)
 
-    return jax.vmap(one_key)(mid, m, w, tot)
+    return jax.vmap(one_key)(mid, m, tot, last)
 
 
 @jax.jit

@@ -1,0 +1,77 @@
+"""Harness CLI tests — the ``stream-bench.sh`` peer end-to-end.
+
+The composite ``JAX_TEST`` is the same sequence as the reference's
+``FLINK_TEST`` (``stream-bench.sh:301-315``): services up -> engine up ->
+paced load -> stop load (collect stats to ``seen.txt``/``updated.txt``) ->
+teardown.  The run here is real multi-process: a RESP server process, an
+engine process, and a generator process, talking over sockets and the
+journal broker.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SB = os.path.join(REPO, "stream_bench.py")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_harness(ops, env_extra, timeout=240):
+    env = dict(os.environ, **env_extra, PYTHONUNBUFFERED="1",
+               JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, SB, *ops], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_unknown_operation_lists_supported():
+    proc = run_harness(["NO_SUCH_OP"], {"WORKDIR": "/tmp/sb-unknown"})
+    assert proc.returncode == 1
+    assert "UNKNOWN OPERATION" in proc.stdout
+    assert "JAX_TEST" in proc.stdout
+
+
+def test_jax_test_end_to_end(tmp_path):
+    wd = str(tmp_path / "run")
+    env = {
+        "WORKDIR": wd,
+        "REDIS_PORT": str(free_port()),
+        "LOAD": "400",
+        "TEST_TIME": "10",
+        "TOPIC": "ad-events",
+    }
+    proc = run_harness(["JAX_TEST"], env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # stats were collected into the canonical files (core.clj:130-149)
+    seen = open(os.path.join(wd, "seen.txt")).read().split()
+    updated = open(os.path.join(wd, "updated.txt")).read().split()
+    assert seen and updated and len(seen) == len(updated)
+    assert all(int(s) > 0 for s in seen)
+
+    # the engine exited cleanly and processed events exactly
+    last = open(os.path.join(wd, "logs", "engine.log")).read().strip()
+    stats = json.loads(last.splitlines()[-1])
+    assert stats["events"] > 0
+    assert stats["dropped"] == 0
+    total_seen = sum(int(s) for s in seen)
+    assert 0 < total_seen <= stats["events"]
+
+    # teardown left no processes behind
+    for name in ("redis", "engine", "load"):
+        assert not os.path.exists(os.path.join(wd, "pids", f"{name}.pid"))
+
+
+def test_ops_are_rerunnable(tmp_path):
+    """STOP on nothing is a no-op, like stop_if_needed (stream-bench.sh:66)."""
+    wd = str(tmp_path / "run2")
+    proc = run_harness(["STOP_ALL"], {"WORKDIR": wd})
+    assert proc.returncode == 0
+    assert "No running instances" in proc.stdout

@@ -1,5 +1,6 @@
 """Sketch kernels (HLL, count-min, t-digest) vs exact reference models."""
 
+import jax.numpy as jnp
 import numpy as np
 
 from streambench_tpu.ops import cms, hll, tdigest
@@ -139,3 +140,13 @@ def test_tdigest_empty_key_returns_zero():
     q = np.asarray(tdigest.quantile(st, np.array([0.5], np.float32)))
     assert q[1, 0] == 0.0 and q[2, 0] == 0.0
     assert 3.0 < q[0, 0] < 6.0
+
+
+def test_tdigest_tail_quantile_with_empty_centroids():
+    """Digests with unoccupied centroid slots must not interpolate tail
+    quantiles toward empty (mean-0) centroids (code-review finding)."""
+    st = tdigest.init_state(1, compression=16)
+    vals = np.full(4, 100.0, np.float32)
+    st = tdigest.update(st, np.zeros(4, np.int32), vals, np.ones(4, bool))
+    q = np.asarray(tdigest.quantile(st, jnp.array([0.5, 0.99, 1.0])))
+    assert np.allclose(q[0], 100.0), q

@@ -154,3 +154,60 @@ def test_session_capacity_overflow_drops():
                               np.array([70_000, 70_001, 70_002], np.int32),
                               np.ones(3, bool))
     assert int(st.dropped) == 1
+
+
+def test_session_late_event_does_not_regress_carry():
+    """A late-but-in-gap event must not pull the carried session's last
+    activity (or a later gap decision) backwards (code-review finding)."""
+    st = session.init_state(4)
+    # open a session for user 1 ending at t=100_000
+    st, cb, cc = session.step(
+        st, np.array([1], np.int32), np.ones(1, np.int32),
+        np.array([100_000], np.int32), np.ones(1, bool))
+    # late event at 90_000 (within 60s lateness, within 30s gap)
+    st, cb, cc = session.step(
+        st, np.array([1], np.int32), np.ones(1, np.int32),
+        np.array([90_000], np.int32), np.ones(1, bool))
+    assert int(st.last_time[1]) == 100_000  # not regressed to 90_000
+    assert not (np.asarray(cb.valid).any() or np.asarray(cc.valid).any())
+    # event at 125_000: 25s after true last activity -> SAME session
+    st, cb, cc = session.step(
+        st, np.array([1], np.int32), np.ones(1, np.int32),
+        np.array([125_000], np.int32), np.ones(1, bool))
+    assert not (np.asarray(cb.valid).any() or np.asarray(cc.valid).any())
+    st, fin = session.flush(st, force=True)
+    got = collect_closed(fin)
+    assert got == [(1, 90_000, 125_000, 3)]
+
+
+def test_session_late_batch_then_split_in_one_batch():
+    """Late event + far event in ONE batch: the in-batch gap test must use
+    the carried last activity, not just the previous in-batch event."""
+    st = session.init_state(4)
+    st, cb, cc = session.step(
+        st, np.array([1], np.int32), np.ones(1, np.int32),
+        np.array([100_000], np.int32), np.ones(1, bool))
+    st, cb, cc = session.step(
+        st, np.array([1, 1], np.int32), np.ones(2, np.int32),
+        np.array([90_000, 125_000], np.int32), np.ones(2, bool))
+    # 125_000 - 100_000 = 25s <= gap: still one session, nothing closed
+    assert not (np.asarray(cb.valid).any() or np.asarray(cc.valid).any())
+    st, fin = session.flush(st, force=True)
+    assert collect_closed(fin) == [(1, 90_000, 125_000, 3)]
+
+
+def test_session_far_late_event_is_its_own_session():
+    """An event more than gap_ms BEFORE the carried session's start must
+    not merge into it (code-review finding)."""
+    st = session.init_state(4)
+    st, cb, cc = session.step(
+        st, np.array([1], np.int32), np.ones(1, np.int32),
+        np.array([100_000], np.int32), np.ones(1, bool))
+    # 50s before the carried span start, gap is 30s -> separate session
+    st, cb, cc = session.step(
+        st, np.array([1], np.int32), np.ones(1, np.int32),
+        np.array([50_000], np.int32), np.ones(1, bool))
+    got = collect_closed(cb, cc)
+    st, fin = session.flush(st, force=True)
+    got += collect_closed(fin)
+    assert sorted(got) == [(1, 50_000, 50_000, 1), (1, 100_000, 100_000, 1)]
