@@ -169,8 +169,11 @@ def op_setup() -> None:
         "process.cores": 4,
     })
     log(f"wrote {CONF_FILE}")
-    rc = subprocess.run(["make", "-s"], cwd=os.path.join(
-        REPO_ROOT, "streambench_tpu", "native")).returncode
+    try:
+        rc = subprocess.run(["make", "-s"], cwd=os.path.join(
+            REPO_ROOT, "streambench_tpu", "native")).returncode
+    except FileNotFoundError:  # no make on this host
+        rc = 127
     log("native encoder ready" if rc == 0 else
         "native encoder build failed (python encoder will be used)")
 

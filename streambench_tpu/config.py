@@ -77,6 +77,11 @@ class BenchmarkConfig:
     jax_time_divisor_ms: int = 10_000      # window length (CampaignProcessorCommon.java:28)
     jax_flush_interval_ms: int = 1000      # flusher cadence (CampaignProcessorCommon.java:41-54)
     jax_allowed_lateness_ms: int = 60_000  # generator's max late-by (core.clj:170-173)
+    # Snapshot cadence: 0 = after every flush (the default; snapshots are
+    # ~10 KB so this is cheap and keeps the crash-replay window to a single
+    # flush).  >0 trades a longer at-least-once replay window for fewer
+    # writes.
+    jax_checkpoint_interval_ms: int = 0
     jax_mesh_shape: tuple[int, ...] = (1,)  # device mesh (batch axis first)
     jax_mesh_axes: tuple[str, ...] = ("data",)
     jax_use_native_encoder: bool = True    # C++ fast-path when the .so is built
@@ -162,6 +167,7 @@ class BenchmarkConfig:
             jax_time_divisor_ms=geti("jax.time.divisor.ms", 10_000),
             jax_flush_interval_ms=geti("jax.flush.interval.ms", 1000),
             jax_allowed_lateness_ms=geti("jax.allowed.lateness.ms", 60_000),
+            jax_checkpoint_interval_ms=geti("jax.checkpoint.interval.ms", 0),
             jax_mesh_shape=mesh_shape_t,
             jax_mesh_axes=tuple(_as_list(mesh_axes)) or ("data",),
             jax_use_native_encoder=getb("jax.use.native.encoder", True),

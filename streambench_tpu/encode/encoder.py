@@ -108,6 +108,12 @@ class EventEncoder:
     def num_campaigns(self) -> int:
         return len(self.campaigns)
 
+    def set_base_time(self, base_time_ms: int | None) -> None:
+        """Pin the rebase origin (checkpoint restore): window ids are
+        relative to ``base_time_ms``, so a restored engine must encode new
+        events against the *same* base or its ring slots would shift."""
+        self.base_time_ms = base_time_ms
+
     # -- interning helpers --------------------------------------------
     def _intern(self, table: dict[bytes, int], key: bytes) -> int:
         idx = table.get(key)

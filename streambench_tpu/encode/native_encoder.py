@@ -47,6 +47,11 @@ class NativeEventEncoder(EventEncoder):
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             len(ads_b), divisor_ms, lateness_ms)
 
+    def set_base_time(self, base_time_ms: int | None) -> None:
+        super().set_base_time(base_time_ms)
+        if base_time_ms is not None:
+            self._lib.sb_encoder_set_base_time(self._enc, base_time_ms)
+
     def __del__(self):  # pragma: no cover - interpreter teardown order
         lib = getattr(self, "_lib", None)
         enc = getattr(self, "_enc", None)

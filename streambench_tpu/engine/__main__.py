@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="drain the journal at full speed, then exit")
     p.add_argument("--sharded", action="store_true",
                    help="run the mesh-sharded engine (jax.mesh.* config)")
+    p.add_argument("--checkpointDir", default=None,
+                   help="enable (offset, state) snapshots here; on start, "
+                        "resume from the newest one if present")
     return p
 
 
@@ -101,7 +104,16 @@ def main(argv: list[str] | None = None) -> int:
 
     broker = FileBroker(args.brokerDir or os.path.join(args.workdir, "broker"))
     broker.create_topic(cfg.kafka_topic)
-    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+    checkpointer = None
+    if args.checkpointDir:
+        from streambench_tpu.checkpoint import Checkpointer
+
+        checkpointer = Checkpointer(args.checkpointDir)
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic),
+                          checkpointer=checkpointer)
+    if runner.resume():
+        print(f"resumed from checkpoint: offset={runner.reader.offset} "
+              f"events={engine.events_processed}", flush=True)
 
     signal.signal(signal.SIGTERM, lambda *_: runner.stop())
     signal.signal(signal.SIGINT, lambda *_: runner.stop())

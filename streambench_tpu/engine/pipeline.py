@@ -155,6 +155,71 @@ class AdAnalyticsEngine:
         return len(rows)
 
     # ------------------------------------------------------------------
+    # checkpoint/resume (SURVEY.md §5.4 — absent in the reference; the
+    # scan carry is fixed-shape arrays, so a snapshot is one savez)
+    def snapshot(self, offset: int) -> "Snapshot":
+        """Capture exact engine state as of journal byte ``offset``."""
+        from streambench_tpu.checkpoint import Snapshot
+
+        return Snapshot(
+            offset=offset,
+            meta=dict(
+                base_time_ms=self.encoder.base_time_ms,
+                divisor_ms=self.divisor,
+                lateness_ms=self.lateness,
+                window_slots=self.W,
+                span_start=self._span_start,
+                events_processed=self.events_processed,
+                windows_written=self.windows_written,
+                started_ms=self.started_ms,
+                last_event_ms=self.last_event_ms,
+                num_campaigns=self.encoder.num_campaigns,
+            ),
+            counts=np.asarray(self.state.counts),
+            window_ids=np.asarray(self.state.window_ids),
+            watermark=int(self.state.watermark),
+            dropped=int(self.state.dropped),
+            pending=[(c, ts, n) for (c, ts), n in self._pending.items()],
+            latency=sorted(self.window_latency.items()),
+        )
+
+    def restore(self, snap: "Snapshot") -> None:
+        """Reset this engine to a snapshot; caller re-tails the journal at
+        ``snap.offset``."""
+        for key, mine in (("num_campaigns", self.encoder.num_campaigns),
+                          ("divisor_ms", self.divisor),
+                          ("lateness_ms", self.lateness),
+                          ("window_slots", self.W)):
+            # Ring geometry must match exactly: window ids are relative to
+            # divisor and base, slots to W — reinterpreting either silently
+            # corrupts counts (the span guard would be sized for the wrong
+            # ring).
+            if int(snap.meta[key]) != mine:
+                raise ValueError(
+                    f"checkpoint {key}={snap.meta[key]} != engine {mine}; "
+                    "restart with the original config or discard the "
+                    "checkpoint")
+        self.encoder.set_base_time(snap.meta["base_time_ms"])
+        self.state = self._put_state(
+            snap.counts, snap.window_ids, snap.watermark, snap.dropped)
+        self._span_start = snap.meta["span_start"]
+        self.events_processed = int(snap.meta["events_processed"])
+        self.windows_written = int(snap.meta["windows_written"])
+        self.started_ms = int(snap.meta["started_ms"])
+        self.last_event_ms = int(snap.meta["last_event_ms"])
+        self._pending = defaultdict(int)
+        for c, ts, n in snap.pending:
+            self._pending[(int(c), int(ts))] = int(n)
+        self.window_latency = {int(ts): int(v) for ts, v in snap.latency}
+
+    def _put_state(self, counts, window_ids, watermark, dropped):
+        """Place restored host arrays on device (subclass hook: the sharded
+        engine re-applies its mesh shardings)."""
+        return wc.WindowState(
+            counts=jnp.asarray(counts), window_ids=jnp.asarray(window_ids),
+            watermark=jnp.int32(watermark), dropped=jnp.int32(dropped))
+
+    # ------------------------------------------------------------------
     def close(self) -> None:
         """Final flush + fork-style latency dump
         (``AdvertisingTopologyNative.java:521-532``)."""

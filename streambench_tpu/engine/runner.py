@@ -18,6 +18,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass
 
+from streambench_tpu.checkpoint import Checkpointer
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine
 from streambench_tpu.io.journal import JournalReader
 from streambench_tpu.utils.ids import now_ms
@@ -47,7 +48,9 @@ class StreamRunner:
     def __init__(self, engine: AdAnalyticsEngine, reader: JournalReader,
                  batch_size: int | None = None,
                  buffer_timeout_ms: int | None = None,
-                 flush_interval_ms: int | None = None):
+                 flush_interval_ms: int | None = None,
+                 checkpointer: Checkpointer | None = None,
+                 checkpoint_interval_ms: int | None = None):
         cfg = engine.cfg
         self.engine = engine
         self.reader = reader
@@ -58,11 +61,36 @@ class StreamRunner:
         self.flush_interval_ms = (flush_interval_ms
                                   if flush_interval_ms is not None
                                   else cfg.jax_flush_interval_ms)
+        self.checkpointer = checkpointer
+        self.checkpoint_interval_ms = (
+            checkpoint_interval_ms if checkpoint_interval_ms is not None
+            else cfg.jax_checkpoint_interval_ms)
+        self._last_ckpt = time.monotonic()
         self.stats = RunStats()
         self._stop = False
 
     def stop(self) -> None:
         self._stop = True
+
+    def resume(self) -> bool:
+        """Restore engine + reader from the newest checkpoint, if any.
+        Call before ``run``; returns True when a snapshot was applied."""
+        if self.checkpointer is None:
+            return False
+        snap = self.checkpointer.load()
+        if snap is None:
+            return False
+        self.engine.restore(snap)
+        self.reader.seek(snap.offset)
+        return True
+
+    def _checkpoint_now(self, now: float) -> None:
+        self.checkpointer.save(self.engine.snapshot(self.reader.offset))
+        self._last_ckpt = now
+
+    def _checkpoint_due(self, now: float) -> bool:
+        return (self.checkpointer is not None and
+                (now - self._last_ckpt) * 1000 >= self.checkpoint_interval_ms)
 
     def run(self, duration_s: float | None = None,
             idle_timeout_s: float | None = None,
@@ -75,6 +103,15 @@ class StreamRunner:
         last_data = time.monotonic()
         pending: list[bytes] = []
         pending_since: float | None = None
+
+        def dispatch() -> None:
+            nonlocal pending, pending_since, last_data
+            self.engine.process_lines(pending)
+            st.events += len(pending)
+            st.batches += 1
+            pending = []
+            pending_since = None
+            last_data = time.monotonic()  # processing isn't idleness
 
         while not self._stop:
             now = time.monotonic()
@@ -99,26 +136,29 @@ class StreamRunner:
             batch_old = (pending_since is not None and
                          (now - pending_since) * 1000 >= self.buffer_timeout_ms)
             if len(pending) >= self.batch_size or (pending and batch_old):
-                self.engine.process_lines(pending)
-                st.events += len(pending)
-                st.batches += 1
-                pending = []
-                pending_since = None
-                last_data = time.monotonic()  # processing isn't idleness
+                dispatch()
             elif not lines:
                 time.sleep(0.001)  # nothing due and nothing new: yield
 
             if (now - last_flush) * 1000 >= self.flush_interval_ms:
+                if self._checkpoint_due(now) and pending:
+                    # The reader offset already covers polled-but-unprocessed
+                    # lines; dispatch them first so the snapshot can't skip
+                    # them on resume (and the checkpoint cadence can't be
+                    # starved by a continuously non-empty buffer).
+                    dispatch()
                 st.windows_written += self.engine.flush()
                 st.flushes += 1
                 last_flush = now
+                if self._checkpoint_due(now):
+                    self._checkpoint_now(now)
 
         if pending:
-            self.engine.process_lines(pending)
-            st.events += len(pending)
-            st.batches += 1
+            dispatch()
         st.windows_written += self.engine.flush()
         st.flushes += 1
+        if self.checkpointer is not None:
+            self._checkpoint_now(time.monotonic())
         st.finished_ms = now_ms()
         return st
 
@@ -143,7 +183,11 @@ class StreamRunner:
                 st.windows_written += self.engine.flush()
                 st.flushes += 1
                 last_flush = now
+                if self._checkpoint_due(now):
+                    self._checkpoint_now(now)
         st.windows_written += self.engine.flush()
         st.flushes += 1
+        if self.checkpointer is not None:
+            self._checkpoint_now(time.monotonic())
         st.finished_ms = now_ms()
         return st

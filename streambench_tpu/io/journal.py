@@ -93,6 +93,18 @@ class JournalReader:
         self._fh = None
         self._readahead: deque[bytes] = deque()  # parsed but not delivered
 
+    def seek(self, offset: int) -> None:
+        """Reposition to an absolute byte offset (checkpoint restore).
+
+        Assigning ``offset`` directly is not enough once the reader has
+        polled: the open file handle and the read-ahead buffer both hold
+        the old position and would silently keep delivering from it.
+        """
+        self.offset = offset
+        self._readahead.clear()
+        if self._fh is not None:
+            self._fh.seek(offset)
+
     def _ensure_open(self) -> bool:
         if self._fh is None:
             if not os.path.exists(self.path):

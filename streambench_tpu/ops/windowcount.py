@@ -44,7 +44,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-NEG = jnp.int32(-2_000_000_000)  # "minus infinity" for int32 maxes
+# "minus infinity" for int32 maxes.  A plain Python int (weak-typed, stays
+# int32 next to int32 operands): a module-level jnp scalar would initialize
+# the JAX backend at import time, before a CLI entry point can pin the
+# platform (the image's sitecustomize force-selects the hardware plugin).
+NEG = -2_000_000_000
 
 
 class WindowState(NamedTuple):

@@ -171,6 +171,23 @@ class ShardedWindowEngine(AdAnalyticsEngine):
             jnp.asarray(self.encoder.join_table),
             NamedSharding(mesh, P()))
 
+    def _put_state(self, counts, window_ids, watermark, dropped):
+        """Checkpoint restore with mesh shardings re-applied; accepts
+        snapshots from an unsharded engine by re-padding the campaign axis."""
+        C = pad_campaigns(self.encoder.num_campaigns, self.mesh)
+        counts = np.asarray(counts)
+        if counts.shape[0] < C:
+            counts = np.pad(counts, ((0, C - counts.shape[0]), (0, 0)))
+        rep = NamedSharding(self.mesh, P())
+        return WindowState(
+            counts=jax.device_put(
+                jnp.asarray(counts),
+                NamedSharding(self.mesh, P(CAMPAIGN_AXIS, None))),
+            window_ids=jax.device_put(jnp.asarray(window_ids), rep),
+            watermark=jax.device_put(jnp.int32(watermark), rep),
+            dropped=jax.device_put(jnp.int32(dropped), rep),
+        )
+
     def _device_step(self, ad_idx, event_type, event_time, valid) -> None:
         self.state = sharded_step(
             self.mesh, self.state, self.join_table,

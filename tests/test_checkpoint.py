@@ -1,0 +1,154 @@
+"""Checkpoint/resume (SURVEY section 5.4): crash mid-stream, restore the
+newest snapshot, replay the journal tail — every window still CORRECT.
+
+The reference has no working checkpointing (Flink's enableCheckpointing is
+commented out, AdvertisingTopologyNative.java:81-84); its only resume story
+is Kafka offsets.  These tests pin the stronger guarantee the rebuild
+provides: snapshot = exact (offset, state) pair.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from streambench_tpu.checkpoint import Checkpointer, Snapshot
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+
+
+def setup_run(tmp_path, events=12_000, batch=512):
+    cfg = default_config(jax_batch_size=batch)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=events,
+                 rng=random.Random(7), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    return cfg, r, broker, mapping
+
+
+def test_crash_resume_matches_oracle(tmp_path):
+    """Process half, snapshot, *discard the engine* (the crash), build a
+    fresh engine + reader from the checkpoint, finish: oracle-exact."""
+    cfg, r, broker, mapping = setup_run(tmp_path)
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+
+    eng1 = AdAnalyticsEngine(cfg, mapping, redis=r)
+    reader1 = broker.reader(cfg.kafka_topic)
+    runner1 = StreamRunner(eng1, reader1, checkpointer=ckpt)
+    runner1.run_catchup(max_events=6000)
+    # run_catchup saved a final snapshot after its final flush
+    snap = ckpt.load()
+    assert snap is not None and snap.offset == reader1.offset
+    del eng1, runner1  # crash
+
+    eng2 = AdAnalyticsEngine(cfg, mapping, redis=r)
+    reader2 = broker.reader(cfg.kafka_topic)
+    runner2 = StreamRunner(eng2, reader2, checkpointer=ckpt)
+    assert runner2.resume()
+    assert reader2.offset == snap.offset
+    runner2.run_catchup()
+    eng2.close()
+
+    correct, differ, missing = gen.check_correct(r, str(tmp_path),
+                                                 log=lambda s: None)
+    assert differ == 0 and missing == 0 and correct > 0
+    assert eng2.events_processed == 12_000
+
+
+def test_snapshot_restore_roundtrip_exact(tmp_path):
+    """snapshot() -> restore() onto a fresh engine reproduces device state,
+    pending deltas, latency ledger, and encoder base bit-exactly."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=4000, batch=256)
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    reader = broker.reader(cfg.kafka_topic)
+    StreamRunner(eng, reader).run_catchup(max_events=2000)
+    # leave undrained device counts AND a pending buffer behind
+    eng._drain_device()
+    snap = eng.snapshot(reader.offset)
+
+    eng2 = AdAnalyticsEngine(cfg, mapping, redis=r)
+    eng2.restore(snap)
+    assert eng2.encoder.base_time_ms == eng.encoder.base_time_ms
+    np.testing.assert_array_equal(np.asarray(eng2.state.counts),
+                                  np.asarray(eng.state.counts))
+    np.testing.assert_array_equal(np.asarray(eng2.state.window_ids),
+                                  np.asarray(eng.state.window_ids))
+    assert int(eng2.state.watermark) == int(eng.state.watermark)
+    assert int(eng2.state.dropped) == int(eng.state.dropped)
+    assert dict(eng2._pending) == dict(eng._pending)
+    assert eng2.window_latency == eng.window_latency
+    assert eng2.events_processed == eng.events_processed
+
+
+def test_campaign_count_mismatch_rejected(tmp_path):
+    cfg, r, broker, mapping = setup_run(tmp_path, events=100, batch=64)
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    snap = eng.snapshot(0)
+    snap.meta["num_campaigns"] = 7
+    with pytest.raises(ValueError, match="num_campaigns"):
+        eng.restore(snap)
+
+
+def test_ring_geometry_mismatch_rejected(tmp_path):
+    """A snapshot taken under one (W, divisor, lateness) must not restore
+    into an engine with another: window ids/slots would be reinterpreted
+    and counts silently corrupted."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=100, batch=64)
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    for key in ("window_slots", "divisor_ms", "lateness_ms"):
+        snap = eng.snapshot(0)
+        snap.meta[key] += 1
+        with pytest.raises(ValueError, match=key):
+            eng.restore(snap)
+
+
+def test_reader_seek_clears_handle_and_readahead(tmp_path):
+    """resume() must physically reposition an already-polled reader: the
+    open file handle and the read-ahead buffer both hold the old spot."""
+    from streambench_tpu.io.journal import JournalReader, JournalWriter
+
+    path = str(tmp_path / "t.jsonl")
+    with JournalWriter(path) as w:
+        w.append_many([f"line{i}" for i in range(6)])
+    r = JournalReader(path)
+    assert r.poll(2) == [b"line0", b"line1"]  # rest lands in read-ahead
+    mid = r.offset
+    assert r.poll(2) == [b"line2", b"line3"]
+    r.seek(mid)
+    assert r.poll(100) == [b"line2", b"line3", b"line4", b"line5"]
+    assert r.offset == os.path.getsize(path)
+
+
+def test_checkpointer_rotation_and_torn_file(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ck"), keep=2)
+    mk = lambda off: Snapshot(
+        offset=off, meta=dict(base_time_ms=0, span_start=None,
+                              events_processed=off, windows_written=0,
+                              started_ms=0, last_event_ms=0,
+                              num_campaigns=3),
+        counts=np.zeros((3, 4), np.int32),
+        window_ids=np.full(4, -1, np.int32), watermark=0, dropped=0,
+        pending=[(1, 20_000, 5)], latency=[(20_000, 12)])
+    p1 = ck.save(mk(100))
+    p2 = ck.save(mk(200))
+    p3 = ck.save(mk(300))
+    import os
+    assert not os.path.exists(p1) and os.path.exists(p2)  # pruned to keep=2
+    # tear the newest file: load falls back to the previous snapshot
+    with open(p3, "wb") as f:
+        f.write(b"\x00" * 10)
+    snap = ck.load()
+    assert snap is not None and snap.offset == 200
+    assert snap.pending == [(1, 20_000, 5)]
+    assert snap.latency == [(20_000, 12)]
+
+    # a new Checkpointer in the same dir continues the sequence
+    ck2 = Checkpointer(str(tmp_path / "ck"), keep=2)
+    ck2.save(mk(400))
+    assert ck2.load().offset == 400

@@ -1,0 +1,42 @@
+"""Importing streambench_tpu must NOT initialize a JAX backend.
+
+CLI entry points (engine, harness) pin the platform *after* package import
+(the image's sitecustomize force-selects the hardware plugin via
+jax.config, so the pin must win).  Any module-level jnp/jax array op would
+initialize the backend first — on a machine where the hardware tunnel is
+busy, that turns `python -m streambench_tpu.engine` into a silent hang
+before main() ever runs.  Regression guard for exactly that bug.
+"""
+
+import os
+import pkgutil
+import subprocess
+import sys
+
+import streambench_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_import_initializes_no_backend():
+    mods = [m.name for m in pkgutil.walk_packages(
+        streambench_tpu.__path__, prefix="streambench_tpu.")
+        if not m.name.endswith("__main__")
+        and "libsbnative" not in m.name]  # raw .so, not a Python module
+    assert "streambench_tpu.ops.windowcount" in mods
+    code = (
+        "import importlib, jax\n"
+        f"mods = {mods!r}\n"
+        "for m in mods:\n"
+        "    importlib.import_module(m)\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge._backends, (\n"
+        "    f'package import initialized backends: '\n"
+        "    f'{list(xla_bridge._backends)}')\n"
+        "print('no backend init')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=120,
+                          env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no backend init" in proc.stdout
