@@ -57,6 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpointDir", default=None,
                    help="enable (offset, state) snapshots here; on start, "
                         "resume from the newest one if present")
+    p.add_argument("--traceDir", default=None,
+                   help="capture a jax.profiler device trace here")
     return p
 
 
@@ -137,13 +139,22 @@ def main(argv: list[str] | None = None) -> int:
     print(f"engine up: topic={cfg.kafka_topic} redis={cfg.redis_host}:"
           f"{cfg.redis_port} batch={engine.batch_size}", flush=True)
 
-    if args.catchup:
-        stats = runner.run_catchup(max_events=args.maxEvents)
-    else:
-        stats = runner.run(duration_s=args.duration,
-                           idle_timeout_s=args.idleTimeout,
-                           max_events=args.maxEvents)
+    from streambench_tpu.trace import device_trace
+
+    with device_trace(args.traceDir):
+        if args.catchup:
+            stats = runner.run_catchup(max_events=args.maxEvents)
+        else:
+            stats = runner.run(duration_s=args.duration,
+                               idle_timeout_s=args.idleTimeout,
+                               max_events=args.maxEvents)
     engine.close()
+    # stage spans + Apex-style decile report (SURVEY.md §5.1/§5.5)
+    print(engine.tracer.report(), file=sys.stderr, flush=True)
+    print(engine.latency_tracker.report(), file=sys.stderr, flush=True)
+    if runner.stall_detector.stalls:
+        print(f"flush stalls: {runner.stall_detector.stalls}",
+              file=sys.stderr, flush=True)
     print(json.dumps({
         "events": stats.events, "batches": stats.batches,
         "windows_written": stats.windows_written,

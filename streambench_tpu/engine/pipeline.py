@@ -30,7 +30,9 @@ from streambench_tpu.io.redis_schema import (
     dump_latency_hash,
     write_windows_pipelined,
 )
+from streambench_tpu.metrics import LatencyTracker
 from streambench_tpu.ops import windowcount as wc
+from streambench_tpu.trace import Tracer
 from streambench_tpu.utils.ids import now_ms
 
 
@@ -79,6 +81,9 @@ class AdAnalyticsEngine:
         self.last_event_ms = self.started_ms
         # fork-style latency accounting: abs_window_ts -> last time_updated
         self.window_latency: dict[int, int] = {}
+        # stage spans (SURVEY.md §5.1) + Apex-style decile accounting (§5.5)
+        self.tracer = Tracer()
+        self.latency_tracker = LatencyTracker(window_ms=self.divisor)
 
     # ------------------------------------------------------------------
     def process_lines(self, lines: list[bytes]) -> int:
@@ -87,7 +92,8 @@ class AdAnalyticsEngine:
             chunk = lines[off:off + self.batch_size]
             if not chunk:
                 break
-            batch = self._encode(chunk, self.batch_size)
+            with self.tracer.span("encode"):
+                batch = self._encode(chunk, self.batch_size)
             if batch.n == 0:
                 continue
             vt = batch.event_time[:batch.n]
@@ -98,11 +104,16 @@ class AdAnalyticsEngine:
             # Ring-reuse guard: drain device deltas BEFORE this batch if its
             # max would stretch the unflushed span past the safe limit.
             if batch_max - self._span_start > self._span_guard:
-                self._drain_device()
+                with self.tracer.span("drain"):
+                    self._drain_device()
                 self._span_start = batch_min
-            self._device_step(
-                jnp.asarray(batch.ad_idx), jnp.asarray(batch.event_type),
-                jnp.asarray(batch.event_time), jnp.asarray(batch.valid))
+            with self.tracer.span("device_step"):
+                # async dispatch: the span covers transfer + enqueue, not
+                # device completion (that overlaps the next encode — the
+                # pipeline-parallel analog, SURVEY.md §2)
+                self._device_step(
+                    jnp.asarray(batch.ad_idx), jnp.asarray(batch.event_type),
+                    jnp.asarray(batch.event_time), jnp.asarray(batch.valid))
             self.events_processed += batch.n
             self.last_event_ms = now_ms()
         return len(lines)
@@ -140,16 +151,19 @@ class AdAnalyticsEngine:
         defines latency truth as ``time_updated − window_ts``).  Returns
         window rows written.
         """
-        self._drain_device()
+        with self.tracer.span("drain"):
+            self._drain_device()
         if not self._pending:
             return 0
         stamp = now_ms() if time_updated is None else time_updated
         rows = [(self.encoder.campaigns[c], ts, n)
                 for (c, ts), n in self._pending.items()]
-        for _, ts, _ in rows:
+        for camp, ts, _ in rows:
             self.window_latency[ts] = stamp - ts
+            self.latency_tracker.record(camp, ts, stamp)
         if self.redis is not None:
-            write_windows_pipelined(self.redis, rows, time_updated=stamp)
+            with self.tracer.span("redis_flush"):
+                write_windows_pipelined(self.redis, rows, time_updated=stamp)
         self._pending.clear()
         self.windows_written += len(rows)
         return len(rows)

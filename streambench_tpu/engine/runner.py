@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from streambench_tpu.checkpoint import Checkpointer
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine
 from streambench_tpu.io.journal import JournalReader
+from streambench_tpu.metrics import StallDetector
 from streambench_tpu.utils.ids import now_ms
 
 
@@ -66,6 +67,10 @@ class StreamRunner:
             checkpoint_interval_ms if checkpoint_interval_ms is not None
             else cfg.jax_checkpoint_interval_ms)
         self._last_ckpt = time.monotonic()
+        # Backpressure canary: warn when the flush cadence slips to >2x its
+        # period (the Apex stall warning, ProcessTimeAwareStore.java:84-87).
+        self.stall_detector = StallDetector(
+            expected_period_ms=max(self.flush_interval_ms, 1))
         self.stats = RunStats()
         self._stop = False
 
@@ -149,6 +154,7 @@ class StreamRunner:
                     dispatch()
                 st.windows_written += self.engine.flush()
                 st.flushes += 1
+                self.stall_detector.tick(int(time.monotonic() * 1000))
                 last_flush = now
                 if self._checkpoint_due(now):
                     self._checkpoint_now(now)
@@ -182,6 +188,7 @@ class StreamRunner:
             if (now - last_flush) * 1000 >= self.flush_interval_ms:
                 st.windows_written += self.engine.flush()
                 st.flushes += 1
+                self.stall_detector.tick(int(time.monotonic() * 1000))
                 last_flush = now
                 if self._checkpoint_due(now):
                     self._checkpoint_now(now)

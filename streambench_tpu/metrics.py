@@ -1,0 +1,120 @@
+"""Latency accounting: decile report + stall detection.
+
+Re-expresses the reference's two in-repo observability idioms
+(SURVEY.md §5.5):
+
+- the Apex latency-aware store (``ProcessTimeAwareStore.java``): per
+  (key, bucket) last-update times recorded as aggregates land
+  (``updateUpdateTime``, ``:102-111``), then a final report of sorted
+  latencies ``update_time − bucket − window_len`` with the first
+  ``ignore_first`` and the trailing bucket dropped as incomplete
+  (``logFinalLatencies``, ``:115-146``) and a 10-group percentile table
+  (``outputGroupByCount``, ``:160-176``);
+- its backpressure stall warning: log when the gap between consecutive
+  end-of-window callbacks exceeds 2x the streaming window
+  (``:84-87``, 400 ms for the 200 ms window).
+
+Here the "store" is the engine's flush path, so ``LatencyTracker.record``
+is called once per window writeback and the report runs at close (or on
+demand).  Pure host-side bookkeeping: tiny dicts, no device work.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Callable
+
+logger = logging.getLogger("streambench.metrics")
+
+
+class LatencyTracker:
+    """Per-(key, bucket) last-update times -> final latency distribution."""
+
+    def __init__(self, window_ms: int = 10_000, ignore_first: int = 10):
+        self.window_ms = window_ms
+        self.ignore_first = ignore_first
+        # bucket(ms, window start) -> key -> last update time (ms)
+        self._updates: dict[int, dict[str, int]] = defaultdict(dict)
+
+    def record(self, key: str, bucket_ms: int, update_time_ms: int) -> None:
+        self._updates[bucket_ms][key] = update_time_ms
+
+    def final_latencies(self) -> list[int]:
+        """Sorted ``update − bucket − window_len`` over complete buckets.
+
+        The first ``ignore_first`` buckets (engine warm-up) and the last
+        bucket (still filling when the run stopped) are excluded, exactly
+        the reference's trimming (``ProcessTimeAwareStore.java:129-140``).
+        Returns [] when too few buckets survive the trim.
+        """
+        buckets = sorted(self._updates)
+        if len(buckets) <= self.ignore_first + 1:
+            return []
+        kept = buckets[self.ignore_first:-1]
+        out = [t - b - self.window_ms
+               for b in kept for t in self._updates[b].values()]
+        out.sort()
+        return out
+
+    def decile_table(self) -> list[tuple[str, int]]:
+        return decile_table(self.final_latencies())
+
+    def report(self) -> str:
+        lats = self.final_latencies()
+        if not lats:
+            return ("latency report: not enough complete windows "
+                    f"({len(self._updates)} buckets, need "
+                    f"> {self.ignore_first + 1})")
+        lines = [f"latency report over {len(lats)} samples "
+                 f"({len(self._updates)} buckets, first {self.ignore_first} "
+                 "+ last ignored):"]
+        lines += [f"  {rng}: {v} ms" for rng, v in decile_table(lats)]
+        return "\n".join(lines)
+
+
+def decile_table(latencies: list[int]) -> list[tuple[str, int]]:
+    """10 equal-count groups; each row is the group's upper-bound latency
+    (``outputGroupByCount``: row i = sorted[step*(i+1)], last = max)."""
+    if not latencies:
+        return []
+    groups = 10
+    n = len(latencies)
+    step = n // groups
+    rows: list[tuple[str, int]] = []
+    for i in range(groups - 1):
+        idx = min(step * (i + 1), n - 1)
+        rows.append((f"{i * 100 // groups} - {(i + 1) * 100 // groups}",
+                     int(latencies[idx])))
+    rows.append((f"{(groups - 1) * 100 // groups} - 100", int(latencies[-1])))
+    return rows
+
+
+class StallDetector:
+    """Warn when consecutive progress ticks are too far apart.
+
+    The reference warns on an end-window gap over 2x the streaming window
+    (``ProcessTimeAwareStore.java:84-87``).  ``tick()`` is called once per
+    flush; returns the gap in ms when it stalled, else None.
+    """
+
+    def __init__(self, expected_period_ms: int,
+                 factor: float = 2.0,
+                 warn: Callable[[str], None] | None = None):
+        self.threshold_ms = expected_period_ms * factor
+        self._warn = warn or logger.warning
+        self._last_ms: int | None = None
+        self.stalls = 0
+
+    def tick(self, now_ms: int) -> int | None:
+        gap = None
+        if self._last_ms is not None:
+            period = now_ms - self._last_ms
+            if period > self.threshold_ms:
+                gap = period
+                self.stalls += 1
+                self._warn(
+                    f"unexpected long flush period: {period} ms "
+                    f"(threshold {self.threshold_ms:.0f} ms)")
+        self._last_ms = now_ms
+        return gap

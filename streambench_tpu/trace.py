@@ -1,0 +1,102 @@
+"""Stamped-timestamp tracing for the host loop + device profiler hook.
+
+The reference has no dedicated tracer; profiling is ad-hoc stopwatch
+timestamps woven into the dataflow (SURVEY.md §5.1): ``DeserializeBolt``
+stamps arrival time into each tuple (``AdvertisingTopologyNative.java:264,
+273``), the windowed bolts capture per-window (receive, row->col, col->row)
+stamps (``:316-353``), and per-window wall time is printed
+(``:425-426``).  This module makes that idiom first-class: named
+monotonic-clock spans per pipeline stage, aggregated into per-stage
+totals/counts, cheap enough to leave on (two ``perf_counter_ns`` calls and
+a dict update per span).
+
+``device_trace`` wraps ``jax.profiler`` so a run can also capture an XLA
+trace (TensorBoard format) of the device side — the TPU analog of the
+reference's JVM GC logging (``META-INF/properties.xml:10-12``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class StageStats:
+    calls: int = 0
+    total_ns: int = 0
+    max_ns: int = 0
+
+    @property
+    def total_ms(self) -> float:
+        return self.total_ns / 1e6
+
+    @property
+    def mean_ms(self) -> float:
+        return self.total_ns / 1e6 / max(self.calls, 1)
+
+
+@dataclass
+class Tracer:
+    """Per-stage span accounting.  ``with tracer.span("encode"): ...``"""
+
+    stages: dict[str, StageStats] = field(default_factory=dict)
+    enabled: bool = True
+
+    @contextlib.contextmanager
+    def span(self, stage: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter_ns() - t0
+            st = self.stages.get(stage)
+            if st is None:
+                st = self.stages[stage] = StageStats()
+            st.calls += 1
+            st.total_ns += dt
+            st.max_ns = max(st.max_ns, dt)
+
+    def add(self, stage: str, duration_ns: int) -> None:
+        st = self.stages.get(stage)
+        if st is None:
+            st = self.stages[stage] = StageStats()
+        st.calls += 1
+        st.total_ns += duration_ns
+        st.max_ns = max(st.max_ns, duration_ns)
+
+    def report(self) -> str:
+        if not self.stages:
+            return "trace: no spans recorded"
+        width = max(len(s) for s in self.stages)
+        lines = ["trace (stage: calls total_ms mean_ms max_ms):"]
+        for name, st in sorted(self.stages.items(),
+                               key=lambda kv: -kv[1].total_ns):
+            lines.append(
+                f"  {name:<{width}}  {st.calls:>8}  {st.total_ms:>10.1f}  "
+                f"{st.mean_ms:>8.3f}  {st.max_ns / 1e6:>8.3f}")
+        return "\n".join(lines)
+
+    def as_dict(self) -> dict[str, dict[str, float]]:
+        return {name: {"calls": st.calls, "total_ms": st.total_ms,
+                       "mean_ms": st.mean_ms, "max_ms": st.max_ns / 1e6}
+                for name, st in self.stages.items()}
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str | None):
+    """Capture a ``jax.profiler`` trace under ``logdir`` (no-op if None)."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

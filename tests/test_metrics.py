@@ -1,0 +1,109 @@
+"""Latency decile reporter, stall detector, tracer, and the r2c/c2r
+host<->device handoff benchmark (SURVEY.md §5.1/§5.5: the Apex
+ProcessTimeAwareStore report and the fork's WindowedArrowFormatBolter /
+LatencyRecordBolter experiment, re-expressed for the TPU engine)."""
+
+import random
+
+from streambench_tpu import handoff
+from streambench_tpu.datagen import gen
+from streambench_tpu.encode.encoder import EventEncoder
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.metrics import LatencyTracker, StallDetector, decile_table
+from streambench_tpu.trace import Tracer
+
+
+def test_latency_tracker_trims_warmup_and_tail():
+    # 13 buckets; first 10 (warm-up) + last (incomplete) must be excluded,
+    # leaving buckets 10..11 (ProcessTimeAwareStore.java:129-140 semantics).
+    t = LatencyTracker(window_ms=10_000, ignore_first=10)
+    for b in range(13):
+        bucket = b * 10_000
+        # update lands (b+1 windows) late for key "a", +5 ms for key "b"
+        t.record("a", bucket, bucket + 10_000 + 100 * b)
+        t.record("b", bucket, bucket + 10_000 + 100 * b + 5)
+    lats = t.final_latencies()
+    # kept buckets: 10 and 11 -> latencies 1000,1005,1100,1105
+    assert lats == [1000, 1005, 1100, 1105]
+    report = t.report()
+    assert "4 samples" in report and "0 - 10" in report
+
+
+def test_latency_tracker_needs_enough_buckets():
+    t = LatencyTracker(ignore_first=10)
+    for b in range(11):
+        t.record("k", b * 10_000, b * 10_000 + 12_000)
+    assert t.final_latencies() == []
+    assert "not enough" in t.report()
+
+
+def test_decile_table_matches_reference_grouping():
+    # outputGroupByCount: row i = sorted[step*(i+1)], last row = max
+    lats = list(range(100))
+    rows = decile_table(lats)
+    assert len(rows) == 10
+    assert rows[0] == ("0 - 10", 10)
+    assert rows[8] == ("80 - 90", 90)
+    assert rows[9] == ("90 - 100", 99)
+    assert decile_table([]) == []
+    single = decile_table([7])  # fewer samples than groups: all rows = max
+    assert len(single) == 10 and all(v == 7 for _, v in single)
+
+
+def test_stall_detector_warns_on_gap():
+    warnings = []
+    sd = StallDetector(expected_period_ms=1000, warn=warnings.append)
+    assert sd.tick(10_000) is None          # first tick: no baseline
+    assert sd.tick(11_000) is None          # on cadence
+    assert sd.tick(14_000) == 3000          # 3 s gap > 2 s threshold
+    assert sd.stalls == 1 and "3000 ms" in warnings[0]
+
+
+def test_tracer_spans_and_report():
+    tr = Tracer()
+    for _ in range(3):
+        with tr.span("encode"):
+            pass
+    tr.add("device_step", 2_000_000)  # 2 ms
+    assert tr.stages["encode"].calls == 3
+    rep = tr.report()
+    assert "encode" in rep and "device_step" in rep
+    d = tr.as_dict()
+    assert d["device_step"]["total_ms"] == 2.0
+    tr.enabled = False
+    with tr.span("encode"):
+        pass
+    assert tr.stages["encode"].calls == 3  # disabled span not recorded
+
+
+def _make_windows(n_windows=3, batch=64):
+    rng = random.Random(9)
+    campaigns = gen.make_ids(10, rng)
+    ads = gen.make_ids(100, rng)
+    mapping = {a: campaigns[i % 10] for i, a in enumerate(ads)}
+    src = gen.EventSource(ads=ads, user_ids=gen.make_ids(5, rng),
+                          page_ids=gen.make_ids(5, rng), rng=rng)
+    base = 1_700_000_000_000
+    windows, starts = [], []
+    for w in range(n_windows):
+        ts = [base + w * 10_000 + i for i in range(batch)]
+        windows.append([e.encode() for e in src.events_at(ts)])
+        starts.append(base + w * 10_000)
+    return mapping, campaigns, windows, starts
+
+
+def test_handoff_roundtrip_and_redis_schema():
+    mapping, campaigns, windows, starts = _make_windows()
+    enc = EventEncoder(mapping, campaigns)
+    samples = handoff.run_handoff(enc, windows, starts)
+    assert len(samples) == 3
+    assert all(s.r2c_ms > 0 and s.c2r_ms > 0 for s in samples)
+    assert [s.window_start_ms for s in samples] == starts
+
+    r = as_redis(FakeRedisStore())
+    handoff.dump_handoff(r, "t1_handoff", samples)
+    got = handoff.read_handoff(r, "t1_handoff")
+    assert set(got) == set(starts)
+    w, r2c, c2r = got[starts[0]]
+    assert r2c > 0 and c2r > 0
