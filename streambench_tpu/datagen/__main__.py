@@ -86,6 +86,10 @@ def main(argv: list[str] | None = None) -> int:
                          num_campaigns=cfg.jax_num_campaigns,
                          ads_per_campaign=cfg.jax_ads_per_campaign,
                          workdir=args.workdir,
+                         # one broker partition per kafka.partition, so a
+                         # count-windowed consumer (map.partitions) can
+                         # align with the dataset (stream-bench.sh:107-115)
+                         partitions=max(cfg.kafka_partitions, 1),
                          progress=lambda k: print(k, flush=True)
                          if k % 1_000_000 == 0 else None)
         print(f"wrote {n} events")

@@ -177,6 +177,7 @@ def do_setup(r: RedisLike | None, cfg: BenchmarkConfig,
              rng: random.Random | None = None,
              workdir: str = ".",
              topic: str | None = None,
+             partitions: int = 1,
              progress: Callable[[int], None] | None = None) -> int:
     """``-s``: catchup-simulation setup (``do-setup`` + ``write-to-kafka``,
     ``core.clj:60-98,239-248``).
@@ -212,7 +213,12 @@ def do_setup(r: RedisLike | None, cfg: BenchmarkConfig,
     topic = topic or cfg.kafka_topic
     # Truncate the topic alongside the journal: -s defines a fresh dataset,
     # and oracle (kafka-json.txt) and topic must stay in lockstep.
-    sink = broker.writer(topic, append=False) if broker is not None else None
+    # One writer per topic partition, round-robin by event index — the
+    # broker peer of `create_kafka_topic --partitions $PARTITIONS`
+    # (stream-bench.sh:107-115); partition counts stay equal whenever
+    # n_events divides evenly, which count-windowed consumers rely on.
+    sinks = ([broker.writer(topic, p, append=False)
+              for p in range(partitions)] if broker is not None else [])
     written = 0
     with open(os.path.join(workdir, KAFKA_JSON_FILE), "w") as journal:
         batch = 100_000
@@ -220,12 +226,17 @@ def do_setup(r: RedisLike | None, cfg: BenchmarkConfig,
             hi = min(base + batch, n_events)
             lines = src.events_at(start + 10 * n for n in range(base, hi))
             journal.write("".join(l + "\n" for l in lines))
-            if sink is not None:
-                sink.append_many(lines)
+            if sinks:
+                if len(sinks) == 1:
+                    sinks[0].append_many(lines)
+                else:
+                    for p, sink in enumerate(sinks):
+                        off = (p - base) % len(sinks)
+                        sink.append_many(lines[off::len(sinks)])
             written = hi
             if progress:
                 progress(written)
-    if sink is not None:
+    for sink in sinks:
         sink.close()
     return written
 

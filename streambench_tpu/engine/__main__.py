@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "resume from the newest one if present")
     p.add_argument("--traceDir", default=None,
                    help="capture a jax.profiler device trace here")
+    p.add_argument("--microbatch", action="store_true",
+                   help="run the fork's count-based barrier-aligned window "
+                        "mode (window.size / map.partitions) over the "
+                        "broker topic, then exit")
     return p
 
 
@@ -91,6 +95,23 @@ def main(argv: list[str] | None = None) -> int:
         redis = as_redis(FakeRedisStore())
     else:
         redis = RespClient(cfg.redis_host, cfg.redis_port)
+
+    if args.microbatch:
+        from streambench_tpu.engine.microbatch import run_microbatch
+
+        broker = FileBroker(args.brokerDir
+                            or os.path.join(args.workdir, "broker"))
+        merged, results = run_microbatch(cfg, broker, mapping,
+                                         campaigns=campaigns, redis=redis)
+        lats = sorted(lat for r in results for lat in r.latency.values())
+        print(json.dumps({
+            "windows": len(merged),
+            "events": sum(r.events for r in results),
+            "partitions": len(results),
+            "total_views": int(sum(int(c.sum()) for c in merged.values())),
+            "p50_latency_ms": lats[len(lats) // 2] if lats else None,
+        }), flush=True)
+        return 0
 
     def make_engine(r) -> AdAnalyticsEngine:
         if args.sharded:

@@ -1,0 +1,323 @@
+"""Count-based micro-batch mode: the fork's barrier-aligned windows.
+
+The reference fork's research vehicle (``MockWindowedFlatMap``,
+``AdvertisingTopologyNative.java:167-254``) replaces event-time windows
+with *count-based* ones: each of ``map.partitions`` parallel mappers
+buffers ``window.size / map.partitions`` events, then all partitions
+rendezvous at a window barrier; the last partition to arrive becomes the
+owner and stamps the window's start time into Redis, the rest spin on
+``HGET start_time``.  Every event is tagged with that shared stamp, and the
+downstream processor records per-window latency ``now − start`` which it
+dumps to a Redis hash at job close (``CampaignProcessor``, ``:477-533``).
+
+This module re-expresses that design TPU-first:
+
+- the per-window work (filter "view" -> join -> per-campaign count) is one
+  jitted segment-sum over the whole window — a micro-batch IS a window, so
+  the keyed shuffle collapses to a single ``[C]`` count vector per
+  partition, merged across partitions by addition (the host analog of the
+  ``psum`` merge; the network shuffle never happens);
+- in-process partitions align on a ``threading.Barrier`` whose action
+  stamps the window (``LocalWindowBarrier``) — the device-step-alignment
+  analog; distributed processes use ``RedisWindowBarrier``, the fork's
+  protocol with one fix: the fork HDELs a *shared* ``start_time`` field on
+  window entry, which lets a late-arriving partition delete the stamp the
+  owner just wrote (a real race in the reference, SURVEY.md §5.2); here
+  stamps are per-window-index fields ``start_time:<k>``, so nothing is
+  ever deleted while being waited on;
+- the latency dump keeps the fork's exact hash schema
+  (``redis.hashtable``: ``thread_idx``, ``running_time:<i>``,
+  ``<windowStart>:<i>`` -> latency) via ``dump_latency_hash``.
+
+Unlike the fork (where every parallel source re-reads the *same* events
+file, ``FileBasedDataSource`` x ``map.partitions``), partitions here each
+consume their own broker partition — real data parallelism.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from streambench_tpu.config import BenchmarkConfig
+from streambench_tpu.encode.native_encoder import make_encoder
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import RedisLike, dump_latency_hash
+from streambench_tpu.utils.ids import now_ms
+
+
+# ----------------------------------------------------------------------
+# window barriers
+# ----------------------------------------------------------------------
+
+class LocalWindowBarrier:
+    """In-process rendezvous: the barrier action stamps the window start.
+
+    The action runs exactly once per generation before any waiter is
+    released, so every partition reads the same stamp — the same role the
+    fork's "last HINCRBY arrival" owner plays.
+    """
+
+    def __init__(self, n_partitions: int, timeout_s: float = 60.0):
+        self._stamps: dict[int, int] = {}
+        self._timeout = timeout_s
+        self.ended = False  # abort() was an end-of-stream, not a timeout
+        self._barrier = threading.Barrier(n_partitions, action=self._stamp)
+
+    def _stamp(self) -> None:
+        # generations are sequential: all partitions are at window k here
+        self._stamps[len(self._stamps)] = now_ms()
+
+    def arrive(self, window_idx: int) -> int:
+        try:
+            self._barrier.wait(self._timeout)
+        except threading.BrokenBarrierError:
+            if self.ended:
+                raise  # normal end-of-stream release (drive() swallows it)
+            # Barrier.wait's own timeout also breaks the barrier; surface
+            # it as the error it is instead of a silent partial result.
+            raise TimeoutError(
+                f"window barrier {window_idx}: a partition failed to "
+                f"arrive within {self._timeout}s") from None
+        return self._stamps[window_idx]
+
+    def abort(self) -> None:
+        """End the run: once any partition hits end-of-stream no further
+        window can ever assemble (the barrier needs all parties), so
+        waiting peers are released with ``BrokenBarrierError`` and their
+        in-flight window is dropped — consistent with the no-partial-
+        windows rule."""
+        self.ended = True
+        self._barrier.abort()
+
+
+class RedisWindowBarrier:
+    """The fork's Redis barrier, with per-window stamp keys (see module
+    docstring for the delete-race fix).  Protocol per window ``k``:
+
+    - ``HINCRBY <table> partition_count 1``; the arrival that brings the
+      count to ``n_partitions`` resets it to 0 and becomes the owner
+      (``start_new_window``, ``AdvertisingTopologyNative.java:228-238``);
+    - owner: ``HSET <table> start_time:<k> now`` (``finish_window``);
+    - others: 1 ms-sleep spin on ``HGET start_time:<k>`` (``wait_window``).
+    """
+
+    def __init__(self, redis: RedisLike, hashtable: str, n_partitions: int,
+                 poll_interval_s: float = 0.001, timeout_s: float = 60.0):
+        self.redis = redis
+        self.table = hashtable
+        self.n = n_partitions
+        self._poll = poll_interval_s
+        self._timeout = timeout_s
+
+    def arrive(self, window_idx: int) -> int:
+        my = int(self.redis.execute("HINCRBY", self.table,
+                                    "partition_count", 1))
+        field_ = f"start_time:{window_idx}"
+        if my == self.n:
+            self.redis.execute("HSET", self.table, "partition_count", "0")
+            stamp = now_ms()
+            self.redis.execute("HSET", self.table, field_, str(stamp))
+            return stamp
+        deadline = time.monotonic() + self._timeout
+        while True:
+            res = self.redis.execute("HGET", self.table, field_)
+            if res is not None:
+                return int(res)
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"window barrier {window_idx}: no stamp after "
+                    f"{self._timeout}s (partition died?)")
+            time.sleep(self._poll)
+
+
+# ----------------------------------------------------------------------
+# the per-window device kernel
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_campaigns", "view_type"))
+def window_campaign_counts(join_table, ad_idx, event_type, valid,
+                           *, num_campaigns: int, view_type: int = 0):
+    """One micro-batch window -> per-campaign view counts ``[C]``.
+
+    The whole fork chain (EventFilterBolt -> project -> RedisJoinBolt ->
+    keyBy(campaign) -> count) as a single masked segment-sum: the keyed
+    shuffle is just a scatter-add index.
+    """
+    campaign = join_table[ad_idx]
+    mask = valid & (event_type == view_type) & (campaign >= 0)
+    idx = jnp.where(mask, campaign, num_campaigns)  # OOB rows dropped
+    return jnp.zeros((num_campaigns,), jnp.int32).at[idx].add(1, mode="drop")
+
+
+# ----------------------------------------------------------------------
+# per-partition mapper + multi-partition driver
+# ----------------------------------------------------------------------
+
+@dataclass
+class PartitionResult:
+    partition: int
+    windows: int = 0
+    events: int = 0
+    started_ms: int = 0    # first window start stamp
+    finished_ms: int = 0   # completion time of the last window
+    # window index -> per-campaign counts [C].  Indexed by window ordinal,
+    # not stamp: in catchup runs consecutive windows can share a
+    # millisecond and stamp-keyed state would silently merge them (the
+    # fork has exactly this hazard — its latency map is stamp-keyed).
+    counts: dict[int, np.ndarray] = field(default_factory=dict)
+    # window index -> barrier stamp (shared across partitions)
+    stamps: dict[int, int] = field(default_factory=dict)
+    # window start stamp -> last observed latency (now - start), fork style
+    latency: dict[int, int] = field(default_factory=dict)
+
+    @property
+    def running_time_ms(self) -> int:
+        return max(self.finished_ms - self.started_ms, 0)
+
+
+class MicroBatchMapper:
+    """One map partition: buffer ``partition_size`` lines, rendezvous,
+    fold the window on device, record latency."""
+
+    def __init__(self, cfg: BenchmarkConfig, encoder, join_table_dev,
+                 barrier, partition: int, input_format: str = "json"):
+        if cfg.window_size % cfg.map_partitions:
+            raise ValueError(
+                f"window.size {cfg.window_size} not divisible by "
+                f"map.partitions {cfg.map_partitions}")
+        self.partition_size = cfg.window_size // cfg.map_partitions
+        self.encoder = encoder
+        self.join_table_dev = join_table_dev
+        self.barrier = barrier
+        # "json" for generator journals; "tbl" for the fork's pipe-separated
+        # events files (AdvertisingTopologyNative.java:210: "u|p|ad|...")
+        self._encode = (encoder.encode if input_format == "json"
+                        else encoder.encode_tbl)
+        self.result = PartitionResult(partition)
+        self._buf: list[bytes] = []
+        self._window_idx = 0
+
+    def feed(self, lines: list[bytes]) -> None:
+        for line in lines:
+            self._buf.append(line)
+            if len(self._buf) == self.partition_size:
+                self._close_window()
+
+    def _close_window(self) -> None:
+        start = self.barrier.arrive(self._window_idx)
+        batch = self._encode(self._buf, self.partition_size)
+        counts = np.asarray(window_campaign_counts(
+            self.join_table_dev, batch.ad_idx, batch.event_type,
+            batch.valid, num_campaigns=self.encoder.num_campaigns))
+        r = self.result
+        r.counts[self._window_idx] = counts
+        r.stamps[self._window_idx] = start
+        done = now_ms()
+        r.latency[start] = done - start
+        if not r.started_ms:
+            r.started_ms = start
+        r.finished_ms = done
+        r.windows += 1
+        r.events += len(self._buf)
+        self._buf.clear()
+        self._window_idx += 1
+
+    @property
+    def leftover(self) -> int:
+        """Events short of a full window at end of stream (the fork simply
+        never emits a partial window; neither do we)."""
+        return len(self._buf)
+
+
+def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
+                   ad_to_campaign: dict[str, str],
+                   campaigns: list[str] | None = None,
+                   redis: RedisLike | None = None,
+                   barrier=None,
+                   max_windows: int | None = None,
+                   input_format: str = "json"
+                   ) -> tuple[dict[int, np.ndarray], list[PartitionResult]]:
+    """Drive ``map.partitions`` mapper threads over the broker topic.
+
+    Returns ``(merged, results)``: merged per-campaign counts keyed by
+    window ordinal (partition partials summed — the unifier /
+    ``reduce.partitions`` role, the host analog of the psum merge) and
+    the per-partition results.
+    When ``redis`` is given, each partition dumps its latency map in the
+    fork's hash format at close.
+    """
+    P = cfg.map_partitions
+    have = set(broker.partitions(cfg.kafka_topic))
+    missing = [p for p in range(P) if p not in have]
+    if missing:
+        raise ValueError(
+            f"map.partitions={P} but broker topic '{cfg.kafka_topic}' has "
+            f"no partition(s) {missing} (found {sorted(have)}); generate "
+            f"the dataset with a matching partition count")
+    barrier = barrier or LocalWindowBarrier(P)
+    encoder = make_encoder(ad_to_campaign, campaigns,
+                           divisor_ms=cfg.jax_time_divisor_ms,
+                           lateness_ms=cfg.jax_allowed_lateness_ms,
+                           use_native=cfg.jax_use_native_encoder)
+    # one replicated device copy of the join table, shared by all mappers
+    join_table_dev = jnp.asarray(encoder.join_table)
+    mappers = [MicroBatchMapper(cfg, encoder, join_table_dev, barrier, p,
+                                input_format=input_format)
+               for p in range(P)]
+    limit = max_windows * mappers[0].partition_size if max_windows else None
+    errors: list[BaseException] = []
+
+    def drive(p: int) -> None:
+        try:
+            with broker.reader(cfg.kafka_topic, p) as reader:
+                fed = 0
+                while True:
+                    want = (min(4096, limit - fed)
+                            if limit is not None else 4096)
+                    if want <= 0:
+                        break
+                    lines = reader.poll(max_records=want)
+                    if not lines:
+                        break
+                    mappers[p].feed(lines)
+                    fed += len(lines)
+            # end-of-stream: no further window can assemble without this
+            # partition; release any peers parked at the rendezvous
+            if isinstance(barrier, LocalWindowBarrier):
+                barrier.abort()
+        except threading.BrokenBarrierError:
+            pass  # a peer hit end-of-stream; our partial window is dropped
+        except BaseException as e:  # surface thread failures to the caller
+            errors.append(e)
+            if isinstance(barrier, LocalWindowBarrier):
+                barrier.abort()
+
+    threads = [threading.Thread(target=drive, args=(p,), daemon=True)
+               for p in range(P)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+    merged: dict[int, np.ndarray] = {}
+    for m in mappers:
+        for k, counts in m.result.counts.items():
+            if k in merged:
+                merged[k] = merged[k] + counts
+            else:
+                merged[k] = counts
+
+    if redis is not None and cfg.redis_hashtable:
+        for m in mappers:
+            dump_latency_hash(redis, cfg.redis_hashtable, m.result.latency,
+                              running_time_ms=m.result.running_time_ms)
+    return merged, [m.result for m in mappers]

@@ -1,0 +1,174 @@
+"""Count-based micro-batch mode (the fork's barrier-aligned windows,
+``AdvertisingTopologyNative.java:167-254``): golden-model window counts,
+barrier agreement across partitions, fork-format latency dump, and
+end-of-stream behavior with unequal partitions."""
+
+import json
+import random
+import threading
+
+import numpy as np
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine.microbatch import (
+    LocalWindowBarrier,
+    RedisWindowBarrier,
+    run_microbatch,
+)
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis, read_latency_hash
+
+
+def setup(tmp_path, events=1800, partitions=3, window_size=300):
+    cfg = default_config(window_size=window_size, map_partitions=partitions)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(None, cfg, broker=broker, events_num=events,
+                 rng=random.Random(21), workdir=str(tmp_path),
+                 partitions=partitions)
+    mapping = gen.load_ad_mapping_file(str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    campaigns, _ = gen.load_ids(str(tmp_path))
+    return cfg, broker, mapping, campaigns
+
+
+def golden_windows(broker, cfg, mapping, campaigns):
+    """Recompute expected per-(window, campaign) view counts from the
+    partition journals — the count-window analog of dostats."""
+    P = cfg.map_partitions
+    psize = cfg.window_size // P
+    cidx = {c: i for i, c in enumerate(campaigns)}
+    per_part = []
+    for p in range(P):
+        with broker.reader(cfg.kafka_topic, p) as r:
+            lines = []
+            while True:
+                got = r.poll()
+                if not got:
+                    break
+                lines.extend(got)
+        per_part.append(lines)
+    n_windows = min(len(l) // psize for l in per_part)
+    out = []
+    for k in range(n_windows):
+        counts = np.zeros(len(campaigns), np.int64)
+        for p in range(P):
+            for line in per_part[p][k * psize:(k + 1) * psize]:
+                ev = json.loads(line)
+                if ev["event_type"] == "view":
+                    counts[cidx[mapping[ev["ad_id"]]]] += 1
+        out.append(counts)
+    return out
+
+
+def test_microbatch_matches_golden_model(tmp_path):
+    cfg, broker, mapping, campaigns = setup(tmp_path)
+    merged, results = run_microbatch(cfg, broker, mapping, campaigns)
+    expected = golden_windows(broker, cfg, mapping, campaigns)
+
+    assert len(merged) == len(expected) == 6  # 1800 / 300
+    got = [merged[k] for k in sorted(merged)]
+    for g, e in zip(got, expected):
+        np.testing.assert_array_equal(g.astype(np.int64), e)
+    # every partition saw every window with the same stamps
+    stamps = [r.stamps for r in results]
+    assert stamps[0] == stamps[1] == stamps[2]
+    assert all(r.windows == 6 and r.events == 600 for r in results)
+    assert all(lat >= 0 for r in results for lat in r.latency.values())
+
+
+def test_redis_barrier_agrees_and_is_delete_race_free(tmp_path):
+    cfg, broker, mapping, campaigns = setup(tmp_path, events=900,
+                                            window_size=300)
+    r = as_redis(FakeRedisStore())
+    barrier = RedisWindowBarrier(r, "barrier_tbl", cfg.map_partitions)
+    merged, results = run_microbatch(cfg, broker, mapping, campaigns,
+                                     barrier=barrier)
+    assert len(merged) == 3
+    stamps = [res.stamps for res in results]
+    assert stamps[0] == stamps[1] == stamps[2]
+    # per-window stamp fields persist (nothing HDEL'd mid-wait) and the
+    # counter wrapped back to 0 after each full rendezvous
+    for k in range(3):
+        assert r.hget("barrier_tbl", f"start_time:{k}") is not None
+    assert r.hget("barrier_tbl", "partition_count") == "0"
+
+
+def test_latency_dump_uses_fork_hash_schema(tmp_path):
+    cfg, broker, mapping, campaigns = setup(tmp_path, events=900,
+                                            window_size=300)
+    r = as_redis(FakeRedisStore())
+    merged, results = run_microbatch(cfg, broker, mapping, campaigns,
+                                     redis=r)
+    running, per_idx = read_latency_hash(r, cfg.redis_hashtable)
+    # one dump per partition: thread_idx 1..3
+    assert set(per_idx) == {1, 2, 3}
+    for idx in per_idx:
+        # one latency per window, except when consecutive windows share a
+        # millisecond stamp (fork-format latency maps are stamp-keyed)
+        assert 1 <= len(per_idx[idx]) <= 3
+        assert running[idx] >= 0
+
+
+def test_unequal_partitions_end_without_deadlock(tmp_path):
+    """One partition runs dry a window early: peers must be released (the
+    rendezvous can never complete again) and the extra window dropped."""
+    cfg, broker, mapping, campaigns = setup(tmp_path, events=1800,
+                                            window_size=300)
+    # truncate partition 2 to one window's worth of lines
+    path = broker.topic_path(cfg.kafka_topic, 2)
+    lines = open(path, "rb").read().splitlines()[:100]
+    with open(path, "wb") as f:
+        f.write(b"".join(l + b"\n" for l in lines))
+
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(
+            run_microbatch(cfg, broker, mapping, campaigns)),
+        daemon=True)
+    t.start()
+    t.join(30)
+    assert not t.is_alive(), "microbatch run deadlocked on unequal partitions"
+    merged, results = done[0]
+    assert len(merged) == 1  # only the first window assembled everywhere
+    assert results[2].windows == 1
+
+
+def test_missing_partition_is_an_error_not_empty_result(tmp_path):
+    """map.partitions > generated partitions must fail loudly, not return
+    {'windows': 0} silently."""
+    import pytest
+
+    cfg, broker, mapping, campaigns = setup(tmp_path, events=300,
+                                            partitions=1, window_size=300)
+    cfg = default_config(window_size=300, map_partitions=3)
+    with pytest.raises(ValueError, match="no partition"):
+        run_microbatch(cfg, broker, mapping, campaigns)
+
+
+def test_barrier_timeout_is_an_error_not_eos():
+    """A mid-stream barrier timeout must surface, not masquerade as
+    end-of-stream."""
+    import pytest
+
+    b = LocalWindowBarrier(2, timeout_s=0.05)
+    with pytest.raises(TimeoutError, match="failed to arrive"):
+        b.arrive(0)  # the second partition never shows up
+
+
+def test_local_barrier_stamps_shared():
+    b = LocalWindowBarrier(4)
+    out = [[] for _ in range(4)]
+
+    def worker(i):
+        for k in range(5):
+            out[i].append(b.arrive(k))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for k in range(5):
+        assert len({out[i][k] for i in range(4)}) == 1  # same stamp
+    assert out[0] == sorted(out[0])  # stamps never go backwards
