@@ -1,0 +1,28 @@
+"""Dimensional aggregation family (the Apex dimension-computation peer).
+
+Re-expresses components #19-#23 of SURVEY.md §2 TPU-first: a declarative
+dimensional schema (``eventSchema.json`` shape), a jitted multi-aggregate
+window kernel (SUM/MAX/MIN/COUNT per key per time bucket), a durable
+append-log store with the latency-aware decile report, and a JSON-lines
+pub/sub query channel (the WebSocket gateway analog).
+"""
+
+from streambench_tpu.dimensions.app import (  # noqa: F401
+    SENTINEL_CAMPAIGN,
+    DimensionApp,
+)
+from streambench_tpu.dimensions.compute import (  # noqa: F401
+    DimensionState,
+    DimensionsComputation,
+    KeyInterner,
+)
+from streambench_tpu.dimensions.pubsub import (  # noqa: F401
+    PubSubClient,
+    PubSubServer,
+)
+from streambench_tpu.dimensions.schema import (  # noqa: F401
+    AGGREGATORS,
+    DimensionalSchema,
+    parse_schema,
+)
+from streambench_tpu.dimensions.store import DurableDimensionStore  # noqa: F401

@@ -1,0 +1,246 @@
+"""The dimension-computation kernel: schema-driven keyed window aggregates.
+
+The Apex reference computes its dimensional cube with a reflective POJO
+aggregator (``DimensionsComputationFlexibleSingleSchemaPOJO``, keys
+campaignId+time, aggregates SUM(clicks)/MAX(latency),
+``ApplicationDimensionComputation.java:96-116``) partitioned by campaign
+hash with a unifier merge (``:120,152-199``).  Here the cube is dense
+arrays: each (value, aggregator) pair of the schema is one ``[K, W]``
+int32 array over (key index, window-ring slot), and a batch folds in as a
+masked scatter (add / max / min / count) — the keyed shuffle is an index,
+the unifier is elementwise add/max/min, which also makes multi-device
+merges psum/pmax-shaped for free.
+
+Ring/watermark semantics are shared with the exact-count engine
+(``ops.windowcount.assign_windows``): buckets close when the event-time
+watermark passes their end plus allowed lateness; closed buckets are
+emitted with their **final** aggregate values (the HDHT store holds final
+aggregates per bucket, not deltas) and their slots reset to the
+aggregator's identity.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from streambench_tpu.dimensions.schema import AGGREGATORS, DimensionalSchema
+from streambench_tpu.ops.windowcount import assign_windows
+
+# int32 identities (the schema layer's int64 identities clamp to int32)
+_IDENT32 = {"add": 0, "count": 0, "max": -(2**31) + 1, "min": 2**31 - 1}
+
+
+@dataclass
+class DimensionState:
+    """Device state for ONE key combination."""
+
+    aggs: tuple[jax.Array, ...]   # one [K, W] int32 per (value, aggregator)
+    presence: jax.Array           # [K, W] int32 events aggregated per cell
+    window_ids: jax.Array         # [W] int32, -1 = free slot
+    watermark: jax.Array          # [] int32 (relative ms)
+    dropped: jax.Array            # [] int32
+
+
+class KeyInterner:
+    """Host-side key -> dense index with fixed device capacity.
+
+    The synthetic generator defaults to 1M campaigns
+    (``DimensionTupleGenerateOperator.java:16``); capacity is explicit so
+    device arrays stay statically shaped.  Overflow keys map to -1; the
+    kernel counts such rows in ``dropped`` (valid events the fixed
+    key space lost)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.index: dict[str, int] = {}
+        self.overflow = 0
+
+    def intern_many(self, keys: list[str]) -> np.ndarray:
+        out = np.empty(len(keys), np.int32)
+        idx = self.index
+        for i, k in enumerate(keys):
+            v = idx.get(k)
+            if v is None:
+                if len(idx) >= self.capacity:
+                    self.overflow += 1
+                    v = -1
+                else:
+                    v = len(idx)
+                    idx[k] = v
+            out[i] = v
+        return out
+
+    def names(self) -> list[str]:
+        return list(self.index)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kinds", "divisor_ms", "lateness_ms"))
+def _fold(aggs, presence, window_ids, watermark, dropped,
+          key_idx, event_time, valid, value_cols,
+          *, kinds: tuple[str, ...], divisor_ms: int, lateness_ms: int):
+    K, W = aggs[0].shape
+    wid = event_time // divisor_ms
+    wanted = valid & (key_idx >= 0)
+
+    slot, mask, new_ids, new_wm = assign_windows(
+        window_ids, watermark, wid, wanted, valid, event_time,
+        divisor_ms=divisor_ms, lateness_ms=lateness_ms)
+
+    flat = jnp.where(mask, key_idx * W + slot, K * W)  # OOB rows drop
+    # exact participation counter: flush emits a (key, bucket) row iff
+    # presence > 0, so identity-valued aggregates (a SUM of zeros) are
+    # still reported
+    new_presence = (presence.reshape(-1)
+                    .at[flat].add(1, mode="drop").reshape(K, W))
+    new_aggs = []
+    for arr, col, kind in zip(aggs, value_cols, kinds):
+        flatarr = arr.reshape(-1)
+        if kind == "add":
+            upd = flatarr.at[flat].add(jnp.where(mask, col, 0), mode="drop")
+        elif kind == "count":
+            upd = flatarr.at[flat].add(
+                jnp.where(mask, 1, 0).astype(jnp.int32), mode="drop")
+        elif kind == "max":
+            upd = flatarr.at[flat].max(
+                jnp.where(mask, col, _IDENT32["max"]), mode="drop")
+        elif kind == "min":
+            upd = flatarr.at[flat].min(
+                jnp.where(mask, col, _IDENT32["min"]), mode="drop")
+        else:
+            raise ValueError(f"unknown aggregator kind {kind!r}")
+        new_aggs.append(upd.reshape(K, W))
+
+    # lost events: ring/lateness casualties + valid rows whose key fell
+    # outside the fixed key space (KeyInterner overflow maps them to -1)
+    new_dropped = dropped + (jnp.sum(wanted.astype(jnp.int32))
+                             - jnp.sum(mask.astype(jnp.int32))
+                             + jnp.sum((valid & (key_idx < 0))
+                                       .astype(jnp.int32)))
+    return tuple(new_aggs), new_presence, new_ids, new_wm, new_dropped
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("kinds", "divisor_ms", "lateness_ms", "drain"))
+def _flush_closed(aggs, presence, window_ids, watermark,
+                  *, kinds: tuple[str, ...], divisor_ms: int,
+                  lateness_ms: int, drain: bool = False):
+    if drain:  # job close: every occupied slot is final now
+        closed = window_ids >= 0
+    else:
+        closed = ((window_ids >= 0) &
+                  ((window_ids + 1) * divisor_ms + lateness_ms <= watermark))
+    new_ids = jnp.where(closed, jnp.int32(-1), window_ids)
+    new_presence = jnp.where(closed[None, :], jnp.int32(0), presence)
+    new_aggs = []
+    for arr, kind in zip(aggs, kinds):
+        ident = jnp.int32(_IDENT32[kind])
+        new_aggs.append(jnp.where(closed[None, :], ident, arr))
+    return closed, tuple(new_aggs), new_presence, new_ids
+
+
+class DimensionsComputation:
+    """Schema-driven aggregation over one key combination."""
+
+    def __init__(self, schema: DimensionalSchema, num_keys: int,
+                 window_slots: int = 16, lateness_ms: int = 60_000,
+                 combination: tuple[str, ...] | None = None):
+        schema.validate()
+        self.schema = schema
+        self.combination = combination or schema.combinations[0]
+        self.divisor_ms = schema.time_bucket_ms
+        self.lateness_ms = lateness_ms
+        self.K = num_keys
+        self.W = window_slots
+        self.slots = schema.aggregate_slots()   # [(value, agg)]
+        self.kinds = tuple(AGGREGATORS[a][0] for _, a in self.slots)
+        # value column order the kernel expects (one per slot; a value
+        # aggregated two ways is passed twice — XLA dedups the operand)
+        self.value_order = [v for v, _ in self.slots]
+
+    def init_state(self) -> DimensionState:
+        return DimensionState(
+            aggs=tuple(jnp.full((self.K, self.W),
+                                _IDENT32[k], jnp.int32)
+                       for k in self.kinds),
+            presence=jnp.zeros((self.K, self.W), jnp.int32),
+            window_ids=jnp.full((self.W,), -1, jnp.int32),
+            watermark=jnp.int32(0),
+            dropped=jnp.int32(0),
+        )
+
+    def step(self, state: DimensionState, key_idx, event_time, valid,
+             values: dict[str, np.ndarray]) -> DimensionState:
+        """Fold one batch.  ``values`` maps value-field name -> [B] int32
+        column (relative ms for time-like fields)."""
+        cols = tuple(jnp.asarray(values[name]) for name in self.value_order)
+        aggs, presence, ids, wm, dropped = _fold(
+            state.aggs, state.presence, state.window_ids, state.watermark,
+            state.dropped, jnp.asarray(key_idx), jnp.asarray(event_time),
+            jnp.asarray(valid), cols,
+            kinds=self.kinds, divisor_ms=self.divisor_ms,
+            lateness_ms=self.lateness_ms)
+        return DimensionState(aggs, presence, ids, wm, dropped)
+
+    def flush_closed(self, state: DimensionState, drain: bool = False
+                     ) -> tuple[list[tuple[int, int, dict[str, int]]],
+                                DimensionState]:
+        """Emit final aggregates of closed buckets and free their slots.
+
+        Returns ``(rows, new_state)`` where each row is
+        ``(key_index, window_id, {"<value>:<AGG>": final})`` for every key
+        that actually aggregated something in that bucket.  ``drain=True``
+        (job close) emits every occupied slot, open or not.
+        """
+        closed, new_aggs, new_presence, new_ids = _flush_closed(
+            state.aggs, state.presence, state.window_ids, state.watermark,
+            kinds=self.kinds, divisor_ms=self.divisor_ms,
+            lateness_ms=self.lateness_ms, drain=drain)
+        closed = np.asarray(closed)
+        new_state = DimensionState(new_aggs, new_presence, new_ids,
+                                   state.watermark, state.dropped)
+        if not closed.any():
+            return [], new_state
+        old_ids = np.asarray(state.window_ids)
+        olds = [np.asarray(a) for a in state.aggs]
+        # exact participation: a (key, bucket) row exists iff any event
+        # aggregated into it — identity-valued results (SUM of zeros)
+        # still emit
+        touched = np.asarray(state.presence) > 0
+        rows: list[tuple[int, int, dict[str, int]]] = []
+        names = [f"{v}:{a}" for v, a in self.slots]
+        for s in np.flatnonzero(closed).tolist():
+            for k in np.flatnonzero(touched[:, s]).tolist():
+                rows.append((k, int(old_ids[s]),
+                             {n: int(olds[i][k, s])
+                              for i, n in enumerate(names)}))
+        return rows, new_state
+
+    @staticmethod
+    def merge(a: DimensionState, b: DimensionState,
+              kinds: tuple[str, ...]) -> DimensionState:
+        """Unifier merge of two partials (the
+        ``DimensionsComputationUnifierImpl`` role): elementwise add/max/min
+        — associative, so it is also exactly what a cross-device
+        psum/pmax would compute."""
+        merged = []
+        for x, y, kind in zip(a.aggs, b.aggs, kinds):
+            if kind in ("add", "count"):
+                merged.append(x + y)
+            elif kind == "max":
+                merged.append(jnp.maximum(x, y))
+            else:
+                merged.append(jnp.minimum(x, y))
+        return DimensionState(
+            aggs=tuple(merged),
+            presence=a.presence + b.presence,
+            window_ids=jnp.maximum(a.window_ids, b.window_ids),
+            watermark=jnp.maximum(a.watermark, b.watermark),
+            dropped=a.dropped + b.dropped,
+        )

@@ -1,0 +1,156 @@
+"""JSON-lines pub/sub query channel: the WebSocket gateway analog.
+
+The Apex reference exposes live aggregate queries through a gateway
+pub/sub endpoint (``ws://<gateway>/pubsub``, built by
+``ConfigUtil.java:22-34``, wired as PubSubWebSocketAppData query/result
+operators, ``ApplicationDimensionComputation.java:236-259``).  No
+websocket stack is assumed here; the same publish/subscribe contract runs
+over a plain TCP socket speaking newline-delimited JSON:
+
+- client -> server: ``{"type": "subscribe", "topic": T}`` (repeatable),
+  ``{"type": "unsubscribe", "topic": T}``
+- server -> subscriber: ``{"type": "data", "topic": T, "data": ...}``
+
+Slow consumers are disconnected rather than allowed to backpressure the
+engine (send buffers are bounded) — queries must never stall aggregation.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    # One shared socket timeout bounds BOTH reads (the subscribe loop
+    # retries on timeout) and writes (a send that can't complete within it
+    # marks the subscriber dead) — queries must never stall aggregation.
+    timeout_s = 1.0
+
+    def handle(self) -> None:
+        server: PubSubServer = self.server.pubsub  # type: ignore[attr-defined]
+        self.connection.settimeout(self.timeout_s)
+        my_topics: set[str] = set()
+        try:
+            while True:
+                try:
+                    raw = self.rfile.readline()
+                except (TimeoutError, socket.timeout):
+                    continue  # idle subscriber: keep listening
+                except OSError:
+                    break
+                if not raw:
+                    break  # client closed
+                try:
+                    msg = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                topic = str(msg.get("topic", ""))
+                if msg.get("type") == "subscribe" and topic:
+                    my_topics.add(topic)
+                    server._subscribe(topic, self)
+                elif msg.get("type") == "unsubscribe" and topic:
+                    my_topics.discard(topic)
+                    server._unsubscribe(topic, self)
+        finally:
+            for t in my_topics:
+                server._unsubscribe(t, self)
+
+    def send(self, payload: bytes) -> bool:
+        """Bounded write: a consumer whose TCP window stays full past the
+        socket timeout is reported dead (and dropped by publish())."""
+        try:
+            self.connection.sendall(payload)
+            return True
+        except (TimeoutError, socket.timeout, OSError):
+            return False
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PubSubServer:
+    """Threaded topic pub/sub over TCP JSON lines."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = _Server((host, port), _Handler)
+        self._srv.pubsub = self  # type: ignore[attr-defined]
+        self._subs: dict[str, set[_Handler]] = {}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    def start(self) -> "PubSubServer":
+        self._thread.start()
+        return self
+
+    def _subscribe(self, topic: str, h: _Handler) -> None:
+        with self._lock:
+            self._subs.setdefault(topic, set()).add(h)
+
+    def _unsubscribe(self, topic: str, h: _Handler) -> None:
+        with self._lock:
+            self._subs.get(topic, set()).discard(h)
+
+    def subscriber_count(self, topic: str) -> int:
+        with self._lock:
+            return len(self._subs.get(topic, ()))
+
+    def publish(self, topic: str, data) -> int:
+        """Fan a payload out to current subscribers; returns receivers.
+        Dead/slow connections are dropped from the topic."""
+        payload = (json.dumps({"type": "data", "topic": topic,
+                               "data": data},
+                              separators=(",", ":")) + "\n").encode()
+        with self._lock:
+            subs = list(self._subs.get(topic, ()))
+        sent = 0
+        for h in subs:
+            if h.send(payload):
+                sent += 1
+            else:
+                self._unsubscribe(topic, h)
+        return sent
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class PubSubClient:
+    """Blocking JSON-lines client (tests + CLI queries)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+        self._file = self._sock.makefile("rwb")
+
+    def subscribe(self, topic: str) -> None:
+        self._send({"type": "subscribe", "topic": topic})
+
+    def unsubscribe(self, topic: str) -> None:
+        self._send({"type": "unsubscribe", "topic": topic})
+
+    def _send(self, msg: dict) -> None:
+        self._file.write(json.dumps(msg).encode() + b"\n")
+        self._file.flush()
+
+    def recv(self) -> dict:
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("pub/sub server closed the connection")
+        return json.loads(line)
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+        finally:
+            self._sock.close()

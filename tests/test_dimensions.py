@@ -1,0 +1,306 @@
+"""Dimension-computation family (Apex peer, SURVEY.md §2 #19-#23):
+schema parsing, the multi-aggregate kernel vs a numpy oracle, unifier
+merge equivalence, durable-store replay/compaction, pub/sub queries, and
+the whole app end-to-end with sentinel-campaign backfill."""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from streambench_tpu.datagen import gen
+from streambench_tpu.dimensions import (
+    SENTINEL_CAMPAIGN,
+    DimensionApp,
+    DimensionsComputation,
+    PubSubClient,
+    PubSubServer,
+)
+from streambench_tpu.dimensions.schema import parse_schema, parse_time_bucket
+from streambench_tpu.dimensions.store import DurableDimensionStore
+
+
+# ----------------------------------------------------------------- schema
+def test_parse_reference_schema_file():
+    # the reference's own eventSchema.json (which has a trailing comma)
+    src = """{"keys": [ {"name":"campaignId","type":"string"}, ],
+ "timeBuckets":["10s"],
+ "values": [
+    {"name":"clicks","type":"long","aggregators":["SUM"]},
+    {"name":"latency","type":"long","aggregators":["MAX"]} ],
+ "dimensions": [ {"combination":["campaignId"]} ]}"""
+    s = parse_schema(src)
+    assert s.keys == ("campaignId",)
+    assert s.time_bucket_ms == 10_000
+    assert s.aggregate_slots() == [("clicks", "SUM"), ("latency", "MAX")]
+    assert s.combinations == (("campaignId",),)
+
+
+def test_time_bucket_units_and_validation():
+    assert parse_time_bucket("200ms") == 200
+    assert parse_time_bucket("1m") == 60_000
+    with pytest.raises(ValueError):
+        parse_time_bucket("10parsecs")
+    with pytest.raises(ValueError, match="unsupported aggregator"):
+        parse_schema({"keys": [{"name": "k"}],
+                      "values": [{"name": "v", "aggregators": ["MEDIAN"]}]})
+    with pytest.raises(ValueError, match="undeclared"):
+        parse_schema({"keys": [{"name": "k"}],
+                      "values": [{"name": "v", "aggregators": ["SUM"]}],
+                      "dimensions": [{"combination": ["nope"]}]})
+
+
+# ----------------------------------------------------------------- kernel
+SCHEMA = parse_schema({
+    "keys": [{"name": "campaignId"}],
+    "timeBuckets": ["10s"],
+    "values": [{"name": "clicks", "aggregators": ["SUM", "COUNT"]},
+               {"name": "latency", "aggregators": ["MAX", "MIN"]}],
+    "dimensions": [{"combination": ["campaignId"]}],
+})
+
+
+def oracle_fold(rows, divisor=10_000):
+    """rows: (key, t, clicks, latency) -> {(key, wid): (sum, count, max, min)}"""
+    out = {}
+    for k, t, c, l in rows:
+        wid = t // divisor
+        s, n, mx, mn = out.get((k, wid), (0, 0, -(2**31) + 1, 2**31 - 1))
+        out[(k, wid)] = (s + c, n + 1, max(mx, l), min(mn, l))
+    return out
+
+
+def test_kernel_matches_numpy_oracle():
+    rng = np.random.default_rng(5)
+    K, B, NB = 7, 256, 6
+    dc = DimensionsComputation(SCHEMA, num_keys=K, window_slots=8,
+                               lateness_ms=20_000)
+    state = dc.init_state()
+    all_rows = []
+    t0 = 100_000
+    for b in range(NB):
+        key = rng.integers(0, K, B).astype(np.int32)
+        t = (t0 + b * 5000 + rng.integers(0, 5000, B)).astype(np.int32)
+        clicks = rng.integers(1, 5, B).astype(np.int32)
+        lat = rng.integers(0, 1000, B).astype(np.int32)
+        valid = np.ones(B, bool)
+        state = dc.step(state, key, t, valid,
+                        {"clicks": clicks, "latency": lat})
+        all_rows += list(zip(key.tolist(), t.tolist(), clicks.tolist(),
+                             lat.tolist()))
+    rows, state = dc.flush_closed(state, drain=True)
+    assert int(state.dropped) == 0
+    got = {(k, wid): (a["clicks:SUM"], a["clicks:COUNT"],
+                      a["latency:MAX"], a["latency:MIN"])
+           for k, wid, a in rows}
+    assert got == oracle_fold(all_rows)
+
+
+def test_closed_vs_open_bucket_flush():
+    dc = DimensionsComputation(SCHEMA, num_keys=3, window_slots=8,
+                               lateness_ms=10_000)
+    state = dc.init_state()
+    mk = lambda t: dc.step(
+        state, np.array([0], np.int32), np.array([t], np.int32),
+        np.array([True]), {"clicks": np.array([1], np.int32),
+                           "latency": np.array([5], np.int32)})
+    state = mk(10_000)       # bucket 1
+    state = dc.step(state, np.array([1], np.int32),
+                    np.array([45_000], np.int32), np.array([True]),
+                    {"clicks": np.array([2], np.int32),
+                     "latency": np.array([9], np.int32)})  # bucket 4
+    # watermark 45k: bucket 1 closed (20k + 10k lateness <= 45k), 4 open
+    rows, state = dc.flush_closed(state)
+    assert [(k, w) for k, w, _ in rows] == [(0, 1)]
+    rows2, state = dc.flush_closed(state, drain=True)
+    assert [(k, w) for k, w, _ in rows2] == [(1, 4)]
+    assert rows2[0][2]["clicks:SUM"] == 2
+
+
+def test_zero_valued_sum_rows_still_emitted():
+    """A (key, bucket) whose only events carry value 0 must still produce
+    a row (revenue:SUM == 0), not vanish."""
+    schema = parse_schema({"keys": [{"name": "k"}],
+                           "timeBuckets": ["10s"],
+                           "values": [{"name": "revenue",
+                                       "aggregators": ["SUM"]}],
+                           "dimensions": [{"combination": ["k"]}]})
+    dc = DimensionsComputation(schema, num_keys=2, window_slots=4,
+                               lateness_ms=0)
+    state = dc.step(dc.init_state(), np.array([1, 1], np.int32),
+                    np.array([10_000, 10_001], np.int32),
+                    np.array([True, True]),
+                    {"revenue": np.array([0, 0], np.int32)})
+    rows, _ = dc.flush_closed(state, drain=True)
+    assert rows == [(1, 1, {"revenue:SUM": 0})]
+
+
+def test_overflow_keys_counted_as_dropped():
+    """key_idx == -1 (interner overflow) rows must tick ``dropped``."""
+    dc = DimensionsComputation(SCHEMA, num_keys=2, window_slots=4,
+                               lateness_ms=0)
+    state = dc.step(dc.init_state(), np.array([0, -1, -1], np.int32),
+                    np.array([10_000, 10_001, 10_002], np.int32),
+                    np.array([True, True, True]),
+                    {"clicks": np.ones(3, np.int32),
+                     "latency": np.ones(3, np.int32)})
+    assert int(state.dropped) == 2
+
+
+def test_unifier_merge_equals_single_fold():
+    rng = np.random.default_rng(11)
+    K, B = 5, 128
+    dc = DimensionsComputation(SCHEMA, num_keys=K, window_slots=8,
+                               lateness_ms=20_000)
+    key = rng.integers(0, K, 2 * B).astype(np.int32)
+    t = (50_000 + rng.integers(0, 20_000, 2 * B)).astype(np.int32)
+    clicks = rng.integers(1, 4, 2 * B).astype(np.int32)
+    lat = rng.integers(0, 500, 2 * B).astype(np.int32)
+    valid = np.ones(2 * B, bool)
+    vals = lambda s: {"clicks": clicks[s], "latency": lat[s]}
+
+    whole = dc.step(dc.init_state(), key, t, valid,
+                    {"clicks": clicks, "latency": lat})
+    h1 = dc.step(dc.init_state(), key[:B], t[:B], valid[:B], vals(slice(0, B)))
+    h2 = dc.step(dc.init_state(), key[B:], t[B:], valid[B:], vals(slice(B, None)))
+    merged = DimensionsComputation.merge(h1, h2, dc.kinds)
+    for a, b in zip(whole.aggs, merged.aggs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(whole.watermark) == int(merged.watermark)
+
+
+# -------------------------------------------------- synthetic + interner
+def test_synthetic_source_interner_and_overflow():
+    from streambench_tpu.dimensions.synthetic import run_synthetic
+
+    # key capacity far below the campaign universe: overflow keys must be
+    # counted as dropped, interned keys aggregated exactly
+    rows, interner, dropped = run_synthetic(
+        n_events=5000, batch=512, num_campaigns=10_000, key_capacity=256,
+        rng=random.Random(4))
+    assert interner.overflow > 0 and dropped > 0
+    total = sum(a["clicks:SUM"] for _, _, a in rows)
+    assert total + dropped == 5000
+    assert all(name.startswith("campaign-") for name, _, _ in rows)
+
+
+def test_synthetic_source_no_overflow_exact():
+    from streambench_tpu.dimensions.synthetic import run_synthetic
+
+    rows, interner, dropped = run_synthetic(
+        n_events=3000, batch=512, num_campaigns=50, key_capacity=64,
+        rng=random.Random(9))
+    assert dropped == 0 and interner.overflow == 0
+    assert sum(a["clicks:SUM"] for _, _, a in rows) == 3000
+
+
+# ------------------------------------------------------------------ store
+def test_store_replay_compact_and_torn_tail(tmp_path):
+    d = str(tmp_path / "store")
+    with DurableDimensionStore(d) as st:
+        st.put_rows([("c1", 10_000, {"clicks:SUM": 3}),
+                     ("c2", 10_000, {"clicks:SUM": 1})],
+                    update_time_ms=21_000)
+        st.put_rows([("c1", 10_000, {"clicks:SUM": 7})],  # overwrite
+                    update_time_ms=22_000)
+    # torn tail from a crash mid-append
+    with open(os.path.join(d, "dimensions.log"), "a") as f:
+        f.write('{"k":"c3","b":20000,"t":')
+
+    st2 = DurableDimensionStore(d)
+    assert len(st2) == 2
+    assert st2.get("c1", 10_000)["clicks:SUM"] == 7
+    assert st2.get("c1", 10_000)["_updated"] == 22_000
+    assert st2.scan_key("c2") == {10_000: {"clicks:SUM": 1,
+                                           "_updated": 21_000}}
+    st2.compact()
+    st2.put_rows([("c4", 30_000, {"clicks:SUM": 2})])
+    st2.close()
+    lines = open(os.path.join(d, "dimensions.log")).read().splitlines()
+    assert len(lines) == 3  # compacted c1+c2 + appended c4
+    st3 = DurableDimensionStore(d)
+    assert st3.get("c1", 10_000)["clicks:SUM"] == 7
+    assert st3.get("c4", 30_000)["clicks:SUM"] == 2
+
+
+# ----------------------------------------------------------------- pubsub
+def test_pubsub_subscribe_publish_unsubscribe():
+    srv = PubSubServer().start()
+    try:
+        host, port = srv.address
+        c = PubSubClient(host, port)
+        c.subscribe("dimensions")
+        for _ in range(100):
+            if srv.subscriber_count("dimensions"):
+                break
+            import time
+            time.sleep(0.01)
+        assert srv.publish("dimensions", {"x": 1}) == 1
+        msg = c.recv()
+        assert msg == {"type": "data", "topic": "dimensions",
+                       "data": {"x": 1}}
+        assert srv.publish("other-topic", {}) == 0
+        c.close()
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------- app end-to-end
+def make_events(tmp_path, events=4000):
+    rng = random.Random(31)
+    campaigns = gen.make_ids(10, rng)
+    ads = gen.make_ids(100, rng)
+    mapping = {a: campaigns[i % 10] for i, a in enumerate(ads)}
+    src = gen.EventSource(ads=ads, user_ids=gen.make_ids(5, rng),
+                          page_ids=gen.make_ids(5, rng), rng=rng)
+    base = 1_700_000_000_000
+    lines = [e.encode() for e in src.events_at(base + 25 * i
+                                               for i in range(events))]
+    return mapping, campaigns, lines, base
+
+
+def test_dimension_app_end_to_end_matches_golden(tmp_path):
+    mapping, campaigns, lines, base = make_events(tmp_path)
+    srv = PubSubServer().start()
+    try:
+        app = DimensionApp(None, mapping, str(tmp_path / "store"),
+                           campaigns=campaigns, pubsub=srv,
+                           batch_size=512)
+        app.process_lines(lines)
+        report = app.close()
+        assert app.invalid_tuples == 0 and app.dropped == 0
+
+        # golden: clicks SUM per (campaign, 10s bucket) over view events
+        golden: dict[tuple[str, int], int] = {}
+        for line in lines:
+            ev = json.loads(line)
+            if ev["event_type"] != "view":
+                continue
+            b = int(ev["event_time"]) // 10_000 * 10_000
+            k = (mapping[ev["ad_id"]], b)
+            golden[k] = golden.get(k, 0) + 1
+        st = DurableDimensionStore(str(tmp_path / "store"))
+        got = {(k, b): v["clicks:SUM"] for (k, b), v in st.items()}
+        assert got == golden
+        # MAX latency recorded and sane (events are in the past -> large)
+        any_val = next(iter(st.items()))[1]
+        assert any_val["latency:MAX"] > 0
+        assert "latency report" in report
+    finally:
+        srv.close()
+
+
+def test_dimension_app_sentinel_backfill_without_join(tmp_path):
+    mapping, campaigns, lines, base = make_events(tmp_path, events=500)
+    app = DimensionApp(None, mapping, str(tmp_path / "store2"),
+                       campaigns=campaigns, include_join=False)
+    app.process_lines(lines)
+    app.close()
+    st = DurableDimensionStore(str(tmp_path / "store2"))
+    keys = {k for (k, _), _ in st.items()}
+    assert keys == {SENTINEL_CAMPAIGN}
+    views = sum(1 for line in lines
+                if json.loads(line)["event_type"] == "view")
+    assert sum(v["clicks:SUM"] for _, v in st.items()) == views
