@@ -21,13 +21,9 @@ import os
 import signal
 import sys
 
-# The image's sitecustomize may force a hardware backend via jax.config,
-# overriding the JAX_PLATFORMS env var; re-pin it so harness-driven test
-# runs (JAX_PLATFORMS=cpu) actually land on the requested platform.
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+from streambench_tpu.utils.platform import pin_jax_platform
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+pin_jax_platform()
 
 from streambench_tpu.config import ConfigError, find_and_read_config_file
 from streambench_tpu.datagen import gen
@@ -54,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="drain the journal at full speed, then exit")
     p.add_argument("--sharded", action="store_true",
                    help="run the mesh-sharded engine (jax.mesh.* config)")
+    p.add_argument("--engine", default="exact",
+                   choices=("exact", "hll", "sliding", "session"),
+                   help="aggregation engine: exact window counts "
+                        "(default), HLL distinct users, sliding-window + "
+                        "t-digest quantiles, or session windows + "
+                        "count-min heavy hitters (BASELINE configs #1-#4)")
     p.add_argument("--checkpointDir", default=None,
                    help="enable (offset, state) snapshots here; on start, "
                         "resume from the newest one if present")
@@ -96,6 +98,9 @@ def main(argv: list[str] | None = None) -> int:
     else:
         redis = RespClient(cfg.redis_host, cfg.redis_port)
 
+    if args.microbatch and (args.checkpointDir or args.engine != "exact"):
+        raise SystemExit("--microbatch is its own execution mode: drop "
+                         "--checkpointDir/--engine")
     if args.microbatch:
         from streambench_tpu.engine.microbatch import run_microbatch
 
@@ -119,8 +124,21 @@ def main(argv: list[str] | None = None) -> int:
                 ShardedWindowEngine,
                 mesh_from_config,
             )
+            if args.engine != "exact":
+                raise SystemExit("--sharded currently implies the exact "
+                                 "engine; drop --engine")
             return ShardedWindowEngine(cfg, mapping, mesh_from_config(cfg),
                                        campaigns=campaigns, redis=r)
+        if args.engine != "exact":
+            from streambench_tpu.engine.sketches import (
+                HLLDistinctEngine,
+                SessionCMSEngine,
+                SlidingTDigestEngine,
+            )
+            cls = {"hll": HLLDistinctEngine,
+                   "sliding": SlidingTDigestEngine,
+                   "session": SessionCMSEngine}[args.engine]
+            return cls(cfg, mapping, campaigns=campaigns, redis=r)
         return AdAnalyticsEngine(cfg, mapping, campaigns=campaigns, redis=r)
 
     engine = make_engine(redis)
@@ -128,12 +146,23 @@ def main(argv: list[str] | None = None) -> int:
     broker = FileBroker(args.brokerDir or os.path.join(args.workdir, "broker"))
     broker.create_topic(cfg.kafka_topic)
     checkpointer = None
+    if args.checkpointDir and args.engine != "exact":
+        raise SystemExit("--checkpointDir requires the exact engine "
+                         "(sketch states are not checkpointable yet)")
+    n_parts = len(broker.partitions(cfg.kafka_topic))
     if args.checkpointDir:
+        if n_parts > 1:
+            raise SystemExit(
+                "--checkpointDir currently requires a single-partition "
+                f"topic (found {n_parts}); checkpoints store one offset")
         from streambench_tpu.checkpoint import Checkpointer
 
         checkpointer = Checkpointer(args.checkpointDir)
-    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic),
-                          checkpointer=checkpointer)
+    # one consumer over the whole topic, every partition (engines in the
+    # reference likewise subscribe to all of ad-events)
+    reader = (broker.multi_reader(cfg.kafka_topic) if n_parts > 1
+              else broker.reader(cfg.kafka_topic))
+    runner = StreamRunner(engine, reader, checkpointer=checkpointer)
     if runner.resume():
         print(f"resumed from checkpoint: offset={runner.reader.offset} "
               f"events={engine.events_processed}", flush=True)

@@ -115,8 +115,12 @@ class RedisWindowBarrier:
         self.n = n_partitions
         self._poll = poll_interval_s
         self._timeout = timeout_s
+        # a previous run's end-of-stream broadcast must not abort this one
+        self.redis.execute("HDEL", self.table, "aborted")
 
     def arrive(self, window_idx: int) -> int:
+        if self.redis.execute("HGET", self.table, "aborted") is not None:
+            raise threading.BrokenBarrierError
         my = int(self.redis.execute("HINCRBY", self.table,
                                     "partition_count", 1))
         field_ = f"start_time:{window_idx}"
@@ -127,14 +131,24 @@ class RedisWindowBarrier:
             return stamp
         deadline = time.monotonic() + self._timeout
         while True:
-            res = self.redis.execute("HGET", self.table, field_)
+            res, ab = self.redis.pipeline_execute(
+                [("HGET", self.table, field_),
+                 ("HGET", self.table, "aborted")])
             if res is not None:
                 return int(res)
+            if ab is not None:
+                # a peer hit end-of-stream: this window can never assemble
+                raise threading.BrokenBarrierError
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"window barrier {window_idx}: no stamp after "
                     f"{self._timeout}s (partition died?)")
             time.sleep(self._poll)
+
+    def abort(self) -> None:
+        """End-of-stream broadcast: release peers parked in ``arrive``
+        (their in-flight window is dropped, matching the local barrier)."""
+        self.redis.execute("HSET", self.table, "aborted", "1")
 
 
 # ----------------------------------------------------------------------
@@ -271,7 +285,16 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
     mappers = [MicroBatchMapper(cfg, encoder, join_table_dev, barrier, p,
                                 input_format=input_format)
                for p in range(P)]
-    limit = max_windows * mappers[0].partition_size if max_windows else None
+    # Warm the kernel before spawning threads: P mappers would otherwise
+    # race into the same first jit-compile concurrently (tracing is not
+    # reliably thread-safe for an identical fresh signature).
+    psize = mappers[0].partition_size
+    window_campaign_counts(
+        join_table_dev, np.zeros(psize, np.int32),
+        np.full(psize, -1, np.int32), np.zeros(psize, bool),
+        num_campaigns=encoder.num_campaigns).block_until_ready()
+
+    limit = max_windows * psize if max_windows else None
     errors: list[BaseException] = []
 
     def drive(p: int) -> None:
@@ -290,14 +313,12 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
                     fed += len(lines)
             # end-of-stream: no further window can assemble without this
             # partition; release any peers parked at the rendezvous
-            if isinstance(barrier, LocalWindowBarrier):
-                barrier.abort()
+            barrier.abort()
         except threading.BrokenBarrierError:
             pass  # a peer hit end-of-stream; our partial window is dropped
         except BaseException as e:  # surface thread failures to the caller
             errors.append(e)
-            if isinstance(barrier, LocalWindowBarrier):
-                barrier.abort()
+            barrier.abort()
 
     threads = [threading.Thread(target=drive, args=(p,), daemon=True)
                for p in range(P)]

@@ -44,6 +44,10 @@ def default_method() -> str:
 class AdAnalyticsEngine:
     """Exact per-(campaign, 10 s window) view counting — BASELINE config #1."""
 
+    # Subclasses whose pending values are absolute snapshots (not deltas)
+    # set this so the Redis writer HSETs instead of HINCRBYs.
+    absolute_counts = False
+
     def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
                  campaigns: list[str] | None = None,
                  redis: RedisLike | None = None,
@@ -96,35 +100,72 @@ class AdAnalyticsEngine:
                 batch = self._encode(chunk, self.batch_size)
             if batch.n == 0:
                 continue
-            vt = batch.event_time[:batch.n]
-            batch_max = int(vt.max()) + batch.base_time_ms
-            batch_min = int(vt.min()) + batch.base_time_ms
-            if self._span_start is None:
-                self._span_start = batch_min
-            # Ring-reuse guard: drain device deltas BEFORE this batch if its
-            # max would stretch the unflushed span past the safe limit.
-            if batch_max - self._span_start > self._span_guard:
-                with self.tracer.span("drain"):
-                    self._drain_device()
-                self._span_start = batch_min
-            with self.tracer.span("device_step"):
-                # async dispatch: the span covers transfer + enqueue, not
-                # device completion (that overlaps the next encode — the
-                # pipeline-parallel analog, SURVEY.md §2)
-                self._device_step(
-                    jnp.asarray(batch.ad_idx), jnp.asarray(batch.event_type),
-                    jnp.asarray(batch.event_time), jnp.asarray(batch.valid))
-            self.events_processed += batch.n
-            self.last_event_ms = now_ms()
+            self._fold(batch)
         return len(lines)
 
+    def _fold(self, batch) -> None:
+        """Ring-guarded fold of one encoded batch, splitting when needed.
+
+        Two span hazards can corrupt the ring: (a) the batch stretches the
+        *unflushed* span past the safe limit -> drain first; (b) the batch
+        ALONE spans more event time than the ring can hold (sparse or
+        low-rate streams: batch_size x inter-event gap > ring span) -> no
+        drain can help; halve and recurse.  Halving keeps the jit shape
+        set bounded (log2(B) distinct shapes, each compiled once).
+        """
+        vt = batch.event_time[:batch.n]
+        batch_max = int(vt.max()) + batch.base_time_ms
+        batch_min = int(vt.min()) + batch.base_time_ms
+        if batch_max - batch_min > self._span_guard and batch.n > 1:
+            for half in self._halves(batch):
+                if half.n:
+                    self._fold(half)
+            return
+        if self._span_start is None:
+            self._span_start = batch_min
+        # Ring-reuse guard: drain device deltas BEFORE this batch if its
+        # max would stretch the unflushed span past the safe limit.
+        if batch_max - self._span_start > self._span_guard:
+            with self.tracer.span("drain"):
+                self._drain_device()
+            if self._span_start is None or batch_min < self._span_start:
+                self._span_start = batch_min
+        with self.tracer.span("device_step"):
+            # async dispatch: the span covers transfer + enqueue, not
+            # device completion (that overlaps the next encode — the
+            # pipeline-parallel analog, SURVEY.md §2)
+            self._device_step(batch)
+        self.events_processed += batch.n
+        self.last_event_ms = now_ms()
+
+    @staticmethod
+    def _halves(batch):
+        """Split an encoded batch into two fixed-shape halves (valid rows
+        are compacted to the front, so column slices stay consistent)."""
+        import dataclasses
+
+        B = batch.batch_size
+        B0 = B // 2
+        n0 = min(batch.n, B0)
+        cols = ("ad_idx", "event_type", "event_time", "user_idx",
+                "page_idx", "ad_type", "valid")
+        lo = dataclasses.replace(
+            batch, **{c: getattr(batch, c)[:B0] for c in cols}, n=n0)
+        hi = dataclasses.replace(
+            batch, **{c: getattr(batch, c)[B0:] for c in cols},
+            n=batch.n - n0)
+        return lo, hi
+
     # ------------------------------------------------------------------
-    def _device_step(self, ad_idx, event_type, event_time, valid) -> None:
-        """Fold one encoded batch into device state (subclass hook: the
-        sharded engine swaps in the mesh version)."""
+    def _device_step(self, batch) -> None:
+        """Fold one ``EncodedBatch`` into device state (subclass hook:
+        the sharded engine swaps in the mesh version; sketch engines use
+        additional columns like ``user_idx``)."""
         self.state = wc.step(
-            self.state, self.join_table, ad_idx, event_type, event_time,
-            valid, divisor_ms=self.divisor, lateness_ms=self.lateness,
+            self.state, self.join_table,
+            jnp.asarray(batch.ad_idx), jnp.asarray(batch.event_type),
+            jnp.asarray(batch.event_time), jnp.asarray(batch.valid),
+            divisor_ms=self.divisor, lateness_ms=self.lateness,
             method=self.method)
 
     # ------------------------------------------------------------------
@@ -163,7 +204,8 @@ class AdAnalyticsEngine:
             self.latency_tracker.record(camp, ts, stamp)
         if self.redis is not None:
             with self.tracer.span("redis_flush"):
-                write_windows_pipelined(self.redis, rows, time_updated=stamp)
+                write_windows_pipelined(self.redis, rows, time_updated=stamp,
+                                        absolute=self.absolute_counts)
         self._pending.clear()
         self.windows_written += len(rows)
         return len(rows)

@@ -1,0 +1,262 @@
+"""Sketch-aggregation engines: BASELINE configs #2-#4.
+
+Same host loop, encoder, Redis writer, and harness contract as the exact
+count engine (``AdAnalyticsEngine``) — only the device aggregation state
+changes, exactly how the reference swaps ``CampaignProcessorCommon`` for a
+different processor while keeping the topology (SURVEY.md §7.6).  All
+three sketches merge with psum/pmax-shaped reductions, so the sharded
+variants come from the same mesh treatment as the exact engine.
+
+- ``HLLDistinctEngine`` — distinct users per (campaign, 10 s window) via
+  HyperLogLog registers in place of exact counts.  Estimates are
+  *absolute*, so window writebacks HSET rather than HINCRBY.
+- ``SlidingTDigestEngine`` — sliding-window (size/slide) view counts plus
+  a per-campaign t-digest over event latency; quantiles dump to Redis at
+  close.
+- ``SessionCMSEngine`` — session windows (gap-based) of per-user clicks,
+  feeding a count-min sketch whose top-k heavy hitters dump at close.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from streambench_tpu.config import BenchmarkConfig
+from streambench_tpu.engine.pipeline import AdAnalyticsEngine
+from streambench_tpu.io.redis_schema import RedisLike
+from streambench_tpu.ops import cms, hll, session, sliding, tdigest
+from streambench_tpu.ops import windowcount as wc
+from streambench_tpu.utils.ids import now_ms
+
+
+class _SketchEngineBase(AdAnalyticsEngine):
+    """Shared plumbing: sketch engines keep their own device state and
+    cannot reuse the exact-count checkpoint snapshot (its arrays are the
+    ``WindowState`` counts)."""
+
+    def snapshot(self, offset: int):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support checkpointing yet; "
+            "run without --checkpointDir")
+
+    def restore(self, snap):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support checkpointing yet")
+
+
+class HLLDistinctEngine(_SketchEngineBase):
+    """Distinct users per (campaign, window): HLL registers on device.
+
+    BASELINE config #2 — 'HyperLogLog distinct-user-per-campaign sketch in
+    place of exact count'.  ``seen_count`` in the canonical Redis schema
+    becomes the distinct estimate; re-flushes of a still-open window
+    replace the previous estimate (absolute semantics).
+    """
+
+    absolute_counts = True
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 registers: int = 128,
+                 input_format: str = "json"):
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, input_format=input_format)
+        self.registers = registers
+        self.state = hll.init_state(self.encoder.num_campaigns, self.W,
+                                    num_registers=registers)
+
+    def _device_step(self, batch) -> None:
+        self.state = hll.step(
+            self.state, self.join_table,
+            jnp.asarray(batch.ad_idx), jnp.asarray(batch.user_idx),
+            jnp.asarray(batch.event_type), jnp.asarray(batch.event_time),
+            jnp.asarray(batch.valid),
+            divisor_ms=self.divisor, lateness_ms=self.lateness)
+
+    def _drain_device(self) -> None:
+        est, wids, self.state = hll.flush(
+            self.state, divisor_ms=self.divisor, lateness_ms=self.lateness)
+        est = np.asarray(est)
+        wids = np.asarray(wids)
+        base = self.encoder.base_time_ms or 0
+        for s in np.flatnonzero(wids >= 0).tolist():
+            abs_ts = base + int(wids[s]) * self.divisor
+            col = est[:, s]
+            for c in np.flatnonzero(col > 0).tolist():
+                # absolute estimate: replace, don't accumulate
+                self._pending[(c, abs_ts)] = int(col[c])
+        # Open windows keep their registers on device, so the unflushed
+        # event-time span restarts at the oldest still-open window, not
+        # at the next batch (the base engine drains everything and can
+        # reset to None).
+        still_open = np.asarray(self.state.window_ids)
+        open_wids = still_open[still_open >= 0]
+        self._span_start = (base + int(open_wids.min()) * self.divisor
+                            if open_wids.size else None)
+
+    @property
+    def dropped(self) -> int:
+        return int(self.state.dropped)
+
+
+class SlidingTDigestEngine(_SketchEngineBase):
+    """Sliding-window view counts + per-campaign latency t-digest.
+
+    BASELINE config #3 — 'sliding-window (10s / 1s slide) + t-digest
+    latency-quantile sketch per campaign'.  Window rows use the canonical
+    schema with ``window_ts`` = the slide-aligned window START; counts are
+    deltas (HINCRBY) like the exact engine.  At close, per-campaign
+    latency quantiles land in the Redis hash
+    ``<redis.hashtable>_quantiles`` as ``<campaign>:p<q>`` fields.
+    """
+
+    QUANTILES = (0.5, 0.9, 0.99)
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 size_ms: int | None = None, slide_ms: int = 1_000,
+                 window_slots: int | None = None,
+                 compression: int = 64,
+                 input_format: str = "json"):
+        size = size_ms if size_ms is not None else cfg.jax_time_divisor_ms
+        late_eff = sliding.effective_lateness(size, slide_ms,
+                                              cfg.jax_allowed_lateness_ms)
+        # ring must span lateness + size in SLIDE units
+        W = window_slots or (late_eff // slide_ms + 3 * (size // slide_ms))
+        cfg2 = dataclasses.replace(
+            cfg, jax_window_slots=W, jax_time_divisor_ms=slide_ms,
+            jax_allowed_lateness_ms=late_eff)
+        super().__init__(cfg2, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, input_format=input_format)
+        self.size_ms = size
+        self.slide_ms = slide_ms
+        self.base_lateness = cfg.jax_allowed_lateness_ms
+        self.digest = tdigest.init_state(self.encoder.num_campaigns,
+                                         compression=compression)
+
+    def _device_step(self, batch) -> None:
+        ad = jnp.asarray(batch.ad_idx)
+        et = jnp.asarray(batch.event_type)
+        tm = jnp.asarray(batch.event_time)
+        valid = jnp.asarray(batch.valid)
+        self.state = sliding.step(
+            self.state, self.join_table, ad, et, tm, valid,
+            size_ms=self.size_ms, slide_ms=self.slide_ms,
+            lateness_ms=self.base_lateness)
+        # latency sample per view event, bucketed per campaign
+        base = self.encoder.base_time_ms or 0
+        now_rel = np.clip(np.int64(now_ms()) - base, 0, 2**31 - 2)
+        lat = jnp.maximum(jnp.int32(now_rel) - tm, 0)
+        campaign = self.join_table[ad]
+        mask = valid & (et == 0) & (campaign >= 0)
+        self.digest = tdigest.update(self.digest, campaign, lat, mask)
+
+    def quantiles(self) -> np.ndarray:
+        """Per-campaign latency quantiles ``[C, len(QUANTILES)]`` (ms)."""
+        return np.asarray(tdigest.quantile(
+            self.digest, jnp.asarray(self.QUANTILES, jnp.float32)))
+
+    def close(self) -> None:
+        super().close()
+        if self.redis is not None and self.cfg.redis_hashtable:
+            q = self.quantiles()
+            cmds = []
+            table = f"{self.cfg.redis_hashtable}_quantiles"
+            for c, name in enumerate(self.encoder.campaigns):
+                for j, qq in enumerate(self.QUANTILES):
+                    cmds.append(("HSET", table, f"{name}:p{int(qq * 100)}",
+                                 f"{q[c, j]:.1f}"))
+            self.redis.pipeline_execute(cmds)
+
+
+class SessionCMSEngine(_SketchEngineBase):
+    """Per-user session click aggregation + count-min heavy hitters.
+
+    BASELINE config #4 — 'session-window per-user click aggregation
+    (gap=30s) with count-min heavy-hitter sketch'.  Closed sessions (in
+    batch, carried, or expired by watermark) feed the CMS keyed by user
+    with the session's click count as weight; ``close()`` writes top-k
+    user estimates to ``<redis.hashtable>_hh``.
+    """
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 gap_ms: int = 30_000, user_capacity: int = 1 << 16,
+                 cms_depth: int = 4, cms_width: int = 2048,
+                 top_k: int = 16,
+                 input_format: str = "json"):
+        # The heavy-hitter report needs user-id NAMES; only the Python
+        # encoder keeps the user intern table host-side (the native one
+        # interns in C with no reverse lookup), so pin it here.
+        cfg = dataclasses.replace(cfg, jax_use_native_encoder=False)
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, input_format=input_format)
+        self.gap_ms = gap_ms
+        self.user_capacity = user_capacity
+        self.top_k = top_k
+        self.state = session.init_state(user_capacity)
+        self.cms = cms.init_state(depth=cms_depth, width=cms_width)
+        self.sessions_closed = 0
+        self.session_clicks = 0
+
+    def _absorb(self, closed: session.ClosedSessions) -> None:
+        self.cms = cms.update(self.cms, closed.user, closed.clicks,
+                              closed.valid)
+        v = np.asarray(closed.valid)
+        self.sessions_closed += int(v.sum())
+        self.session_clicks += int(np.asarray(closed.clicks)[v].sum())
+
+    def _device_step(self, batch) -> None:
+        self.state, in_batch, carried = session.step(
+            self.state, jnp.asarray(batch.user_idx),
+            jnp.asarray(batch.event_type), jnp.asarray(batch.event_time),
+            jnp.asarray(batch.valid),
+            gap_ms=self.gap_ms, lateness_ms=self.lateness)
+        self._absorb(in_batch)
+        self._absorb(carried)
+
+    def _drain_device(self) -> None:
+        self.state, expired = session.flush(
+            self.state, gap_ms=self.gap_ms, lateness_ms=self.lateness)
+        self._absorb(expired)
+        self._span_start = None
+
+    def flush(self, time_updated: int | None = None) -> int:
+        self._drain_device()
+        return 0  # sessions have no canonical window rows
+
+    def heavy_hitters(self) -> list[tuple[str, int]]:
+        """Top-k (user, estimated clicks), est > 0 only."""
+        users = [u.decode() if isinstance(u, bytes) else u
+                 for u in self.encoder.user_index]
+        n = len(users)
+        if n == 0:
+            return []
+        cand = jnp.arange(n, dtype=jnp.int32)
+        vals, idx = cms.heavy_hitters(self.cms, cand,
+                                      k=min(self.top_k, n))
+        vals = np.asarray(vals)
+        idx = np.asarray(idx)
+        return [(users[int(i)], int(v)) for v, i in zip(vals, idx) if v > 0]
+
+    def close(self) -> None:
+        self.state, final = session.flush(
+            self.state, gap_ms=self.gap_ms, lateness_ms=self.lateness,
+            force=True)
+        self._absorb(final)
+        if self.redis is not None and self.cfg.redis_hashtable:
+            table = f"{self.cfg.redis_hashtable}_hh"
+            cmds = [("HSET", table, user, str(est))
+                    for user, est in self.heavy_hitters()]
+            if cmds:
+                self.redis.pipeline_execute(cmds)
+
+    @property
+    def dropped(self) -> int:
+        return int(self.state.dropped)

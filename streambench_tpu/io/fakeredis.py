@@ -89,6 +89,19 @@ class FakeRedisStore:
             self._check_type(key, self._hashes)
             return self._hashes.get(key, {}).get(field)
 
+    def hdel(self, key: str, *fields: str) -> int:
+        with self._lock:
+            self._check_type(key, self._hashes)
+            h = self._hashes.get(key, {})
+            removed = 0
+            for f in fields:
+                if f in h:
+                    del h[f]
+                    removed += 1
+            if not h and key in self._hashes:
+                del self._hashes[key]
+            return removed
+
     def hgetall(self, key: str) -> list[str]:
         with self._lock:
             self._check_type(key, self._hashes)

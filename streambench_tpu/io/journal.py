@@ -180,6 +180,70 @@ class JournalReader:
         self.close()
 
 
+class MultiReader:
+    """Round-robin reader over all partitions of a topic.
+
+    The streaming engine is one consumer of the WHOLE topic (the
+    reference's engines likewise subscribe to every partition of
+    ``ad-events``); partitioned topics exist so count-windowed map
+    partitions can each own one (``map.partitions``).  ``poll`` drains
+    partitions round-robin for rough arrival-order fairness.
+
+    Checkpointing: a multi-partition position is a vector, not a byte
+    offset — ``offsets``/``seek_offsets`` expose it; the scalar
+    ``offset`` property exists only to fail loudly if something treats
+    this reader as single-partition.
+    """
+
+    def __init__(self, readers: list[JournalReader]):
+        if not readers:
+            raise ValueError("MultiReader needs at least one reader")
+        self._readers = readers
+        self._next = 0
+
+    @property
+    def offsets(self) -> list[int]:
+        return [r.offset for r in self._readers]
+
+    def seek_offsets(self, offsets: list[int]) -> None:
+        if len(offsets) != len(self._readers):
+            raise ValueError(
+                f"{len(offsets)} offsets for {len(self._readers)} partitions")
+        for r, off in zip(self._readers, offsets):
+            r.seek(off)
+
+    @property
+    def offset(self):
+        raise AttributeError(
+            "MultiReader spans partitions; use .offsets (checkpointing a "
+            "multi-partition run needs the per-partition vector)")
+
+    def poll(self, max_records: int = 65536) -> list[bytes]:
+        out: list[bytes] = []
+        n = len(self._readers)
+        empty_streak = 0
+        while len(out) < max_records and empty_streak < n:
+            r = self._readers[self._next]
+            self._next = (self._next + 1) % n
+            got = r.poll(max_records=max_records - len(out))
+            if got:
+                out.extend(got)
+                empty_streak = 0
+            else:
+                empty_streak += 1
+        return out
+
+    def close(self) -> None:
+        for r in self._readers:
+            r.close()
+
+    def __enter__(self) -> "MultiReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 class FileBroker:
     """Directory of topic files; the process-local 'Kafka cluster'.
 
@@ -218,6 +282,11 @@ class FileBroker:
     def reader(self, topic: str, partition: int = 0,
                offset: int = 0) -> JournalReader:
         return JournalReader(self.topic_path(topic, partition), offset)
+
+    def multi_reader(self, topic: str) -> MultiReader:
+        """One consumer over every existing partition of ``topic``."""
+        parts = self.partitions(topic) or [0]
+        return MultiReader([self.reader(topic, p) for p in parts])
 
     def read_all(self, topic: str) -> Iterator[bytes]:
         """Replay a whole topic (all partitions, offset 0) — oracle use."""

@@ -125,13 +125,19 @@ def write_window(r: RedisLike, campaign: str, window_ts: int | str,
 
 def write_windows_pipelined(r: RedisLike,
                             entries: Iterable[tuple[str, int, int]],
-                            time_updated: int | None = None) -> int:
+                            time_updated: int | None = None,
+                            absolute: bool = False) -> int:
     """Flush many ``(campaign, window_ts, count)`` rows efficiently.
 
     Same observable schema as ``write_window``, but the existence probes for
     all rows ride one pipeline and the mutations another — two round trips
     per flush instead of the reference's 5+ per window
     (``AdvertisingSpark.scala:189-205``).  Returns the number of rows written.
+
+    ``absolute=True`` HSETs ``seen_count`` instead of HINCRBY — for
+    aggregators whose flushed value is an absolute snapshot rather than a
+    delta (HLL distinct estimates: re-flushing a still-open window must
+    replace, not accumulate).
     """
     rows = [(c, str(w), int(n)) for c, w, n in entries]
     if not rows:
@@ -162,7 +168,10 @@ def write_windows_pipelined(r: RedisLike,
                 new_lists[campaign] = luuid
                 muts.append(("HSET", campaign, "windows", luuid))
             muts.append(("LPUSH", luuid, wts))
-        muts.append(("HINCRBY", wuuid, "seen_count", count))
+        if absolute:
+            muts.append(("HSET", wuuid, "seen_count", count))
+        else:
+            muts.append(("HINCRBY", wuuid, "seen_count", count))
         muts.append(("HSET", wuuid, "time_updated", stamp))
     r.pipeline_execute(muts)
     return len(rows)

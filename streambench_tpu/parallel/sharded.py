@@ -188,8 +188,8 @@ class ShardedWindowEngine(AdAnalyticsEngine):
             dropped=jax.device_put(jnp.int32(dropped), rep),
         )
 
-    def _device_step(self, ad_idx, event_type, event_time, valid) -> None:
+    def _device_step(self, batch) -> None:
         self.state = sharded_step(
             self.mesh, self.state, self.join_table,
-            ad_idx, event_type, event_time, valid,
+            batch.ad_idx, batch.event_type, batch.event_time, batch.valid,
             divisor_ms=self.divisor, lateness_ms=self.lateness)

@@ -1,0 +1,26 @@
+"""JAX platform pinning for CLI entry points.
+
+The image's sitecustomize may pre-register a hardware backend plugin and
+force ``jax_platforms`` via jax.config — overriding the ``JAX_PLATFORMS``
+environment variable.  Harness-driven test runs (``JAX_PLATFORMS=cpu``)
+must still land on the requested platform, so every process entry point
+re-pins the config before any array op initializes a backend.  (Package
+imports are guaranteed backend-init-free — see
+``tests/test_import_side_effects.py`` — which is what makes pinning at
+main() time sufficient.)
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_jax_platform(platform: str | None = None) -> None:
+    """Pin jax to ``platform`` (default: the JAX_PLATFORMS env var).
+    No-op when neither is set."""
+    platform = platform or os.environ.get("JAX_PLATFORMS")
+    if not platform:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platform)

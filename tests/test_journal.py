@@ -89,3 +89,26 @@ def test_concurrent_writer_reader(tmp_path):
     t.join(timeout=10)
     assert len(got) == 1000 and got[0] == b"line-0" and got[-1] == b"line-999"
     w.close()
+
+
+def test_multi_reader_round_robin_and_seek(tmp_path):
+    import pytest
+
+    broker = FileBroker(str(tmp_path / "mrb"))
+    for p in range(3):
+        with broker.writer("t", p) as w:
+            w.append_many([f"p{p}-{i}" for i in range(4)])
+    mr = broker.multi_reader("t")
+    got = mr.poll(max_records=100)
+    assert len(got) == 12
+    assert {line.decode().split("-")[0] for line in got} == {"p0", "p1", "p2"}
+    assert mr.poll() == []
+    offs = mr.offsets
+    mr.seek_offsets([0, offs[1], offs[2]])  # rewind partition 0 only
+    again = mr.poll(max_records=100)
+    assert sorted(again) == sorted(f"p0-{i}".encode() for i in range(4))
+    with pytest.raises(AttributeError, match="partitions"):
+        mr.offset
+    with pytest.raises(ValueError):
+        mr.seek_offsets([0])
+    mr.close()

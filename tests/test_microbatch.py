@@ -156,6 +156,35 @@ def test_barrier_timeout_is_an_error_not_eos():
         b.arrive(0)  # the second partition never shows up
 
 
+def test_unequal_partitions_redis_barrier_no_timeout(tmp_path):
+    """Same end-of-stream scenario with the Redis barrier: the dry
+    partition's abort broadcast must release peers promptly (no 60s
+    timeout, no spurious error)."""
+    import time as _time
+
+    cfg, broker, mapping, campaigns = setup(tmp_path, events=1800,
+                                            window_size=300)
+    path = broker.topic_path(cfg.kafka_topic, 2)
+    lines = open(path, "rb").read().splitlines()[:100]
+    with open(path, "wb") as f:
+        f.write(b"".join(l + b"\n" for l in lines))
+
+    r = as_redis(FakeRedisStore())
+    barrier = RedisWindowBarrier(r, "bt", cfg.map_partitions, timeout_s=20)
+    t0 = _time.monotonic()
+    merged, results = run_microbatch(cfg, broker, mapping, campaigns,
+                                     barrier=barrier)
+    assert _time.monotonic() - t0 < 10  # released by abort, not timeout
+    assert len(merged) == 1 and results[2].windows == 1
+
+
+def test_redis_barrier_fresh_run_clears_stale_abort(tmp_path):
+    r = as_redis(FakeRedisStore())
+    r.execute("HSET", "bt", "aborted", "1")  # previous run's broadcast
+    b = RedisWindowBarrier(r, "bt", 1)
+    assert b.arrive(0) > 0  # single partition: owner immediately
+
+
 def test_local_barrier_stamps_shared():
     b = LocalWindowBarrier(4)
     out = [[] for _ in range(4)]
