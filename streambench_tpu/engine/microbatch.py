@@ -78,6 +78,15 @@ class LocalWindowBarrier:
         try:
             self._barrier.wait(self._timeout)
         except threading.BrokenBarrierError:
+            # CPython Barrier race: a peer that already passed this
+            # generation can abort() (end-of-stream) before WE re-check
+            # the barrier state on wake-up, poisoning a generation that
+            # in fact completed.  The stamp discriminates exactly: the
+            # barrier action ran (stamp exists) iff our generation
+            # completed — return it; only a genuinely un-assembled
+            # window falls through.
+            if window_idx in self._stamps:
+                return self._stamps[window_idx]
             if self.ended:
                 raise  # normal end-of-stream release (drive() swallows it)
             # Barrier.wait's own timeout also breaks the barrier; surface
@@ -276,13 +285,18 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
             f"no partition(s) {missing} (found {sorted(have)}); generate "
             f"the dataset with a matching partition count")
     barrier = barrier or LocalWindowBarrier(P)
-    encoder = make_encoder(ad_to_campaign, campaigns,
-                           divisor_ms=cfg.jax_time_divisor_ms,
-                           lateness_ms=cfg.jax_allowed_lateness_ms,
-                           use_native=cfg.jax_use_native_encoder)
-    # one replicated device copy of the join table, shared by all mappers
-    join_table_dev = jnp.asarray(encoder.join_table)
-    mappers = [MicroBatchMapper(cfg, encoder, join_table_dev, barrier, p,
+    # ONE ENCODER PER MAPPER THREAD: encoders carry mutable intern state
+    # (user/page maps, rebase origin) that is not thread-safe — sharing
+    # one across concurrently-encoding partitions silently corrupts
+    # parses (observed as nondeterministic counts).  The join table is
+    # deterministic from the mapping, so one device copy is shared.
+    encoders = [make_encoder(ad_to_campaign, campaigns,
+                             divisor_ms=cfg.jax_time_divisor_ms,
+                             lateness_ms=cfg.jax_allowed_lateness_ms,
+                             use_native=cfg.jax_use_native_encoder)
+                for _ in range(P)]
+    join_table_dev = jnp.asarray(encoders[0].join_table)
+    mappers = [MicroBatchMapper(cfg, encoders[p], join_table_dev, barrier, p,
                                 input_format=input_format)
                for p in range(P)]
     # Warm the kernel before spawning threads: P mappers would otherwise
@@ -292,7 +306,7 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
     window_campaign_counts(
         join_table_dev, np.zeros(psize, np.int32),
         np.full(psize, -1, np.int32), np.zeros(psize, bool),
-        num_campaigns=encoder.num_campaigns).block_until_ready()
+        num_campaigns=encoders[0].num_campaigns).block_until_ready()
 
     limit = max_windows * psize if max_windows else None
     errors: list[BaseException] = []

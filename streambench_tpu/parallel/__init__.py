@@ -1,3 +1,11 @@
+from streambench_tpu.parallel.distributed import (
+    DistContext,
+    DistributedWindowEngine,
+    cross_host_barrier,
+    global_mesh,
+    init_distributed,
+    run_distributed_catchup,
+)
 from streambench_tpu.parallel.mesh import build_mesh, mesh_from_config
 from streambench_tpu.parallel.sharded import (
     ShardedWindowEngine,
@@ -6,8 +14,14 @@ from streambench_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "DistContext",
+    "DistributedWindowEngine",
     "build_mesh",
+    "cross_host_barrier",
+    "global_mesh",
+    "init_distributed",
     "mesh_from_config",
+    "run_distributed_catchup",
     "ShardedWindowEngine",
     "sharded_init_state",
     "sharded_step",
