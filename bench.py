@@ -37,6 +37,11 @@ def log(msg: str) -> None:
 def main() -> int:
     n_events = int(os.environ.get("STREAMBENCH_BENCH_EVENTS", "500000"))
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from streambench_tpu.utils.platform import pin_jax_platform
+
+    pin_jax_platform()  # honor JAX_PLATFORMS even under sitecustomize
+
     import jax
 
     from streambench_tpu.config import default_config
