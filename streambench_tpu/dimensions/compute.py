@@ -228,7 +228,26 @@ class DimensionsComputation:
         """Unifier merge of two partials (the
         ``DimensionsComputationUnifierImpl`` role): elementwise add/max/min
         — associative, so it is also exactly what a cross-device
-        psum/pmax would compute."""
+        psum/pmax would compute.
+
+        Merge is only sound when both partials' ring slots hold the SAME
+        windows — i.e. the partials were folded over the same batch
+        cadence (as the unifier's upstream partitions are).  With divergent
+        watermark progress, a slot could hold window ids w1 != w2 and the
+        elementwise add would silently sum two different windows'
+        aggregates under ``max(w1, w2)``.  That is checked here (one tiny
+        host sync, ADVICE r1): empty slots (-1) merge freely with anything.
+        """
+        ia = np.asarray(a.window_ids)
+        ib = np.asarray(b.window_ids)
+        conflict = (ia >= 0) & (ib >= 0) & (ia != ib)
+        if conflict.any():
+            s = int(np.flatnonzero(conflict)[0])
+            raise ValueError(
+                f"cannot merge partials with divergent ring contents: slot "
+                f"{s} holds window {int(ia[s])} in one partial and "
+                f"{int(ib[s])} in the other; merge partials only across "
+                "the same batch cadence (or flush both first)")
         merged = []
         for x, y, kind in zip(a.aggs, b.aggs, kinds):
             if kind in ("add", "count"):

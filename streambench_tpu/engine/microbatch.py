@@ -105,6 +105,9 @@ class LocalWindowBarrier:
         self.ended = True
         self._barrier.abort()
 
+    def reset(self) -> None:
+        """No-op: a fresh object IS a fresh barrier (state is in-process)."""
+
 
 class RedisWindowBarrier:
     """The fork's Redis barrier, with per-window stamp keys (see module
@@ -115,26 +118,55 @@ class RedisWindowBarrier:
       (``start_new_window``, ``AdvertisingTopologyNative.java:228-238``);
     - owner: ``HSET <table> start_time:<k> now`` (``finish_window``);
     - others: 1 ms-sleep spin on ``HGET start_time:<k>`` (``wait_window``).
+
+    Construction is **side-effect-free**: residue from a prior run
+    (``partition_count`` left by an aborted run's already-arrived spinners,
+    a stale ``aborted`` broadcast) is cleared by ``reset()``, which the run
+    *driver* calls exactly once before any partition starts — a
+    per-partition constructor clear would itself race with peers already
+    arriving, and can erase a live run's end-of-stream broadcast.  (The
+    fork has both flaws and leans on the harness FLUSHALL between runs.)
+    Runs sharing one hashtable can alternatively be isolated with
+    ``run_id``, which namespaces every barrier field.
     """
 
     def __init__(self, redis: RedisLike, hashtable: str, n_partitions: int,
-                 poll_interval_s: float = 0.001, timeout_s: float = 60.0):
+                 poll_interval_s: float = 0.001, timeout_s: float = 60.0,
+                 run_id: str = ""):
         self.redis = redis
         self.table = hashtable
         self.n = n_partitions
         self._poll = poll_interval_s
         self._timeout = timeout_s
-        # a previous run's end-of-stream broadcast must not abort this one
-        self.redis.execute("HDEL", self.table, "aborted")
+        suffix = f":{run_id}" if run_id else ""
+        self._f_count = "partition_count" + suffix
+        self._f_abort = "aborted" + suffix
+        self._f_stamp = "start_time" + suffix
+
+    def reset(self) -> None:
+        """Clear this run's barrier fields.  MUST be called exactly once,
+        by the driver, before any partition can arrive.
+
+        Clears the per-window stamps too: a stale ``start_time:<k>`` from
+        a prior run would satisfy a spinner *instantly* — partitions would
+        stop rendezvousing at all and every event would carry the previous
+        run's stamp (garbage latencies)."""
+        self.redis.execute("HDEL", self.table, self._f_count)
+        self.redis.execute("HDEL", self.table, self._f_abort)
+        prefix = self._f_stamp + ":"
+        flat = (self.redis.hgetall(self.table)
+                if hasattr(self.redis, "hgetall") else {})
+        for name in flat:
+            if name.startswith(prefix):
+                self.redis.execute("HDEL", self.table, name)
 
     def arrive(self, window_idx: int) -> int:
-        if self.redis.execute("HGET", self.table, "aborted") is not None:
+        if self.redis.execute("HGET", self.table, self._f_abort) is not None:
             raise threading.BrokenBarrierError
-        my = int(self.redis.execute("HINCRBY", self.table,
-                                    "partition_count", 1))
-        field_ = f"start_time:{window_idx}"
+        my = int(self.redis.execute("HINCRBY", self.table, self._f_count, 1))
+        field_ = f"{self._f_stamp}:{window_idx}"
         if my == self.n:
-            self.redis.execute("HSET", self.table, "partition_count", "0")
+            self.redis.execute("HSET", self.table, self._f_count, "0")
             stamp = now_ms()
             self.redis.execute("HSET", self.table, field_, str(stamp))
             return stamp
@@ -142,7 +174,7 @@ class RedisWindowBarrier:
         while True:
             res, ab = self.redis.pipeline_execute(
                 [("HGET", self.table, field_),
-                 ("HGET", self.table, "aborted")])
+                 ("HGET", self.table, self._f_abort)])
             if res is not None:
                 return int(res)
             if ab is not None:
@@ -157,7 +189,7 @@ class RedisWindowBarrier:
     def abort(self) -> None:
         """End-of-stream broadcast: release peers parked in ``arrive``
         (their in-flight window is dropped, matching the local barrier)."""
-        self.redis.execute("HSET", self.table, "aborted", "1")
+        self.redis.execute("HSET", self.table, self._f_abort, "1")
 
 
 # ----------------------------------------------------------------------
@@ -285,6 +317,9 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
             f"no partition(s) {missing} (found {sorted(have)}); generate "
             f"the dataset with a matching partition count")
     barrier = barrier or LocalWindowBarrier(P)
+    # THE single reset point (see RedisWindowBarrier docstring): clear any
+    # prior run's residue before the first partition can arrive.
+    barrier.reset()
     # ONE ENCODER PER MAPPER THREAD: encoders carry mutable intern state
     # (user/page maps, rebase origin) that is not thread-safe — sharing
     # one across concurrently-encoding partitions silently corrupts

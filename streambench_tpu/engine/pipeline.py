@@ -36,9 +36,21 @@ from streambench_tpu.trace import Tracer
 from streambench_tpu.utils.ids import now_ms
 
 
-def default_method() -> str:
-    """Scatter-add on CPU; one-hot reduction on TPU (MXU-friendly)."""
-    return "onehot" if jax.default_backend() == "tpu" else "scatter"
+# One-hot materializes a [B, C*W] comparison per step — MXU-friendly while
+# C*W is a few thousand cells (C=100 campaigns x W=16 slots = 1,600) but
+# catastrophic at BASELINE config #5's C=1e6 (a [1024, 1.6e7] intermediate
+# per step).  Above this cell bound scatter-add always wins.
+ONEHOT_MAX_CELLS = 32_768
+
+
+def default_method(num_cells: int | None = None) -> str:
+    """Scatter-add on CPU or for large state; one-hot reduction on TPU
+    (MXU-friendly) while ``num_cells = C*W`` stays under the bound."""
+    if jax.default_backend() not in ("tpu", "axon"):
+        return "scatter"
+    if num_cells is not None and num_cells > ONEHOT_MAX_CELLS:
+        return "scatter"
+    return "onehot"
 
 
 class AdAnalyticsEngine:
@@ -55,7 +67,6 @@ class AdAnalyticsEngine:
                  input_format: str = "json"):
         self.cfg = cfg
         self.redis = redis
-        self.method = method or default_method()
         self.divisor = cfg.jax_time_divisor_ms
         self.lateness = cfg.jax_allowed_lateness_ms
         self.encoder = make_encoder(ad_to_campaign, campaigns,
@@ -64,6 +75,8 @@ class AdAnalyticsEngine:
                                     use_native=cfg.jax_use_native_encoder)
         self.join_table = jnp.asarray(self.encoder.join_table)
         self.W = cfg.jax_window_slots
+        self.method = method or default_method(
+            self.encoder.num_campaigns * self.W)
         self.batch_size = cfg.jax_batch_size
         self._encode = (self.encoder.encode if input_format == "json"
                         else self.encoder.encode_tbl)

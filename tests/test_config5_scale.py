@@ -1,0 +1,112 @@
+"""BASELINE config #5 at scale: 1e6 campaigns, campaign-sharded state.
+
+The reference scales keyed state by hash-routing events to the worker
+owning each campaign (``AdvertisingTopology.java:232-233``); here the
+campaign axis of the mesh owns a contiguous shard of the [C, W] count
+state and no event moves.  This test proves the sharded engine is exact
+at C=1e6 (the multi-tenant operating point) — on the virtual CPU mesh
+for correctness, exactly like the reference's embedded-cluster test
+(SURVEY.md §4.3) — and that ``default_method`` refuses the one-hot
+formulation at this scale (it would materialize a [B, 1.6e7]
+intermediate per step).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from streambench_tpu.engine.pipeline import ONEHOT_MAX_CELLS, default_method
+from streambench_tpu.ops import windowcount as wc
+from streambench_tpu.parallel import (
+    build_mesh,
+    sharded_init_state,
+    sharded_step,
+)
+from streambench_tpu.parallel.sharded import pad_campaigns
+
+C_BIG = 1_000_003  # deliberately not divisible: exercises pad_campaigns
+W = 8
+DIV = 10_000
+LATE = 20_000
+
+
+def test_default_method_scales_by_cells():
+    # Small state may pick either formulation; big state must never
+    # pick one-hot regardless of backend.
+    assert default_method(C_BIG * W) == "scatter"
+    assert default_method(ONEHOT_MAX_CELLS + 1) == "scatter"
+    assert default_method() in ("scatter", "onehot")
+
+
+def test_million_campaign_sharded_exact():
+    mesh = build_mesh(data=2, campaign=4, devices=jax.devices()[:8])
+    C_pad = pad_campaigns(C_BIG, mesh)
+    assert C_pad >= C_BIG and C_pad % 4 == 0
+
+    rng = np.random.default_rng(11)
+    n_ads = 50_000
+    B = 512
+    # Ads map across the whole campaign range (including the top end, so
+    # the padded tail stays empty but the last real shard is exercised).
+    join = np.concatenate([
+        rng.integers(0, C_BIG, n_ads).astype(np.int32), [-1]])
+    join[0] = C_BIG - 1
+
+    state = sharded_init_state(C_BIG, W, mesh)
+    assert state.counts.shape == (C_pad, W)
+
+    expected = {}
+    t = 70_000
+    for _ in range(4):
+        ad = rng.integers(0, n_ads, B).astype(np.int32)
+        et = rng.integers(0, 3, B).astype(np.int32)
+        tm = (t + np.sort(rng.integers(0, 15_000, B))).astype(np.int32)
+        valid = rng.random(B) < 0.95
+        # Pin one guaranteed view on ad 0 -> campaign C_BIG-1, so the
+        # last shard's top row is provably exercised.
+        ad[0], et[0], valid[0] = 0, 0, True
+        t += 15_000
+        state = sharded_step(mesh, state, join, ad, et, tm, valid,
+                             divisor_ms=DIV, lateness_ms=LATE)
+        for a, e, ts, v in zip(ad.tolist(), et.tolist(), tm.tolist(),
+                               valid.tolist()):
+            c = int(join[a])
+            if v and e == 0 and c >= 0:
+                key = (c, ts // DIV)
+                expected[key] = expected.get(key, 0) + 1
+
+    deltas, wids, state = wc.flush_deltas(state, divisor_ms=DIV,
+                                          lateness_ms=LATE)
+    deltas = np.asarray(deltas)
+    wids = np.asarray(wids)
+    got = {}
+    ci, si = np.nonzero(deltas)
+    for c, s in zip(ci.tolist(), si.tolist()):
+        assert wids[s] >= 0
+        got[(c, int(wids[s]))] = int(deltas[c, s])
+    # No drops happened (event-time span stayed well inside the ring),
+    # so the oracle must match exactly — including campaign C_BIG-1.
+    assert int(state.dropped) == 0
+    assert got == expected
+    assert any(c == C_BIG - 1 for c, _ in got)
+
+
+def test_scatter_and_onehot_bit_identical_small():
+    # The method choice is a performance decision only; both formulations
+    # must agree bit-for-bit wherever one-hot is legal.
+    rng = np.random.default_rng(3)
+    C, n_ads, B = 64, 200, 128
+    join = np.concatenate([rng.integers(0, C, n_ads).astype(np.int32), [-1]])
+    args = (
+        np.asarray(rng.integers(0, n_ads, B), np.int32),
+        np.asarray(rng.integers(0, 3, B), np.int32),
+        np.asarray(np.sort(rng.integers(70_000, 150_000, B)), np.int32),
+        rng.random(B) < 0.9,
+    )
+    s1 = wc.step(wc.init_state(C, W), join, *args, divisor_ms=DIV,
+                 lateness_ms=LATE, method="scatter")
+    s2 = wc.step(wc.init_state(C, W), join, *args, divisor_ms=DIV,
+                 lateness_ms=LATE, method="onehot")
+    np.testing.assert_array_equal(np.asarray(s1.counts), np.asarray(s2.counts))
+    np.testing.assert_array_equal(np.asarray(s1.window_ids),
+                                  np.asarray(s2.window_ids))

@@ -171,6 +171,27 @@ def test_unifier_merge_equals_single_fold():
     assert int(whole.watermark) == int(merged.watermark)
 
 
+def test_unifier_merge_rejects_divergent_ring_contents():
+    """ADVICE r1: if two partials' rings hold DIFFERENT window ids in the
+    same slot (divergent watermark progress), an elementwise merge would
+    silently sum two windows' aggregates — it must refuse instead."""
+    dc = DimensionsComputation(SCHEMA, num_keys=3, window_slots=4,
+                               lateness_ms=20_000)
+    key = np.zeros(8, np.int32)
+    valid = np.ones(8, bool)
+    vals = {"clicks": np.ones(8, np.int32),
+            "latency": np.ones(8, np.int32)}
+    # windows 5..8 and 9..12 share ring slots (W=4) under different ids
+    t1 = (50_000 + np.arange(8, dtype=np.int32) * 4_000)
+    t2 = (90_000 + np.arange(8, dtype=np.int32) * 4_000)
+    h1 = dc.step(dc.init_state(), key, t1, valid, vals)
+    h2 = dc.step(dc.init_state(), key, t2, valid, vals)
+    with pytest.raises(ValueError, match="divergent ring contents"):
+        DimensionsComputation.merge(h1, h2, dc.kinds)
+    # empty slots merge freely: a fresh partial is always mergeable
+    DimensionsComputation.merge(h1, dc.init_state(), dc.kinds)
+
+
 # -------------------------------------------------- synthetic + interner
 def test_synthetic_source_interner_and_overflow():
     from streambench_tpu.dimensions.synthetic import run_synthetic

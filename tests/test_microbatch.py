@@ -178,11 +178,48 @@ def test_unequal_partitions_redis_barrier_no_timeout(tmp_path):
     assert len(merged) == 1 and results[2].windows == 1
 
 
-def test_redis_barrier_fresh_run_clears_stale_abort(tmp_path):
+def test_redis_barrier_reset_clears_stale_run_residue(tmp_path):
+    """ADVICE r1 (medium): an aborted run leaves partition_count residue
+    (every spinner had HINCRBY'd) and an aborted broadcast in the shared
+    hashtable; the driver-side reset() — NOT a per-partition constructor
+    clear — must scrub both, or a rerun mis-elects window owners."""
     r = as_redis(FakeRedisStore())
-    r.execute("HSET", "bt", "aborted", "1")  # previous run's broadcast
+    # previous aborted run: 2 of 3 partitions had arrived, plus broadcast,
+    # plus completed-window stamps (a stale stamp would satisfy a spinner
+    # instantly, so partitions would stop rendezvousing at all)
+    r.execute("HSET", "bt", "partition_count", "2")
+    r.execute("HSET", "bt", "aborted", "1")
+    r.execute("HSET", "bt", "start_time:0", "12345")
     b = RedisWindowBarrier(r, "bt", 1)
-    assert b.arrive(0) > 0  # single partition: owner immediately
+    b.reset()  # the single driver-side reset point
+    assert r.hget("bt", "start_time:0") is None
+    stamp = b.arrive(0)  # single partition: owner immediately
+    assert stamp > 12345  # a fresh stamp, not the stale one
+    # owner election happened at count==1, not at stale 2+1
+    assert r.hget("bt", "partition_count") == "0"
+
+
+def test_redis_barrier_construction_has_no_side_effects(tmp_path):
+    """ADVICE r1 (low): a late partition's constructor must not erase a
+    live run's end-of-stream broadcast."""
+    r = as_redis(FakeRedisStore())
+    r.execute("HSET", "bt", "aborted", "1")  # live broadcast from a peer
+    RedisWindowBarrier(r, "bt", 3)  # late construction
+    assert r.hget("bt", "aborted") == "1"  # broadcast survives
+
+
+def test_redis_barrier_run_id_namespaces_fields(tmp_path):
+    """Two runs sharing a hashtable but distinct run_ids can't see each
+    other's counter, stamps, or abort broadcast."""
+    r = as_redis(FakeRedisStore())
+    a = RedisWindowBarrier(r, "bt", 1, run_id="runA")
+    z = RedisWindowBarrier(r, "bt", 1, run_id="runZ")
+    a.reset()
+    z.reset()
+    a.abort()  # run A ends
+    assert z.arrive(0) > 0  # run Z is unaffected
+    assert r.hget("bt", "start_time:runZ:0") is not None
+    assert r.hget("bt", "aborted:runA") == "1"
 
 
 def test_local_barrier_stamps_shared():
