@@ -62,6 +62,10 @@ class Snapshot:
     dropped: int
     pending: list[tuple[int, int, int]] = field(default_factory=list)
     latency: list[tuple[int, int]] = field(default_factory=list)
+    # Engine-specific payload (sketch engines: HLL registers, t-digest
+    # centroids, CMS table, session carries, intern tables).  Arrays of
+    # any dtype incl. bytes ("S*"); round-trips through the npz untouched.
+    extra: dict[str, np.ndarray] = field(default_factory=dict)
 
     @property
     def seq(self) -> int:
@@ -75,13 +79,16 @@ def _encode(snapshot: Snapshot) -> dict:
     meta.update(version=FORMAT_VERSION, offset=int(snapshot.offset),
                 watermark=int(snapshot.watermark),
                 dropped=int(snapshot.dropped))
-    return dict(
+    out = dict(
         counts=np.asarray(snapshot.counts, np.int32),
         window_ids=np.asarray(snapshot.window_ids, np.int32),
         pending=pending,
         latency=latency,
         meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
     )
+    for name, arr in snapshot.extra.items():
+        out[f"x_{name}"] = np.asarray(arr)
+    return out
 
 
 def _decode(z) -> Snapshot:
@@ -99,6 +106,8 @@ def _decode(z) -> Snapshot:
         dropped=int(meta["dropped"]),
         pending=[tuple(r) for r in z["pending"].tolist()],
         latency=[tuple(r) for r in z["latency"].tolist()],
+        extra={name[2:]: z[name] for name in z.files
+               if name.startswith("x_")},
     )
 
 

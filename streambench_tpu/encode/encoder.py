@@ -114,6 +114,26 @@ class EventEncoder:
         events against the *same* base or its ring slots would shift."""
         self.base_time_ms = base_time_ms
 
+    # -- intern-table snapshot (checkpoint/resume for sketch engines) --
+    def dump_intern_tables(self) -> tuple[list[bytes], list[bytes]]:
+        """User/page id keys in INDEX ORDER.  Sketch state keyed by
+        interned indices (HLL register hashes, CMS/session rows) is only
+        restorable if a resumed encoder re-assigns identical indices."""
+        # _intern only appends (idx == len(table)), so dict insertion
+        # order IS index order — no sort needed on the checkpoint path.
+        return list(self.user_index), list(self.page_index)
+
+    def restore_intern_tables(self, users: list[bytes],
+                              pages: list[bytes]) -> None:
+        """Re-intern dumped keys; indices land exactly as dumped."""
+        if self.user_index or self.page_index:
+            raise ValueError(
+                "restore_intern_tables on a used encoder: intern indices "
+                "would diverge from the snapshot; restore into a fresh "
+                "engine instead")
+        self.user_index = {bytes(u): i for i, u in enumerate(users)}
+        self.page_index = {bytes(p): i for i, p in enumerate(pages)}
+
     # -- interning helpers --------------------------------------------
     def _intern(self, table: dict[bytes, int], key: bytes) -> int:
         idx = table.get(key)

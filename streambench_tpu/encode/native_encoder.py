@@ -52,6 +52,42 @@ class NativeEventEncoder(EventEncoder):
         if base_time_ms is not None:
             self._lib.sb_encoder_set_base_time(self._enc, base_time_ms)
 
+    def dump_intern_tables(self) -> tuple[list[bytes], list[bytes]]:
+        out = []
+        for n_fn, bytes_fn, dump_fn in (
+                (self._lib.sb_encoder_n_users,
+                 self._lib.sb_encoder_users_bytes,
+                 self._lib.sb_encoder_dump_users),
+                (self._lib.sb_encoder_n_pages,
+                 self._lib.sb_encoder_pages_bytes,
+                 self._lib.sb_encoder_dump_pages)):
+            n = int(n_fn(self._enc))
+            buf = ctypes.create_string_buffer(max(int(bytes_fn(self._enc)), 1))
+            offsets = np.zeros(n + 1, np.int64)
+            dump_fn(self._enc, buf,
+                    offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            raw = buf.raw
+            out.append([raw[offsets[i]:offsets[i + 1]] for i in range(n)])
+        return out[0], out[1]
+
+    def restore_intern_tables(self, users: list[bytes],
+                              pages: list[bytes]) -> None:
+        if self._lib.sb_encoder_n_users(self._enc) or \
+                self._lib.sb_encoder_n_pages(self._enc):
+            raise ValueError(
+                "restore_intern_tables on a used encoder: intern indices "
+                "would diverge from the snapshot; restore into a fresh "
+                "engine instead")
+        for table, fn, keys in (("user", self._lib.sb_intern_user, users),
+                                ("page", self._lib.sb_intern_page, pages)):
+            for i, k in enumerate(keys):
+                got = fn(self._enc, bytes(k), len(k))
+                if got != i:
+                    raise ValueError(
+                        f"{table} intern diverged on restore: key {k!r} "
+                        f"re-interned to {got}, snapshot says {i} "
+                        "(duplicate or corrupted dump?)")
+
     def __del__(self):  # pragma: no cover - interpreter teardown order
         lib = getattr(self, "_lib", None)
         enc = getattr(self, "_enc", None)

@@ -59,6 +59,11 @@ class AdAnalyticsEngine:
     # Subclasses whose pending values are absolute snapshots (not deltas)
     # set this so the Redis writer HSETs instead of HINCRBYs.
     absolute_counts = False
+    # Checkpoint compatibility class: restore refuses a snapshot from a
+    # different family (engines with different device state would silently
+    # misinterpret each other's arrays).  The sharded engine shares
+    # "exact" with the base deliberately — its state is the same counts.
+    ENGINE_FAMILY = "exact"
 
     def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
                  campaigns: list[str] | None = None,
@@ -226,24 +231,29 @@ class AdAnalyticsEngine:
     # ------------------------------------------------------------------
     # checkpoint/resume (SURVEY.md §5.4 — absent in the reference; the
     # scan carry is fixed-shape arrays, so a snapshot is one savez)
+    def _snapshot_meta(self) -> dict:
+        """Host-side meta shared by every engine family's snapshot."""
+        return dict(
+            engine_family=self.ENGINE_FAMILY,
+            base_time_ms=self.encoder.base_time_ms,
+            divisor_ms=self.divisor,
+            lateness_ms=self.lateness,
+            window_slots=self.W,
+            span_start=self._span_start,
+            events_processed=self.events_processed,
+            windows_written=self.windows_written,
+            started_ms=self.started_ms,
+            last_event_ms=self.last_event_ms,
+            num_campaigns=self.encoder.num_campaigns,
+        )
+
     def snapshot(self, offset: int) -> "Snapshot":
         """Capture exact engine state as of journal byte ``offset``."""
         from streambench_tpu.checkpoint import Snapshot
 
         return Snapshot(
             offset=offset,
-            meta=dict(
-                base_time_ms=self.encoder.base_time_ms,
-                divisor_ms=self.divisor,
-                lateness_ms=self.lateness,
-                window_slots=self.W,
-                span_start=self._span_start,
-                events_processed=self.events_processed,
-                windows_written=self.windows_written,
-                started_ms=self.started_ms,
-                last_event_ms=self.last_event_ms,
-                num_campaigns=self.encoder.num_campaigns,
-            ),
+            meta=self._snapshot_meta(),
             counts=np.asarray(self.state.counts),
             window_ids=np.asarray(self.state.window_ids),
             watermark=int(self.state.watermark),
@@ -252,25 +262,33 @@ class AdAnalyticsEngine:
             latency=sorted(self.window_latency.items()),
         )
 
-    def restore(self, snap: "Snapshot") -> None:
-        """Reset this engine to a snapshot; caller re-tails the journal at
-        ``snap.offset``."""
-        for key, mine in (("num_campaigns", self.encoder.num_campaigns),
-                          ("divisor_ms", self.divisor),
-                          ("lateness_ms", self.lateness),
-                          ("window_slots", self.W)):
-            # Ring geometry must match exactly: window ids are relative to
-            # divisor and base, slots to W — reinterpreting either silently
-            # corrupts counts (the span guard would be sized for the wrong
-            # ring).
+    def _check_geometry(self, snap: "Snapshot",
+                        extra: dict[str, int] | None = None) -> None:
+        """Family + ring-geometry validation.  Window ids are relative to
+        divisor and base, slots to W — reinterpreting any of them silently
+        corrupts counts (the span guard would be sized for the wrong
+        ring), so a mismatch is a hard error, never a best-effort load."""
+        fam = snap.meta.get("engine_family", "exact")
+        if fam != self.ENGINE_FAMILY:
+            raise ValueError(
+                f"checkpoint was written by engine family {fam!r}; this "
+                f"engine is {self.ENGINE_FAMILY!r} — device state is not "
+                "interchangeable across families")
+        checks = dict(num_campaigns=self.encoder.num_campaigns,
+                      divisor_ms=self.divisor,
+                      lateness_ms=self.lateness,
+                      window_slots=self.W)
+        checks.update(extra or {})
+        for key, mine in checks.items():
             if int(snap.meta[key]) != mine:
                 raise ValueError(
                     f"checkpoint {key}={snap.meta[key]} != engine {mine}; "
                     "restart with the original config or discard the "
                     "checkpoint")
+
+    def _restore_host(self, snap: "Snapshot") -> None:
+        """Re-establish every host-side field from snapshot meta."""
         self.encoder.set_base_time(snap.meta["base_time_ms"])
-        self.state = self._put_state(
-            snap.counts, snap.window_ids, snap.watermark, snap.dropped)
         self._span_start = snap.meta["span_start"]
         self.events_processed = int(snap.meta["events_processed"])
         self.windows_written = int(snap.meta["windows_written"])
@@ -280,6 +298,14 @@ class AdAnalyticsEngine:
         for c, ts, n in snap.pending:
             self._pending[(int(c), int(ts))] = int(n)
         self.window_latency = {int(ts): int(v) for ts, v in snap.latency}
+
+    def restore(self, snap: "Snapshot") -> None:
+        """Reset this engine to a snapshot; caller re-tails the journal at
+        ``snap.offset``."""
+        self._check_geometry(snap)
+        self.state = self._put_state(
+            snap.counts, snap.window_ids, snap.watermark, snap.dropped)
+        self._restore_host(snap)
 
     def _put_state(self, counts, window_ids, watermark, dropped):
         """Place restored host arrays on device (subclass hook: the sharded

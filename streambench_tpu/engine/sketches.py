@@ -33,18 +33,47 @@ from streambench_tpu.utils.ids import now_ms
 
 
 class _SketchEngineBase(AdAnalyticsEngine):
-    """Shared plumbing: sketch engines keep their own device state and
-    cannot reuse the exact-count checkpoint snapshot (its arrays are the
-    ``WindowState`` counts)."""
+    """Shared checkpoint plumbing for sketch engines.
 
-    def snapshot(self, offset: int):
-        raise NotImplementedError(
-            f"{type(self).__name__} does not support checkpointing yet; "
-            "run without --checkpointDir")
+    Sketch state is keyed by *interned* user/page indices (HLL register
+    hashes, session rows, CMS columns), so every snapshot also carries the
+    encoder's intern tables — a resumed encoder must re-assign identical
+    indices or restored sketch contents would silently drift (the
+    exact-count engine never needed this; its state is keyed by campaign,
+    which is fixed up front).  Resume semantics match the base engine:
+    at-least-once relative to the journal offset
+    (``AdvertisingTopologyNative.java:92`` / ``checkpoint.py``).
+    """
 
-    def restore(self, snap):
-        raise NotImplementedError(
-            f"{type(self).__name__} does not support checkpointing yet")
+    @staticmethod
+    def _pack_keys(keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+        """Concatenated uint8 blob + int64 offsets.  NOT an "S"-dtype
+        array: numpy's fixed-width bytes strip trailing NULs, which would
+        corrupt ids and collapse distinct keys on restore."""
+        blob = b"".join(keys)
+        offs = np.zeros(len(keys) + 1, np.int64)
+        np.cumsum([len(k) for k in keys], out=offs[1:])
+        return np.frombuffer(blob, np.uint8) if blob else \
+            np.zeros(0, np.uint8), offs
+
+    @staticmethod
+    def _unpack_keys(blob: np.ndarray, offs: np.ndarray) -> list[bytes]:
+        raw = blob.tobytes()
+        return [raw[offs[i]:offs[i + 1]] for i in range(len(offs) - 1)]
+
+    def _intern_extra(self) -> dict:
+        users, pages = self.encoder.dump_intern_tables()
+        ub, uo = self._pack_keys(users)
+        pb, po = self._pack_keys(pages)
+        return {"user_blob": ub, "user_offs": uo,
+                "page_blob": pb, "page_offs": po}
+
+    def _restore_interns(self, snap) -> None:
+        self.encoder.restore_intern_tables(
+            self._unpack_keys(snap.extra["user_blob"],
+                              snap.extra["user_offs"]),
+            self._unpack_keys(snap.extra["page_blob"],
+                              snap.extra["page_offs"]))
 
 
 class HLLDistinctEngine(_SketchEngineBase):
@@ -76,6 +105,35 @@ class HLLDistinctEngine(_SketchEngineBase):
             jnp.asarray(batch.event_type), jnp.asarray(batch.event_time),
             jnp.asarray(batch.valid),
             divisor_ms=self.divisor, lateness_ms=self.lateness)
+
+    ENGINE_FAMILY = "hll"
+
+    def snapshot(self, offset: int):
+        from streambench_tpu.checkpoint import Snapshot
+
+        meta = self._snapshot_meta()
+        meta["num_registers"] = self.registers
+        return Snapshot(
+            offset=offset, meta=meta,
+            counts=np.zeros((0, 0), np.int32),  # registers live in extra
+            window_ids=np.asarray(self.state.window_ids),
+            watermark=int(self.state.watermark),
+            dropped=int(self.state.dropped),
+            pending=[(c, ts, n) for (c, ts), n in self._pending.items()],
+            latency=sorted(self.window_latency.items()),
+            extra={"hll_registers": np.asarray(self.state.registers),
+                   **self._intern_extra()},
+        )
+
+    def restore(self, snap) -> None:
+        self._check_geometry(snap, extra={"num_registers": self.registers})
+        self.state = hll.HLLState(
+            registers=jnp.asarray(snap.extra["hll_registers"]),
+            window_ids=jnp.asarray(snap.window_ids),
+            watermark=jnp.int32(snap.watermark),
+            dropped=jnp.int32(snap.dropped))
+        self._restore_interns(snap)
+        self._restore_host(snap)
 
     def _drain_device(self) -> None:
         est, wids, self.state = hll.flush(
@@ -138,6 +196,39 @@ class SlidingTDigestEngine(_SketchEngineBase):
         self.base_lateness = cfg.jax_allowed_lateness_ms
         self.digest = tdigest.init_state(self.encoder.num_campaigns,
                                          compression=compression)
+
+    ENGINE_FAMILY = "sliding_tdigest"
+
+    def snapshot(self, offset: int):
+        from streambench_tpu.checkpoint import Snapshot
+
+        meta = self._snapshot_meta()
+        meta.update(size_ms=self.size_ms, slide_ms=self.slide_ms,
+                    compression=int(self.digest.means.shape[1]))
+        return Snapshot(
+            offset=offset, meta=meta,
+            counts=np.asarray(self.state.counts),
+            window_ids=np.asarray(self.state.window_ids),
+            watermark=int(self.state.watermark),
+            dropped=int(self.state.dropped),
+            pending=[(c, ts, n) for (c, ts), n in self._pending.items()],
+            latency=sorted(self.window_latency.items()),
+            extra={"td_means": np.asarray(self.digest.means),
+                   "td_weights": np.asarray(self.digest.weights),
+                   **self._intern_extra()},
+        )
+
+    def restore(self, snap) -> None:
+        self._check_geometry(snap, extra=dict(
+            size_ms=self.size_ms, slide_ms=self.slide_ms,
+            compression=int(self.digest.means.shape[1])))
+        self.state = self._put_state(
+            snap.counts, snap.window_ids, snap.watermark, snap.dropped)
+        self.digest = tdigest.TDigestState(
+            means=jnp.asarray(snap.extra["td_means"]),
+            weights=jnp.asarray(snap.extra["td_weights"]))
+        self._restore_interns(snap)
+        self._restore_host(snap)
 
     def _device_step(self, batch) -> None:
         ad = jnp.asarray(batch.ad_idx)
@@ -204,6 +295,50 @@ class SessionCMSEngine(_SketchEngineBase):
         self.cms = cms.init_state(depth=cms_depth, width=cms_width)
         self.sessions_closed = 0
         self.session_clicks = 0
+
+    ENGINE_FAMILY = "session_cms"
+
+    def snapshot(self, offset: int):
+        from streambench_tpu.checkpoint import Snapshot
+
+        meta = self._snapshot_meta()
+        meta.update(gap_ms=self.gap_ms, user_capacity=self.user_capacity,
+                    cms_depth=int(self.cms.table.shape[0]),
+                    cms_width=int(self.cms.table.shape[1]),
+                    cms_total=int(self.cms.total),
+                    sessions_closed=self.sessions_closed,
+                    session_clicks=self.session_clicks)
+        return Snapshot(
+            offset=offset, meta=meta,
+            counts=np.zeros((0, 0), np.int32),
+            window_ids=np.zeros((0,), np.int32),  # no window ring here
+            watermark=int(self.state.watermark),
+            dropped=int(self.state.dropped),
+            extra={"sess_last": np.asarray(self.state.last_time),
+                   "sess_start": np.asarray(self.state.sess_start),
+                   "sess_clicks": np.asarray(self.state.clicks),
+                   "cms_table": np.asarray(self.cms.table),
+                   **self._intern_extra()},
+        )
+
+    def restore(self, snap) -> None:
+        self._check_geometry(snap, extra=dict(
+            gap_ms=self.gap_ms, user_capacity=self.user_capacity,
+            cms_depth=int(self.cms.table.shape[0]),
+            cms_width=int(self.cms.table.shape[1])))
+        self.state = session.SessionState(
+            last_time=jnp.asarray(snap.extra["sess_last"]),
+            sess_start=jnp.asarray(snap.extra["sess_start"]),
+            clicks=jnp.asarray(snap.extra["sess_clicks"]),
+            watermark=jnp.int32(snap.watermark),
+            dropped=jnp.int32(snap.dropped))
+        self.cms = cms.CMSState(
+            table=jnp.asarray(snap.extra["cms_table"]),
+            total=jnp.int32(snap.meta["cms_total"]))
+        self.sessions_closed = int(snap.meta["sessions_closed"])
+        self.session_clicks = int(snap.meta["session_clicks"])
+        self._restore_interns(snap)
+        self._restore_host(snap)
 
     def _absorb(self, closed: session.ClosedSessions) -> None:
         self.cms = cms.update(self.cms, closed.user, closed.clicks,

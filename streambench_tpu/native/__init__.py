@@ -34,6 +34,14 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sb_encoder_n_users.argtypes = [c_p]
     lib.sb_encoder_n_pages.restype = c_i64
     lib.sb_encoder_n_pages.argtypes = [c_p]
+    lib.sb_encoder_users_bytes.restype = c_i64
+    lib.sb_encoder_users_bytes.argtypes = [c_p]
+    lib.sb_encoder_pages_bytes.restype = c_i64
+    lib.sb_encoder_pages_bytes.argtypes = [c_p]
+    lib.sb_encoder_dump_users.argtypes = [
+        c_p, ctypes.c_char_p, ctypes.POINTER(c_i64)]
+    lib.sb_encoder_dump_pages.argtypes = [
+        c_p, ctypes.c_char_p, ctypes.POINTER(c_i64)]
     lib.sb_intern_user.restype = ctypes.c_int32
     lib.sb_intern_user.argtypes = [c_p, ctypes.c_char_p, c_i64]
     lib.sb_intern_page.restype = ctypes.c_int32

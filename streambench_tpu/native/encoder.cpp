@@ -29,6 +29,31 @@ struct StringInterner {
     if (r.second) ++next;
     return r.first->second;
   }
+
+  // Total key bytes (for sizing a dump buffer).
+  int64_t total_bytes() const {
+    int64_t n = 0;
+    for (const auto& kv : map) n += static_cast<int64_t>(kv.first.size());
+    return n;
+  }
+
+  // Write keys concatenated in INDEX ORDER into buf; offsets[next+1]
+  // gets the cumulative byte offsets.  Index order is what lets a
+  // restore re-intern the keys and land on identical indices — the
+  // checkpoint/resume contract for sketch state keyed by interned ids.
+  void dump(char* buf, int64_t* offsets) const {
+    std::vector<const std::string*> by_idx(static_cast<size_t>(next));
+    for (const auto& kv : map) by_idx[static_cast<size_t>(kv.second)] =
+        &kv.first;
+    int64_t off = 0;
+    offsets[0] = 0;
+    for (int32_t i = 0; i < next; ++i) {
+      const std::string& s = *by_idx[static_cast<size_t>(i)];
+      std::memcpy(buf + off, s.data(), s.size());
+      off += static_cast<int64_t>(s.size());
+      offsets[i + 1] = off;
+    }
+  }
 };
 
 struct Encoder {
@@ -110,6 +135,24 @@ int64_t sb_encoder_n_users(void* enc) {
 
 int64_t sb_encoder_n_pages(void* enc) {
   return static_cast<Encoder*>(enc)->pages.next;
+}
+
+int64_t sb_encoder_users_bytes(void* enc) {
+  return static_cast<Encoder*>(enc)->users.total_bytes();
+}
+
+int64_t sb_encoder_pages_bytes(void* enc) {
+  return static_cast<Encoder*>(enc)->pages.total_bytes();
+}
+
+// Dump intern tables in index order (see StringInterner::dump): buf must
+// hold *_bytes() bytes, offsets must hold n_*+1 int64s.
+void sb_encoder_dump_users(void* enc, char* buf, int64_t* offsets) {
+  static_cast<Encoder*>(enc)->users.dump(buf, offsets);
+}
+
+void sb_encoder_dump_pages(void* enc, char* buf, int64_t* offsets) {
+  static_cast<Encoder*>(enc)->pages.dump(buf, offsets);
 }
 
 // Intern one id through the same maps the fast path uses, so Python

@@ -1,0 +1,177 @@
+"""Checkpoint/resume for the sketch engines (BASELINE configs #2-#4).
+
+Each test kills a run partway (engine object discarded), restores a FRESH
+engine from the newest snapshot, finishes the stream, and requires the
+final output to equal an uninterrupted run of the same engine — bit-for-bit
+where the aggregation is batch-invariant (HLL register maxes, CMS adds,
+sliding counts).  That is a stronger property than the reference offers:
+its only resume story is re-reading from the earliest Kafka offset
+(``AdvertisingTopologyNative.java:92``, ``AdvertisingSpark.scala:64``).
+
+The intern-table round-trip is the load-bearing part: HLL hashes and CMS
+rows are keyed by *interned* user indices, so a resumed encoder must
+re-assign identical indices (see ``_SketchEngineBase``).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from streambench_tpu.checkpoint import Checkpointer
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine import StreamRunner
+from streambench_tpu.engine.sketches import (
+    HLLDistinctEngine,
+    SessionCMSEngine,
+    SlidingTDigestEngine,
+)
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis, read_seen_counts
+
+
+def setup_run(tmp_path, events=8000, batch=512):
+    cfg = default_config(jax_batch_size=batch)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=events,
+                 rng=random.Random(77), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    return cfg, broker, mapping
+
+
+def crash_and_resume(tmp_path, cfg, broker, mapping, make_engine,
+                     crash_after=4000):
+    """Run to ``crash_after`` events with checkpointing, discard the
+    engine, restore a fresh one, finish.  Returns the resumed engine and
+    its redis."""
+    ckdir = str(tmp_path / "ckpt")
+    r = as_redis(FakeRedisStore())
+
+    eng1 = make_engine(cfg, mapping, r)
+    run1 = StreamRunner(eng1, broker.reader(cfg.kafka_topic),
+                        checkpointer=Checkpointer(ckdir),
+                        checkpoint_interval_ms=0)
+    run1.run_catchup(max_events=crash_after)
+    # crash: eng1 is gone; its last checkpoint (written at run_catchup
+    # exit) survives
+
+    eng2 = make_engine(cfg, mapping, r)
+    run2 = StreamRunner(eng2, broker.reader(cfg.kafka_topic),
+                        checkpointer=Checkpointer(ckdir),
+                        checkpoint_interval_ms=0)
+    assert run2.resume(), "no snapshot found to resume from"
+    assert eng2.events_processed == eng1.events_processed
+    run2.run_catchup()
+    eng2.close()
+    return eng2, r
+
+
+def uninterrupted(cfg, broker, mapping, make_engine):
+    r = as_redis(FakeRedisStore())
+    eng = make_engine(cfg, mapping, r)
+    StreamRunner(eng, broker.reader(cfg.kafka_topic)).run_catchup()
+    eng.close()
+    return eng, r
+
+
+def test_hll_kill_resume_equals_uninterrupted(tmp_path):
+    cfg, broker, mapping = setup_run(tmp_path)
+    mk = lambda c, m, r: HLLDistinctEngine(c, m, redis=r, registers=128)
+    base_eng, base_r = uninterrupted(cfg, broker, mapping, mk)
+    res_eng, res_r = crash_and_resume(tmp_path, cfg, broker, mapping, mk)
+    assert res_eng.dropped == 0
+    # register maxes are batch-invariant and intern-consistent: the
+    # resumed run's estimates must EQUAL the uninterrupted run's
+    assert read_seen_counts(res_r) == read_seen_counts(base_r)
+    np.testing.assert_array_equal(
+        np.asarray(res_eng.state.registers),
+        np.asarray(base_eng.state.registers))
+
+
+def test_sliding_tdigest_kill_resume_counts_exact(tmp_path):
+    cfg, broker, mapping = setup_run(tmp_path, events=6000)
+    mk = lambda c, m, r: SlidingTDigestEngine(c, m, redis=r, slide_ms=1000)
+    base_eng, base_r = uninterrupted(cfg, broker, mapping, mk)
+    res_eng, res_r = crash_and_resume(tmp_path, cfg, broker, mapping, mk,
+                                      crash_after=3000)
+    assert res_eng.dropped == 0
+    # sliding counts are exact deltas -> must match bit-for-bit
+    assert read_seen_counts(res_r) == read_seen_counts(base_r)
+    # the digest survived the round-trip: total weight equals views seen
+    # (digest content is wall-clock latency, so only weights compare)
+    assert (np.asarray(res_eng.digest.weights).sum()
+            == np.asarray(base_eng.digest.weights).sum())
+    q = res_eng.quantiles()
+    assert (q[:, 0] <= q[:, 1] + 1e-3).all()
+
+
+def test_session_cms_kill_resume_equals_uninterrupted(tmp_path):
+    cfg, broker, mapping = setup_run(tmp_path)
+    mk = lambda c, m, r: SessionCMSEngine(c, m, redis=r, top_k=8)
+    base_eng, base_r = uninterrupted(cfg, broker, mapping, mk)
+    res_eng, res_r = crash_and_resume(tmp_path, cfg, broker, mapping, mk)
+    assert res_eng.dropped == 0
+    assert res_eng.session_clicks == base_eng.session_clicks
+    assert res_eng.sessions_closed == base_eng.sessions_closed
+    np.testing.assert_array_equal(
+        np.asarray(res_eng.cms.table), np.asarray(base_eng.cms.table))
+    assert dict(res_eng.heavy_hitters()) == dict(base_eng.heavy_hitters())
+
+
+def test_cross_family_restore_refused(tmp_path):
+    cfg, broker, mapping = setup_run(tmp_path, events=2000)
+    r = as_redis(FakeRedisStore())
+    hll_eng = HLLDistinctEngine(cfg, mapping, redis=r)
+    StreamRunner(hll_eng, broker.reader(cfg.kafka_topic)).run_catchup()
+    snap = hll_eng.snapshot(offset=0)
+
+    sess = SessionCMSEngine(cfg, mapping)
+    with pytest.raises(ValueError, match="engine family"):
+        sess.restore(snap)
+
+    from streambench_tpu.engine import AdAnalyticsEngine
+    exact = AdAnalyticsEngine(cfg, mapping)
+    with pytest.raises(ValueError, match="engine family"):
+        exact.restore(snap)
+
+
+def test_hll_geometry_mismatch_refused(tmp_path):
+    cfg, broker, mapping = setup_run(tmp_path, events=2000)
+    eng = HLLDistinctEngine(cfg, mapping, registers=128)
+    snap = eng.snapshot(offset=0)
+    other = HLLDistinctEngine(cfg, mapping, registers=256)
+    with pytest.raises(ValueError, match="num_registers"):
+        other.restore(snap)
+
+
+def test_sketch_snapshot_roundtrips_through_disk(tmp_path):
+    """extra arrays (registers, digests, intern tables incl. bytes
+    dtypes) must survive the npz encode/decode unchanged."""
+    cfg, broker, mapping = setup_run(tmp_path, events=2000)
+    eng = HLLDistinctEngine(cfg, mapping, registers=128)
+    StreamRunner(eng, broker.reader(cfg.kafka_topic)).run_catchup()
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(eng.snapshot(offset=123))
+    snap = ck.load()
+    assert snap is not None and snap.offset == 123
+    np.testing.assert_array_equal(snap.extra["hll_registers"],
+                                  np.asarray(eng.state.registers))
+    users, _ = eng.encoder.dump_intern_tables()
+    from streambench_tpu.engine.sketches import _SketchEngineBase
+    assert _SketchEngineBase._unpack_keys(
+        snap.extra["user_blob"], snap.extra["user_offs"]) == users
+
+
+def test_intern_pack_preserves_nul_and_duplicate_prefixes():
+    """Keys with trailing NULs must round-trip exactly; an "S"-dtype
+    array would strip them and collapse b'a' with b'a\\x00'."""
+    from streambench_tpu.engine.sketches import _SketchEngineBase as S
+
+    keys = [b"a", b"a\x00", b"", b"x\x00y", b"\x00"]
+    blob, offs = S._pack_keys(keys)
+    assert S._unpack_keys(blob, offs) == keys
+    empty_blob, empty_offs = S._pack_keys([])
+    assert S._unpack_keys(empty_blob, empty_offs) == []
