@@ -43,6 +43,11 @@ WORKDIR = os.path.abspath(os.environ.get("WORKDIR", "./bench-run"))
 CONF_FILE = os.environ.get("CONF_FILE", os.path.join(WORKDIR, "localConf.yaml"))
 SHARDED = os.environ.get("SHARDED", "") not in ("", "0", "false", "no")
 STOP_STATS_GRACE_S = float(os.environ.get("STOP_STATS_GRACE", "2.5"))
+# Engine selection (BASELINE configs #1-#4) + execution-mode knobs, the
+# peer of the reference harness driving every engine (stream-bench.sh:286-343)
+ENGINE = os.environ.get("ENGINE", "exact")   # exact|hll|sliding|session
+MICROBATCH = os.environ.get("MICROBATCH", "") not in ("", "0", "false", "no")
+CHECKPOINT_DIR = os.environ.get("CHECKPOINT_DIR", "")
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -165,6 +170,9 @@ def op_setup() -> None:
         "redis.port": REDIS_PORT,
         "kafka.topic": TOPIC,
         "kafka.partitions": PARTITIONS,
+        # micro-batch mode consumes one broker partition per mapper, so
+        # the generated partition count IS the map parallelism
+        "map.partitions": PARTITIONS,
         "process.hosts": 1,
         "process.cores": 4,
     })
@@ -232,6 +240,10 @@ def op_start_jax_processing() -> None:
             "--brokerDir", BROKER_DIR]
     if SHARDED:
         args.append("--sharded")
+    if ENGINE != "exact":
+        args += ["--engine", ENGINE]
+    if CHECKPOINT_DIR:
+        args += ["--checkpointDir", CHECKPOINT_DIR]
     if running_pid("engine") is not None:
         log("engine is already running...")
         return
@@ -273,6 +285,48 @@ def op_jax_test() -> None:
     op_stop_redis()
 
 
+def op_jax_microbatch() -> None:
+    """Run the fork's count-based barrier-aligned micro-batch mode as a
+    foreground catchup over the journaled topic (the fork replays its
+    events file the same way, ``AdvertisingTopologyNative.java:97-99``),
+    dumping the fork-format latency hash to Redis."""
+    rc = _run_tool(_py("streambench_tpu.engine", "--confPath", CONF_FILE,
+                       "--workdir", WORKDIR, "--brokerDir", BROKER_DIR,
+                       "--microbatch"), "microbatch")
+    if rc != 0:
+        raise SystemExit(f"microbatch run failed (rc={rc})")
+
+
+def op_jax_microbatch_test() -> None:
+    """Composite micro-batch run: journal a paced load, then fold it in
+    barrier-aligned count windows (the fork's research flow)."""
+    op_setup()
+    op_start_redis()
+    op_start_load()
+    log(f"sleeping {TEST_TIME:.0f}s")
+    time.sleep(TEST_TIME)
+    stop_if_needed("load")
+    op_jax_microbatch()
+    op_stop_redis()
+
+
+def op_jax_test_suite() -> None:
+    """Sweep BASELINE configs #1-#4 (exact, hll, sliding, session), each
+    as a fully isolated JAX_TEST in its own workdir + subprocess — the
+    peer of the reference harness's per-engine composite tests
+    (``stream-bench.sh:286-343``)."""
+    for engine in ("exact", "hll", "sliding", "session"):
+        wd = os.path.join(WORKDIR, f"suite-{engine}")
+        log(f"=== JAX_TEST [{engine}] (workdir {wd}) ===")
+        env = dict(os.environ, ENGINE=engine, WORKDIR=wd,
+                   CONF_FILE=os.path.join(wd, "localConf.yaml"))
+        rc = subprocess.run([sys.executable, os.path.abspath(__file__),
+                             "JAX_TEST"], env=env, cwd=REPO_ROOT).returncode
+        if rc != 0:
+            raise SystemExit(f"JAX_TEST [{engine}] failed (rc={rc})")
+        log(f"=== JAX_TEST [{engine}] done ===")
+
+
 def op_stop_all() -> None:
     for name in ("load", "engine", "redis"):
         stop_if_needed(name)
@@ -287,6 +341,9 @@ OPS: dict[str, object] = {
     "START_JAX_PROCESSING": op_start_jax_processing,
     "STOP_JAX_PROCESSING": op_stop_jax_processing,
     "JAX_TEST": op_jax_test,
+    "JAX_TEST_SUITE": op_jax_test_suite,
+    "JAX_MICROBATCH": op_jax_microbatch,
+    "JAX_MICROBATCH_TEST": op_jax_microbatch_test,
     "STOP_ALL": op_stop_all,
 }
 

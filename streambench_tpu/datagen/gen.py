@@ -311,15 +311,20 @@ def get_stats(r: RedisLike, workdir: str = ".") -> list[tuple[int, int]]:
 
 def dostats(workdir: str = ".", time_divisor_ms: int = 10_000,
             events: Iterable[bytes | str] | None = None,
-            mapping_path: str | None = None) -> dict[str, dict[int, int]]:
+            mapping_path: str | None = None,
+            mapping: dict[str, str] | None = None
+            ) -> dict[str, dict[int, int]]:
     """The golden model (``dostats``, ``core.clj:101-128``): replay the
     journal in pure Python, count "view" events per (campaign, bucket).
 
     Returns ``campaign -> {time_bucket -> count}`` with *bucket indices*
-    (event_time // divisor), as the Clojure original does.
+    (event_time // divisor), as the Clojure original does.  ``mapping``
+    supplies the ad->campaign join directly (tests); else it loads from
+    ``mapping_path`` / the workdir file.
     """
-    mapping = load_ad_mapping_file(
-        mapping_path or os.path.join(workdir, AD_TO_CAMPAIGN_FILE))
+    if mapping is None:
+        mapping = load_ad_mapping_file(
+            mapping_path or os.path.join(workdir, AD_TO_CAMPAIGN_FILE))
     own_file = None
     if events is None:
         own_file = open(os.path.join(workdir, KAFKA_JSON_FILE), "rb")

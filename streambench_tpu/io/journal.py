@@ -11,9 +11,10 @@ from a byte offset.  Offsets are byte positions, so checkpoint/resume
 semantics match Kafka's ``(topic, offset)`` pairs
 (``setStartFromEarliest``, ``AdvertisingTopologyNative.java:92``).
 
-A real-Kafka adapter can implement the same two classes against
-confluent-kafka; that library is absent in this image, so it is gated behind
-an import guard in ``streambench_tpu.io.kafka``.
+The real-Kafka adapter implementing this same contract against
+confluent-kafka lives in ``streambench_tpu.io.kafka`` (import-guarded;
+the library is absent in this image).  The shared contract both brokers
+honor is pinned by ``tests/test_kafka_contract.py``.
 """
 
 from __future__ import annotations

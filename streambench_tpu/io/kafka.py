@@ -1,0 +1,226 @@
+"""Real-Kafka broker adapter (import-guarded; confluent-kafka optional).
+
+The reference's firehose is a live Kafka cluster: the harness creates the
+``ad-events`` topic with ``$PARTITIONS`` partitions
+(``create_kafka_topic``, ``stream-bench.sh:107-115``) and the generator
+produces paced JSON events to it (``core.clj:203``).  This module is the
+same firehose behind the exact reader/writer/broker contract the rest of
+the framework consumes (``io.journal.FileBroker``), so an engine, the
+generator, and the harness can switch between the hermetic file journal
+and a real cluster with one constructor swap:
+
+- ``KafkaWriter.append/append_many/flush/close``  == ``JournalWriter``
+- ``KafkaReader.poll/seek/offset/close``          == ``JournalReader``
+  (offsets are Kafka record offsets, not byte positions — both are
+  opaque monotonic ints to checkpoints, which is all ``Snapshot.offset``
+  requires)
+- ``KafkaBroker.create_topic/partitions/writer/reader/multi_reader/
+  read_all``                                      == ``FileBroker``
+
+confluent-kafka is not in this image, so everything is gated: importing
+the module is safe anywhere; constructing an adapter without the library
+raises ``KafkaUnavailableError`` with install guidance.  The contract
+itself is pinned by ``tests/test_kafka_contract.py``, which runs the same
+suite against ``FileBroker`` (always) and against ``KafkaBroker`` (only
+when the library and a live broker are present).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+try:  # pragma: no cover - exercised only where the library exists
+    import confluent_kafka as _ck
+    from confluent_kafka.admin import AdminClient as _AdminClient
+    from confluent_kafka.admin import NewTopic as _NewTopic
+except ImportError:  # the baked image has no confluent-kafka
+    _ck = None
+    _AdminClient = None
+    _NewTopic = None
+
+
+class KafkaUnavailableError(RuntimeError):
+    """confluent-kafka is not installed in this environment."""
+
+
+def available() -> bool:
+    """True when the confluent-kafka client library is importable."""
+    return _ck is not None
+
+
+def _require() -> None:
+    if _ck is None:
+        raise KafkaUnavailableError(
+            "confluent-kafka is not installed; use io.journal.FileBroker "
+            "(the hermetic stand-in) or install confluent-kafka to drive "
+            "a real cluster")
+
+
+class KafkaWriter:
+    """JournalWriter-contract producer for one (topic, partition)."""
+
+    def __init__(self, brokers: str, topic: str, partition: int = 0,
+                 linger_ms: int = 5):
+        _require()
+        self.topic = topic
+        self.partition = partition
+        self._producer = _ck.Producer({
+            "bootstrap.servers": brokers,
+            "linger.ms": linger_ms,
+        })
+
+    def append(self, line: str | bytes) -> None:
+        data = line.encode("utf-8") if isinstance(line, str) else line
+        self._producer.produce(self.topic, value=data.rstrip(b"\n"),
+                               partition=self.partition)
+        self._producer.poll(0)  # serve delivery callbacks, no blocking
+
+    def append_many(self, lines: list[str] | list[bytes]) -> None:
+        for line in lines:
+            self.append(line)
+
+    def flush(self) -> None:
+        self._producer.flush()
+
+    def close(self) -> None:
+        self._producer.flush()
+
+    def __enter__(self) -> "KafkaWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class KafkaReader:
+    """JournalReader-contract consumer over one (topic, partition).
+
+    ``offset`` is the next Kafka record offset to consume — the checkpoint
+    unit, advanced only over *delivered* records, exactly like the
+    journal reader's byte offset (and Kafka's own committed-offset
+    semantics, ``setStartFromEarliest``,
+    ``AdvertisingTopologyNative.java:92``).
+    """
+
+    def __init__(self, brokers: str, topic: str, partition: int = 0,
+                 offset: int = 0, group_id: str = "streambench",
+                 poll_timeout_s: float = 0.05):
+        _require()
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+        self._poll_timeout = poll_timeout_s
+        self._consumer = _ck.Consumer({
+            "bootstrap.servers": brokers,
+            "group.id": group_id,
+            "enable.auto.commit": False,
+            "auto.offset.reset": "earliest",
+        })
+        self._assign()
+
+    def _assign(self) -> None:
+        self._consumer.assign(
+            [_ck.TopicPartition(self.topic, self.partition, self.offset)])
+
+    def seek(self, offset: int) -> None:
+        self.offset = offset
+        self._assign()
+
+    def poll(self, max_records: int = 65536) -> list[bytes]:
+        msgs = self._consumer.consume(num_messages=max_records,
+                                      timeout=self._poll_timeout)
+        out: list[bytes] = []
+        for m in msgs:
+            if m.error() is not None:
+                if m.error().code() == _ck.KafkaError._PARTITION_EOF:
+                    continue
+                raise _ck.KafkaException(m.error())
+            out.append(m.value())
+            self.offset = m.offset() + 1
+        return out
+
+    def poll_blocking(self, max_records: int = 65536,
+                      timeout_s: float = 1.0,
+                      poll_interval_s: float = 0.001) -> list[bytes]:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            lines = self.poll(max_records)
+            if lines or time.monotonic() >= deadline:
+                return lines
+            time.sleep(poll_interval_s)
+
+    def close(self) -> None:
+        self._consumer.close()
+
+    def __enter__(self) -> "KafkaReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class KafkaBroker:
+    """FileBroker-contract facade over a real Kafka cluster."""
+
+    def __init__(self, brokers: str, group_id: str = "streambench",
+                 create_timeout_s: float = 30.0):
+        _require()
+        self.brokers = brokers
+        self.group_id = group_id
+        self._create_timeout = create_timeout_s
+        self._admin = _AdminClient({"bootstrap.servers": brokers})
+
+    def create_topic(self, topic: str, partitions: int = 1) -> None:
+        futures = self._admin.create_topics(
+            [_NewTopic(topic, num_partitions=partitions,
+                       replication_factor=1)])
+        for fut in futures.values():
+            try:
+                fut.result(timeout=self._create_timeout)
+            except Exception as e:  # TOPIC_ALREADY_EXISTS is fine
+                if "TOPIC_ALREADY_EXISTS" not in str(e):
+                    raise
+
+    def partitions(self, topic: str) -> list[int]:
+        md = self._admin.list_topics(topic, timeout=self._create_timeout)
+        t = md.topics.get(topic)
+        if t is None or t.error is not None:
+            return []
+        return sorted(t.partitions)
+
+    def writer(self, topic: str, partition: int = 0,
+               append: bool = True) -> KafkaWriter:
+        # Kafka topics are always append-only; append=False (truncate)
+        # has no cluster analog and is ignored.
+        return KafkaWriter(self.brokers, topic, partition)
+
+    def reader(self, topic: str, partition: int = 0,
+               offset: int = 0) -> KafkaReader:
+        return KafkaReader(self.brokers, topic, partition, offset,
+                           group_id=self.group_id)
+
+    def multi_reader(self, topic: str):
+        from streambench_tpu.io.journal import MultiReader
+
+        parts = self.partitions(topic) or [0]
+        return MultiReader([self.reader(topic, p) for p in parts])
+
+    def read_all(self, topic: str) -> Iterator[bytes]:
+        for p in self.partitions(topic):
+            with self.reader(topic, p) as r:
+                while True:
+                    lines = r.poll_blocking(timeout_s=1.0)
+                    if not lines:
+                        break
+                    yield from lines
+
+
+def make_broker(brokers: str | None, journal_root: str):
+    """The one switch point: a real cluster when ``brokers`` names one and
+    the client library exists, else the hermetic file journal."""
+    if brokers and available():
+        return KafkaBroker(brokers)
+    from streambench_tpu.io.journal import FileBroker
+
+    return FileBroker(journal_root)

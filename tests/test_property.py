@@ -1,0 +1,209 @@
+"""Property-based tests (SURVEY.md §5.2: "property tests replace
+sanitizers").
+
+Randomized adversarial streams — skewed/late timestamps, reordering,
+ragged and over-wide batches, garbage lines, duplicate windows — checked
+against the pure-Python golden model (``dostats``, ``core.clj:101-128``)
+and against differential twins (native vs Python encoder, scatter vs
+one-hot).  The two race conditions fixed in round 1 (barrier wake-up,
+shared encoder) would both have been caught by the churn test here.
+"""
+
+import json
+import random as pyrandom
+
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.encode.encoder import EventEncoder
+from streambench_tpu.engine import AdAnalyticsEngine
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.redis_schema import (
+    as_redis,
+    read_seen_counts,
+    seed_campaigns,
+)
+from streambench_tpu.ops import windowcount as wc
+
+# One fixed geometry across examples: every example reuses the same jit
+# cache entries (shapes/statics identical), so the suite stays fast.
+C, A, B = 7, 30, 256
+DIV, LATE = 10_000, 60_000
+MAPPING = {f"ad{i}": f"camp{i % C}" for i in range(A)}
+MAPPING_ADS = sorted(MAPPING)
+
+SETTINGS = dict(max_examples=15, deadline=None,
+                suppress_health_check=[HealthCheck.too_slow])
+
+
+def make_line(ad: str, etype: str, t: int, user="u1", page="p1",
+              ad_type="banner") -> bytes:
+    # the generator's exact field order (make-kafka-event-at,
+    # core.clj:175-181) so the fast path is exercised
+    return json.dumps({
+        "user_id": user, "page_id": page, "ad_id": ad, "ad_type": ad_type,
+        "event_type": etype, "event_time": str(t),
+    }).encode()
+
+
+@st.composite
+def event_stream(draw, max_events=1500):
+    """A stream with bounded skew + lateness (the generator's contract:
+    +-50 ms skew, occasional late events, core.clj:166-173), plus local
+    reordering — never later than the allowed lateness, so the golden
+    model and the engine must agree EXACTLY."""
+    n = draw(st.integers(10, max_events))
+    rng = pyrandom.Random(draw(st.integers(0, 2**31)))
+    t = 70_000
+    lines = []
+    for _ in range(n):
+        t += rng.randint(0, 300)  # up to ~window-sized gaps over the run
+        skew = rng.randint(-50, 50)
+        late = rng.randint(0, 50_000) if rng.random() < 0.02 else 0
+        ts = max(t + skew - late, 0)
+        ad = rng.choice(MAPPING_ADS) if rng.random() < 0.95 else "unknown-ad"
+        etype = rng.choice(["view", "view", "click", "purchase"])
+        lines.append(make_line(ad, etype, ts, user=f"u{rng.randint(0, 20)}"))
+    return lines
+
+
+@given(stream=event_stream(), chunking=st.integers(1, 4))
+@settings(**SETTINGS)
+def test_engine_matches_dostats_on_adversarial_streams(stream, chunking):
+    cfg = default_config(jax_batch_size=B)
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, sorted(set(MAPPING.values())))
+    eng = AdAnalyticsEngine(cfg, MAPPING, redis=r)
+    rng = pyrandom.Random(1234)
+    i = 0
+    while i < len(stream):
+        # ragged AND over-wide chunks: 1..chunking*B lines per call
+        step_n = rng.randint(1, chunking * B)
+        eng.process_lines(stream[i:i + step_n])
+        i += step_n
+        if rng.random() < 0.3:
+            eng.flush()  # duplicate flushes of still-open windows
+    eng.close()
+    assert eng.dropped == 0
+
+    golden = gen.dostats(events=stream, mapping_path=None,
+                         time_divisor_ms=DIV,
+                         mapping=MAPPING)
+    got = read_seen_counts(r)
+    flat_got = {(c, w // DIV): n for c in got for w, n in got[c].items()}
+    flat_want = {(c, b): n for c, per in golden.items()
+                 for b, n in per.items()}
+    assert flat_got == flat_want
+
+
+@given(stream=event_stream(max_events=400),
+       garbage=st.lists(st.binary(min_size=0, max_size=80), max_size=10))
+@settings(**SETTINGS)
+def test_native_and_python_encoders_identical(stream, garbage):
+    """Differential: the C++ fast path and the pure-Python encoder must
+    produce byte-identical columns, intern tables, and bad-line counts —
+    on clean streams AND with garbage interleaved."""
+    native_mod = pytest.importorskip("streambench_tpu.native")
+    if native_mod.load() is None:
+        pytest.skip("native library unavailable")
+    from streambench_tpu.encode.native_encoder import NativeEventEncoder
+
+    rng = pyrandom.Random(7)
+    lines = list(stream)
+    for g in garbage:
+        lines.insert(rng.randrange(len(lines) + 1), g)
+
+    e_py = EventEncoder(MAPPING, divisor_ms=DIV, lateness_ms=LATE)
+    e_nat = NativeEventEncoder(MAPPING, divisor_ms=DIV, lateness_ms=LATE)
+    i = 0
+    while i < len(lines):
+        n = rng.randint(1, B)
+        chunk = lines[i:i + n]
+        i += n
+        b_py = e_py.encode(chunk, B)
+        b_nat = e_nat.encode(chunk, B)
+        assert b_py.n == b_nat.n
+        assert b_py.base_time_ms == b_nat.base_time_ms
+        for col in ("ad_idx", "event_type", "event_time", "user_idx",
+                    "page_idx", "ad_type", "valid"):
+            np.testing.assert_array_equal(
+                getattr(b_py, col), getattr(b_nat, col), err_msg=col)
+    assert e_py.dump_intern_tables() == e_nat.dump_intern_tables()
+    assert e_py.bad_lines == e_nat.bad_lines
+
+
+@given(data=st.data())
+@settings(**SETTINGS)
+def test_windowcount_conservation_and_method_equivalence(data):
+    """Invariant: counted + dropped == wanted, for any input; scatter and
+    one-hot agree bit-for-bit.  Exercises duplicate window ids, ring
+    eviction, and pre-base (negative-window) events."""
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    W = 8
+    n_steps = data.draw(st.integers(1, 4))
+    join = np.concatenate([rng.integers(0, C, A).astype(np.int32), [-1]])
+    s1 = wc.init_state(C, W)
+    s2 = wc.init_state(C, W)
+    wanted_total = 0
+    for _ in range(n_steps):
+        ad = rng.integers(0, A + 1, B).astype(np.int32)  # incl unknown
+        et = rng.integers(0, 3, B).astype(np.int32)
+        # wild times: spans bigger than the ring, duplicates, pre-base
+        tm = rng.integers(-20_000, 300_000, B).astype(np.int32)
+        valid = rng.random(B) < 0.9
+        s1 = wc.step(s1, join, ad, et, tm, valid, divisor_ms=DIV,
+                     lateness_ms=20_000, method="scatter")
+        s2 = wc.step(s2, join, ad, et, tm, valid, divisor_ms=DIV,
+                     lateness_ms=20_000, method="onehot")
+        wanted_total += int(((et == 0) & valid & (join[ad] >= 0)).sum())
+    np.testing.assert_array_equal(np.asarray(s1.counts),
+                                  np.asarray(s2.counts))
+    np.testing.assert_array_equal(np.asarray(s1.window_ids),
+                                  np.asarray(s2.window_ids))
+    assert int(s1.dropped) == int(s2.dropped)
+    assert int(np.asarray(s1.counts).sum()) + int(s1.dropped) == wanted_total
+
+
+@given(seed=st.integers(0, 2**31), windows=st.integers(1, 3),
+       extra=st.integers(0, 59))
+@settings(max_examples=8, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+def test_microbatch_barrier_churn(seed, windows, extra):
+    """Thread-churned partitions with ragged tails: every fully assembled
+    window's merged counts must equal the golden segment count over the
+    union of the partitions' chunks; leftover tails never emit."""
+    import tempfile
+
+    from streambench_tpu.engine.microbatch import run_microbatch
+    from streambench_tpu.io.journal import FileBroker
+
+    P, psize = 3, 20
+    cfg = default_config(window_size=P * psize, map_partitions=P)
+    rng = pyrandom.Random(seed)
+    broker = FileBroker(tempfile.mkdtemp(prefix="mbprop-"))
+    golden = [dict() for _ in range(windows)]
+    for p in range(P):
+        w = broker.writer(cfg.kafka_topic, p)
+        # exactly `windows` full chunks, plus a ragged never-emitted tail
+        # on partition 0
+        n = windows * psize + (extra if p == 0 else 0)
+        for j in range(n):
+            ad = rng.choice(MAPPING_ADS)
+            etype = rng.choice(["view", "click"])
+            w.append(make_line(ad, etype, 70_000 + j))
+            if j < windows * psize and etype == "view":
+                k = j // psize
+                camp = MAPPING[ad]
+                golden[k][camp] = golden[k].get(camp, 0) + 1
+        w.close()
+
+    merged, results = run_microbatch(cfg, broker, MAPPING)
+    assert len(merged) == windows
+    campaigns = sorted(set(MAPPING.values()))
+    for k in range(windows):
+        got = {campaigns[i]: int(v) for i, v in enumerate(merged[k]) if v}
+        assert got == golden[k], f"window {k}"
