@@ -68,6 +68,10 @@ class BenchmarkConfig:
 
     # --- TPU-engine knobs (new; same namespacing style as storm.*/spark.*) ---
     jax_batch_size: int = 8192             # events per device micro-batch
+    jax_scan_batches: int = 8              # batches folded per device dispatch
+    #   (catchup mode stacks this many micro-batches and folds them in one
+    #   lax.scan call, amortizing per-dispatch latency; streaming mode and
+    #   engines without a scanned kernel ignore it)
     jax_buffer_timeout_ms: int = 100       # Flink bufferTimeout analog
     #   (AdvertisingTopologyNative.java:77-79: latency/throughput tradeoff)
     jax_num_campaigns: int = 100           # key cardinality (core.clj:15)
@@ -160,6 +164,7 @@ class BenchmarkConfig:
             storm_ackers=geti("storm.ackers", 2),
             spark_batchtime=geti("spark.batchtime", 2000),
             jax_batch_size=geti("jax.batch.size", 8192),
+            jax_scan_batches=geti("jax.scan.batches", 8),
             jax_buffer_timeout_ms=geti("jax.buffer.timeout.ms", 100),
             jax_num_campaigns=geti("jax.num.campaigns", 100),
             jax_ads_per_campaign=geti("jax.ads.per.campaign", 10),

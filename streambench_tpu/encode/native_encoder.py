@@ -29,6 +29,12 @@ def _i32p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
 
 
+# Mirrors kBaseUnset in encoder.cpp: INT64_MIN marks "no base yet".  A
+# plain "< 0" check would conflate unset with the legitimately negative
+# bases produced by small (synthetic/test) event times.
+BASE_UNSET = -(1 << 63)
+
+
 class NativeEventEncoder(EventEncoder):
     def __init__(self, ad_to_campaign: dict[str, str],
                  campaigns: list[str] | None = None,
@@ -49,8 +55,8 @@ class NativeEventEncoder(EventEncoder):
 
     def set_base_time(self, base_time_ms: int | None) -> None:
         super().set_base_time(base_time_ms)
-        if base_time_ms is not None:
-            self._lib.sb_encoder_set_base_time(self._enc, base_time_ms)
+        self._lib.sb_encoder_set_base_time(
+            self._enc, BASE_UNSET if base_time_ms is None else base_time_ms)
 
     def dump_intern_tables(self) -> tuple[list[bytes], list[bytes]]:
         out = []
@@ -145,11 +151,12 @@ class NativeEventEncoder(EventEncoder):
             valid = np.zeros(B, bool)
             valid[:n] = True
         self.base_time_ms = base = self._lib.sb_encoder_base_time(self._enc)
-        if base < 0:
+        if base == BASE_UNSET:
             self.base_time_ms = None
         return EncodedBatch(ad_idx, etype, etime, user_idx, page_idx,
                             ad_type, valid, n=n,
-                            base_time_ms=self.base_time_ms or 0)
+                            base_time_ms=self.base_time_ms
+                            if self.base_time_ms is not None else 0)
 
     def _parse_fallback(self, line: bytes):
         try:
@@ -157,7 +164,7 @@ class NativeEventEncoder(EventEncoder):
             t = int(ev["event_time"])
         except (KeyError, ValueError, TypeError):
             return None
-        if self._lib.sb_encoder_base_time(self._enc) < 0:
+        if self._lib.sb_encoder_base_time(self._enc) == BASE_UNSET:
             self._lib.sb_encoder_set_base_time(
                 self._enc,
                 t - (t % self.divisor_ms) - self.lateness_ms)

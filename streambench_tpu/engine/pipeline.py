@@ -17,6 +17,8 @@ guard trips.  Wall-clock flush cadence to Redis stays the reference's 1 Hz
 
 from __future__ import annotations
 
+import queue
+import threading
 from collections import defaultdict
 
 import jax
@@ -36,21 +38,109 @@ from streambench_tpu.trace import Tracer
 from streambench_tpu.utils.ids import now_ms
 
 
-# One-hot materializes a [B, C*W] comparison per step — MXU-friendly while
-# C*W is a few thousand cells (C=100 campaigns x W=16 slots = 1,600) but
-# catastrophic at BASELINE config #5's C=1e6 (a [1024, 1.6e7] intermediate
-# per step).  Above this cell bound scatter-add always wins.
-ONEHOT_MAX_CELLS = 32_768
+# The factored matmul method materializes [B, C] + [B, W] one-hots (not
+# the [B, C*W] the "onehot" method needs), so its bound is on the campaign
+# axis alone: past this, the [B, C] operand stops being worth the MXU and
+# scatter-add wins (config #5's C=1e6 would be a [8192, 1e6] f32 operand —
+# 32 GB).
+MATMUL_MAX_CAMPAIGNS = 4_096
 
 
-def default_method(num_cells: int | None = None) -> str:
-    """Scatter-add on CPU or for large state; one-hot reduction on TPU
-    (MXU-friendly) while ``num_cells = C*W`` stays under the bound."""
+def default_method(num_campaigns: int | None = None,
+                   window_slots: int | None = None) -> str:
+    """Scatter-add on CPU or for large key spaces; the factored MXU matmul
+    on TPU while the campaign axis stays under ``MATMUL_MAX_CAMPAIGNS``."""
     if jax.default_backend() not in ("tpu", "axon"):
         return "scatter"
-    if num_cells is not None and num_cells > ONEHOT_MAX_CELLS:
+    if num_campaigns is not None and num_campaigns > MATMUL_MAX_CAMPAIGNS:
         return "scatter"
-    return "onehot"
+    return "matmul"
+
+
+class _RedisWriter:
+    """Background window-writeback thread.
+
+    The reference runs its Redis flusher on its own thread
+    (``CampaignProcessorCommon.java:35-55``); here that overlaps the
+    writeback with encode + device compute (the pipeline-parallel stage
+    chain, SURVEY.md §2).  ``time_updated`` is stamped by THIS thread at
+    actual write time (``core.clj:149`` defines latency truth), unless the
+    caller pinned a stamp.  A bounded queue provides backpressure; errors
+    surface on the next ``drain``/``close``.
+    """
+
+    def __init__(self, redis: RedisLike, absolute: bool, tracer: Tracer,
+                 on_written) -> None:
+        self._redis = redis
+        self._absolute = absolute
+        self._tracer = tracer
+        self._on_written = on_written   # (rows, stamp) latency bookkeeping
+        self._q: queue.Queue = queue.Queue(maxsize=8)
+        self._error: BaseException | None = None
+        self._lock = threading.Lock()
+        # Batches whose write raised: retained for the engine to re-merge
+        # into _pending (take_failed) — a transient Redis outage must not
+        # permanently undercount windows.
+        self._failed: list[list] = []
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="redis-writer")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                rows, stamp = item
+                stamp = now_ms() if stamp is None else stamp
+                try:
+                    with self._tracer.span("redis_flush"):
+                        write_windows_pipelined(self._redis, rows,
+                                                time_updated=stamp,
+                                                absolute=self._absolute)
+                except BaseException as e:  # retained for reclaim/retry
+                    import sys
+                    print(f"redis writer: write of {len(rows)} rows "
+                          f"failed ({e!r}); retained for retry",
+                          file=sys.stderr, flush=True)
+                    with self._lock:
+                        self._failed.append(rows)
+                        self._error = e
+                else:
+                    # latency bookkeeping only for rows that actually landed
+                    self._on_written(rows, stamp)
+            finally:
+                self._q.task_done()
+
+    def take_failed(self) -> list[list]:
+        """Hand back batches whose write failed (clears the retention).
+        The engine re-merges them into ``_pending`` so the next flush
+        retries — a transient Redis outage must not undercount windows."""
+        with self._lock:
+            failed, self._failed = self._failed, []
+        return failed
+
+    def submit(self, rows, stamp: int | None) -> None:
+        self._q.put((rows, stamp))
+
+    def drain(self) -> None:
+        """Block until every submitted batch was attempted.  Failures are
+        not raised here — they sit in ``take_failed`` for reclaim."""
+        self._q.join()
+
+    def close(self) -> None:
+        """Stop the thread.  Raises if batches failed and were never
+        reclaimed — silent data loss at shutdown is not an option."""
+        if self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join()
+        with self._lock:
+            lost, err = len(self._failed), self._error
+        if lost:
+            raise RuntimeError(
+                f"redis writer shut down with {lost} unwritten batches"
+            ) from err
 
 
 class AdAnalyticsEngine:
@@ -81,8 +171,9 @@ class AdAnalyticsEngine:
         self.join_table = jnp.asarray(self.encoder.join_table)
         self.W = cfg.jax_window_slots
         self.method = method or default_method(
-            self.encoder.num_campaigns * self.W)
+            self.encoder.num_campaigns, self.W)
         self.batch_size = cfg.jax_batch_size
+        self.scan_batches = max(cfg.jax_scan_batches, 1)
         self._encode = (self.encoder.encode if input_format == "json"
                         else self.encoder.encode_tbl)
         if self.W * self.divisor <= self.lateness + 2 * self.divisor:
@@ -95,6 +186,15 @@ class AdAnalyticsEngine:
 
         # host-side bookkeeping
         self._span_start: int | None = None   # min unflushed event time (abs)
+        # Deferred drains: (deltas, window_ids) DEVICE arrays from
+        # flush_deltas calls whose host materialization is postponed.  The
+        # device executes enqueued programs in order, so the ring is safe
+        # to reuse the moment flush_deltas is DISPATCHED; blocking on the
+        # result (np.asarray) would stall the host behind every batch
+        # queued before it — the round-2 bench lost 85% of its wall time
+        # exactly there.  Materialization happens at flush()/snapshot()
+        # time, when the 1 Hz cadence has let the queue drain naturally.
+        self._undrained: list[tuple[jax.Array, jax.Array]] = []
         # pending Redis deltas: (campaign_idx, abs_window_ts) -> count
         self._pending: dict[tuple[int, int], int] = defaultdict(int)
         self.events_processed = 0
@@ -106,6 +206,12 @@ class AdAnalyticsEngine:
         # stage spans (SURVEY.md §5.1) + Apex-style decile accounting (§5.5)
         self.tracer = Tracer()
         self.latency_tracker = LatencyTracker(window_ms=self.divisor)
+        self._writer: _RedisWriter | None = None
+
+    # Subclasses whose _device_step is not the exact-count kernel clear
+    # this; process_chunk then folds per-batch (still with deferred
+    # drains) instead of through the scanned exact kernel.
+    SCAN_SUPPORTED = True
 
     # ------------------------------------------------------------------
     def process_lines(self, lines: list[bytes]) -> int:
@@ -120,6 +226,82 @@ class AdAnalyticsEngine:
                 continue
             self._fold(batch)
         return len(lines)
+
+    def process_chunk(self, lines: list[bytes]) -> int:
+        """Encode + fold up to ``scan_batches`` batches with ONE device
+        dispatch (``lax.scan`` over stacked micro-batches).
+
+        This is the dispatch-amortization path for catchup: per-batch
+        enqueue overhead (~10 ms against a remote TPU backend) is paid
+        once per K batches instead of once per batch.  Falls back to the
+        per-batch path when the engine's kernel has no scanned form or
+        the chunk's event-time span doesn't fit the ring in one piece.
+        """
+        K = self.scan_batches
+        B = self.batch_size
+        batches = []
+        for off in range(0, len(lines), B):
+            with self.tracer.span("encode"):
+                b = self._encode(lines[off:off + B], B)
+            if b.n:
+                batches.append(b)
+        if not self.SCAN_SUPPORTED or K <= 1:
+            for b in batches:
+                self._fold(b)
+            return len(lines)
+        for g in range(0, len(batches), K):
+            self._fold_group(batches[g:g + K])
+        return len(lines)
+
+    def _fold_group(self, batches: list) -> None:
+        """Fold up to ``scan_batches`` encoded batches in one dispatch."""
+        if len(batches) == 1:
+            self._fold(batches[0])
+            return
+        lo = min(int(b.event_time[:b.n].min()) + b.base_time_ms
+                 for b in batches)
+        hi = max(int(b.event_time[:b.n].max()) + b.base_time_ms
+                 for b in batches)
+        if hi - lo > self._span_guard:
+            # The group alone outspans the ring; the per-batch path can
+            # drain between batches and halve over-wide ones.
+            for b in batches:
+                self._fold(b)
+            return
+        if self._span_start is None:
+            self._span_start = lo
+        if hi - self._span_start > self._span_guard:
+            with self.tracer.span("drain"):
+                self._drain_device()
+            self._span_start = lo
+
+        # Pad the stack to the next power-of-two group size so the scan
+        # compiles once per bucket (log2(K)+1 shapes, not one per group
+        # size) while partial groups don't pay for a full K of padding.
+        # All-invalid padding batches are no-ops in the kernel (masked
+        # everywhere, the watermark max treats invalid rows as -inf).
+        k = 1
+        while k < len(batches):
+            k *= 2
+        pad = min(k, self.scan_batches) - len(batches)
+        cols = {}
+        for name in ("ad_idx", "event_type", "event_time", "valid"):
+            arrs = [getattr(b, name) for b in batches]
+            if pad:
+                arrs += [np.zeros_like(arrs[0])] * pad
+            cols[name] = jnp.asarray(np.stack(arrs))
+        with self.tracer.span("device_scan"):
+            self._device_scan(cols["ad_idx"], cols["event_type"],
+                              cols["event_time"], cols["valid"])
+        self.events_processed += sum(b.n for b in batches)
+        self.last_event_ms = now_ms()
+
+    def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
+        """Fold ``[K, B]`` stacked batches in one compiled scan."""
+        self.state = wc.scan_steps(
+            self.state, self.join_table, ad_idx, event_type, event_time,
+            valid, divisor_ms=self.divisor, lateness_ms=self.lateness,
+            method=self.method)
 
     def _fold(self, batch) -> None:
         """Ring-guarded fold of one encoded batch, splitting when needed.
@@ -188,20 +370,34 @@ class AdAnalyticsEngine:
 
     # ------------------------------------------------------------------
     def _drain_device(self) -> None:
-        """Pull count deltas off the device into the host pending buffer."""
+        """Zero the device deltas for ring reuse; materialization deferred.
+
+        Only *dispatches* ``flush_deltas`` — device programs execute in
+        dispatch order, so the ring is reusable immediately; the returned
+        arrays are parked in ``_undrained`` and pulled to the host in
+        ``_materialize_drains`` (never on the hot path).
+        """
         deltas, wids, self.state = wc.flush_deltas(
             self.state, divisor_ms=self.divisor, lateness_ms=self.lateness)
-        deltas = np.asarray(deltas)
-        wids = np.asarray(wids)
-        base = self.encoder.base_time_ms or 0
-        ci, si = np.nonzero(deltas)
-        for c, s in zip(ci.tolist(), si.tolist()):
-            wid = int(wids[s])
-            if wid < 0:
-                continue
-            abs_ts = base + wid * self.divisor
-            self._pending[(c, abs_ts)] += int(deltas[c, s])
+        self._undrained.append((deltas, wids))
         self._span_start = None
+
+    def _materialize_drains(self) -> None:
+        """Merge parked drain results into the host pending buffer."""
+        if not self._undrained:
+            return
+        base = self.encoder.base_time_ms or 0
+        for deltas_d, wids_d in self._undrained:
+            deltas = np.asarray(deltas_d)
+            wids = np.asarray(wids_d)
+            ci, si = np.nonzero(deltas)
+            for c, s in zip(ci.tolist(), si.tolist()):
+                wid = int(wids[s])
+                if wid < 0:
+                    continue
+                abs_ts = base + wid * self.divisor
+                self._pending[(c, abs_ts)] += int(deltas[c, s])
+        self._undrained.clear()
 
     def flush(self, time_updated: int | None = None) -> int:
         """Drain device + write all pending deltas to Redis.
@@ -212,25 +408,67 @@ class AdAnalyticsEngine:
         """
         with self.tracer.span("drain"):
             self._drain_device()
+            self._materialize_drains()
+        self._reclaim_failed_writes()
         if not self._pending:
             return 0
-        stamp = now_ms() if time_updated is None else time_updated
         rows = [(self.encoder.campaigns[c], ts, n)
                 for (c, ts), n in self._pending.items()]
+        self._pending.clear()
+        self.windows_written += len(rows)
+        if self.redis is not None:
+            if self._writer is None:
+                self._writer = _RedisWriter(
+                    self.redis, self.absolute_counts, self.tracer,
+                    self._note_written)
+            self._writer.submit(rows, time_updated)
+        else:
+            self._note_written(rows,
+                              now_ms() if time_updated is None
+                              else time_updated)
+        return len(rows)
+
+    def _note_written(self, rows, stamp: int) -> None:
+        """Latency bookkeeping at actual write time (writer thread)."""
         for camp, ts, _ in rows:
             self.window_latency[ts] = stamp - ts
             self.latency_tracker.record(camp, ts, stamp)
-        if self.redis is not None:
-            with self.tracer.span("redis_flush"):
-                write_windows_pipelined(self.redis, rows, time_updated=stamp,
-                                        absolute=self.absolute_counts)
-        self._pending.clear()
-        self.windows_written += len(rows)
-        return len(rows)
+
+    def _reclaim_failed_writes(self) -> None:
+        """Fold failed writeback batches back into ``_pending`` so the
+        next flush retries them (and snapshots never lose them)."""
+        if self._writer is None:
+            return
+        idx = self.encoder.campaign_index
+        for batch in self._writer.take_failed():
+            for camp, ts, n in batch:
+                if self.absolute_counts:
+                    self._pending[(idx[camp], ts)] = n
+                else:
+                    self._pending[(idx[camp], ts)] += n
+
+    def drain_writes(self) -> None:
+        """Block until every queued Redis writeback has landed.  The sync
+        point before a checkpoint commits (queued-but-unwritten rows left
+        pending at a crash would otherwise be lost: the journal re-tail
+        starts past the events that produced them)."""
+        if self._writer is not None:
+            self._writer.drain()
 
     # ------------------------------------------------------------------
     # checkpoint/resume (SURVEY.md §5.4 — absent in the reference; the
     # scan carry is fixed-shape arrays, so a snapshot is one savez)
+    def _snapshot_sync(self) -> None:
+        """Make host bookkeeping snapshot-complete: parked drain deltas
+        live in neither state.counts (zeroed) nor _pending — fold them
+        in; queued Redis writebacks must land before the snapshot commits
+        (see drain_writes); batches whose write FAILED get reclaimed into
+        _pending so the snapshot carries them.  Every snapshot() override
+        calls this first."""
+        self._materialize_drains()
+        self.drain_writes()
+        self._reclaim_failed_writes()
+
     def _snapshot_meta(self) -> dict:
         """Host-side meta shared by every engine family's snapshot."""
         return dict(
@@ -251,6 +489,7 @@ class AdAnalyticsEngine:
         """Capture exact engine state as of journal byte ``offset``."""
         from streambench_tpu.checkpoint import Snapshot
 
+        self._snapshot_sync()
         return Snapshot(
             offset=offset,
             meta=self._snapshot_meta(),
@@ -288,6 +527,8 @@ class AdAnalyticsEngine:
 
     def _restore_host(self, snap: "Snapshot") -> None:
         """Re-establish every host-side field from snapshot meta."""
+        self.drain_writes()
+        self._undrained.clear()
         self.encoder.set_base_time(snap.meta["base_time_ms"])
         self._span_start = snap.meta["span_start"]
         self.events_processed = int(snap.meta["events_processed"])
@@ -319,6 +560,9 @@ class AdAnalyticsEngine:
         """Final flush + fork-style latency dump
         (``AdvertisingTopologyNative.java:521-532``)."""
         self.flush()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
         if self.redis is not None and self.cfg.redis_hashtable:
             dump_latency_hash(
                 self.redis, self.cfg.redis_hashtable, self.window_latency,

@@ -108,10 +108,18 @@ class StreamRunner:
         last_data = time.monotonic()
         pending: list[bytes] = []
         pending_since: float | None = None
+        # Adaptive batching under backlog: while the reader keeps handing
+        # back full reads (producer is ahead of us), grow the dispatch
+        # target toward one scan-chunk so catching up pays one dispatch
+        # per K batches; any short read snaps it back to one batch so
+        # steady-state latency stays governed by buffer_timeout.
+        chunk_cap = self.batch_size * max(
+            getattr(self.engine, "scan_batches", 1), 1)
+        target = self.batch_size
 
         def dispatch() -> None:
             nonlocal pending, pending_since, last_data
-            self.engine.process_lines(pending)
+            self.engine.process_chunk(pending)
             st.events += len(pending)
             st.batches += 1
             pending = []
@@ -125,22 +133,30 @@ class StreamRunner:
             if max_events and st.events >= max_events:
                 break
 
-            room = self.batch_size - len(pending)
+            room = target - len(pending)
             lines = self.reader.poll(max_records=max(room, 0)) if room else []
             if lines:
                 last_data = now
                 if pending_since is None:
                     pending_since = now
                 pending.extend(lines)
-            elif (idle_timeout_s and not pending
-                    and now - last_data >= idle_timeout_s):
-                # Idle means "polled and found nothing for a while" — the
-                # clock must not tick while we were busy compiling/folding.
-                break
+                if len(lines) >= room:       # backlog: scale the batch up
+                    target = min(target * 2, chunk_cap)
+                elif len(pending) < self.batch_size:
+                    target = self.batch_size
+            else:
+                if len(pending) < self.batch_size:
+                    target = self.batch_size
+                if (idle_timeout_s and not pending
+                        and now - last_data >= idle_timeout_s):
+                    # Idle means "polled and found nothing for a while" —
+                    # the clock must not tick while we were busy
+                    # compiling/folding.
+                    break
 
             batch_old = (pending_since is not None and
                          (now - pending_since) * 1000 >= self.buffer_timeout_ms)
-            if len(pending) >= self.batch_size or (pending and batch_old):
+            if len(pending) >= target or (pending and batch_old):
                 dispatch()
             elif not lines:
                 time.sleep(0.001)  # nothing due and nothing new: yield
@@ -170,16 +186,17 @@ class StreamRunner:
 
     def run_catchup(self, max_events: int | None = None) -> RunStats:
         """Drain the journal as fast as possible (catchup/throughput mode):
-        full batches, no buffer timeout, flush only on ring-span guard +
-        once per second of wall clock."""
+        scan-chunked batches, no buffer timeout, flush only on ring-span
+        guard + once per second of wall clock."""
         st = self.stats
         st.started_ms = now_ms()
         last_flush = time.monotonic()
+        chunk = self.batch_size * getattr(self.engine, "scan_batches", 1)
         while not self._stop:
-            lines = self.reader.poll(max_records=self.batch_size)
+            lines = self.reader.poll(max_records=chunk)
             if not lines:
                 break
-            self.engine.process_lines(lines)
+            self.engine.process_chunk(lines)
             st.events += len(lines)
             st.batches += 1
             if max_events and st.events >= max_events:

@@ -45,6 +45,10 @@ class _SketchEngineBase(AdAnalyticsEngine):
     (``AdvertisingTopologyNative.java:92`` / ``checkpoint.py``).
     """
 
+    # Sketch kernels have no scanned form yet; process_chunk folds
+    # per-batch (deferred drains still apply).
+    SCAN_SUPPORTED = False
+
     @staticmethod
     def _pack_keys(keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
         """Concatenated uint8 blob + int64 offsets.  NOT an "S"-dtype
@@ -111,6 +115,7 @@ class HLLDistinctEngine(_SketchEngineBase):
     def snapshot(self, offset: int):
         from streambench_tpu.checkpoint import Snapshot
 
+        self._snapshot_sync()
         meta = self._snapshot_meta()
         meta["num_registers"] = self.registers
         return Snapshot(
@@ -202,6 +207,7 @@ class SlidingTDigestEngine(_SketchEngineBase):
     def snapshot(self, offset: int):
         from streambench_tpu.checkpoint import Snapshot
 
+        self._snapshot_sync()
         meta = self._snapshot_meta()
         meta.update(size_ms=self.size_ms, slide_ms=self.slide_ms,
                     compression=int(self.digest.means.shape[1]))
@@ -301,6 +307,7 @@ class SessionCMSEngine(_SketchEngineBase):
     def snapshot(self, offset: int):
         from streambench_tpu.checkpoint import Snapshot
 
+        self._snapshot_sync()
         meta = self._snapshot_meta()
         meta.update(gap_ms=self.gap_ms, user_capacity=self.user_capacity,
                     cms_depth=int(self.cms.table.shape[0]),

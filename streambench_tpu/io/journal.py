@@ -131,32 +131,37 @@ class JournalReader:
         if len(out) >= max_records or not self._ensure_open():
             return out
 
-        budget = self._byte_budget
-        while True:
-            data = self._fh.read(budget)
-            if not data:
-                return out
-            end = data.rfind(b"\n")
-            if end >= 0:
-                break
-            if len(data) < budget:
-                # partial trailing line, writer not done yet; rewind
+        # Loop budget-sized reads until the request is satisfied or the
+        # journal runs dry — a single bounded read would silently cap
+        # every poll at ~budget/linesize records and leave scan chunks
+        # (max_records = K*B) chronically underfilled.
+        while len(out) < max_records:
+            budget = self._byte_budget
+            while True:
+                data = self._fh.read(budget)
+                if not data:
+                    return out
+                end = data.rfind(b"\n")
+                if end >= 0:
+                    break
+                if len(data) < budget:
+                    # partial trailing line, writer not done yet; rewind
+                    self._fh.seek(self._fh.tell() - len(data))
+                    return out
+                budget *= 2  # one line longer than the budget: retry bigger
                 self._fh.seek(self._fh.tell() - len(data))
-                return out
-            budget *= 2  # one line longer than the budget: retry bigger
-            self._fh.seek(self._fh.tell() - len(data))
-        # return unread tail (an incomplete line) to the file position
-        tail = len(data) - (end + 1)
-        if tail:
-            self._fh.seek(self._fh.tell() - tail)
-        # split on \n only: splitlines() would also split on \r/\v/\f etc.
-        # inside a record and corrupt the byte-offset accounting.
-        lines = data[:end].split(b"\n")
-        take = max_records - len(out)
-        for line in lines[:take]:
-            self.offset += len(line) + 1
-        out.extend(lines[:take])
-        ra.extend(lines[take:])
+            # return unread tail (an incomplete line) to the file position
+            tail = len(data) - (end + 1)
+            if tail:
+                self._fh.seek(self._fh.tell() - tail)
+            # split on \n only: splitlines() would also split on \r/\v/\f
+            # etc. inside a record and corrupt the byte-offset accounting.
+            lines = data[:end].split(b"\n")
+            take = max_records - len(out)
+            for line in lines[:take]:
+                self.offset += len(line) + 1
+            out.extend(lines[:take])
+            ra.extend(lines[take:])
         return out
 
     def poll_blocking(self, max_records: int = 65536,

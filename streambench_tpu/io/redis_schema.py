@@ -181,13 +181,11 @@ def write_windows_pipelined(r: RedisLike,
 # Stats reader (core.clj:130-149 `get-stats`)
 # ----------------------------------------------------------------------
 
-def read_stats(r: RedisLike) -> list[tuple[int, int]]:
-    """All ``(seen_count, latency_ms)`` pairs, latency = time_updated − window_ts.
-
-    Walks the schema exactly as ``get-stats`` does: campaigns set → per
-    campaign "windows" list → per window UUID hash.
-    """
-    out: list[tuple[int, int]] = []
+def walk_windows(r: RedisLike):
+    """The canonical schema walk (``get-stats``, ``core.clj:130-149``):
+    campaigns set → per-campaign "windows" list → per-window UUID hash.
+    Yields ``(campaign, window_ts_str, window_key)`` — the single source
+    of truth every reader builds on."""
     for campaign in r.execute("SMEMBERS", "campaigns"):
         windows_key = r.execute("HGET", campaign, "windows")
         if windows_key is None:
@@ -195,13 +193,42 @@ def read_stats(r: RedisLike) -> list[tuple[int, int]]:
         n = r.execute("LLEN", windows_key)
         for window_ts in r.execute("LRANGE", windows_key, 0, n):
             window_key = r.execute("HGET", campaign, window_ts)
-            if window_key is None:
-                continue
-            seen = r.execute("HGET", window_key, "seen_count")
-            updated = r.execute("HGET", window_key, "time_updated")
-            if seen is None or updated is None:
-                continue
-            out.append((int(seen), int(updated) - int(window_ts)))
+            if window_key is not None:
+                yield campaign, window_ts, window_key
+
+
+def read_stats(r: RedisLike) -> list[tuple[int, int]]:
+    """All ``(seen_count, latency_ms)`` pairs, latency = time_updated −
+    window_ts, one row per (campaign, window) — ``get-stats``'s view."""
+    out: list[tuple[int, int]] = []
+    for _, window_ts, window_key in walk_windows(r):
+        seen = r.execute("HGET", window_key, "seen_count")
+        updated = r.execute("HGET", window_key, "time_updated")
+        if seen is None or updated is None:
+            continue
+        out.append((int(seen), int(updated) - int(window_ts)))
+    return out
+
+
+def read_window_latencies(r: RedisLike) -> dict[int, int]:
+    """Per UNIQUE window: ``window_ts -> final writeback latency`` (ms).
+
+    The canonical walk yields one row per (campaign, window); percentile
+    reports over those rows overweight windows with many campaigns and
+    collapse to a handful of distinct values (every campaign in a window
+    shares its stamps).  The honest latency distribution — what
+    ``README.markdown:36-37`` defines — has one sample per window: the
+    LAST ``time_updated`` that touched it, minus the window timestamp.
+    """
+    out: dict[int, int] = {}
+    for _, window_ts, window_key in walk_windows(r):
+        updated = r.execute("HGET", window_key, "time_updated")
+        if updated is None:
+            continue
+        ts = int(window_ts)
+        lat = int(updated) - ts
+        if ts not in out or lat > out[ts]:
+            out[ts] = lat
     return out
 
 
@@ -210,19 +237,11 @@ def read_seen_counts(r: RedisLike) -> dict[str, dict[int, int]]:
     (``check-correct``, ``core.clj:215-237``)."""
     out: dict[str, dict[int, int]] = {}
     for campaign in r.execute("SMEMBERS", "campaigns"):
-        windows_key = r.execute("HGET", campaign, "windows")
-        if windows_key is None:
-            continue
-        n = r.execute("LLEN", windows_key)
-        per: dict[int, int] = {}
-        for window_ts in r.execute("LRANGE", windows_key, 0, n):
-            window_key = r.execute("HGET", campaign, window_ts)
-            if window_key is None:
-                continue
-            seen = r.execute("HGET", window_key, "seen_count")
-            if seen is not None:
-                per[int(window_ts)] = int(seen)
-        out[campaign] = per
+        out.setdefault(campaign, {})
+    for campaign, window_ts, window_key in walk_windows(r):
+        seen = r.execute("HGET", window_key, "seen_count")
+        if seen is not None:
+            out[campaign][int(window_ts)] = int(seen)
     return out
 
 

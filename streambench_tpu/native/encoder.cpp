@@ -56,11 +56,17 @@ struct StringInterner {
   }
 };
 
+// "Unset" sentinel for base_time_ms.  NOT -1 / "< 0": a legitimately
+// negative base is routine for small synthetic event times (base =
+// t - t%divisor - lateness goes negative whenever t < divisor+lateness),
+// and conflating it with "unset" silently re-rebased every batch.
+constexpr int64_t kBaseUnset = INT64_MIN;
+
 struct Encoder {
   std::unordered_map<std::string, int32_t> ad_index;
   StringInterner users;
   StringInterner pages;
-  int64_t base_time_ms = -1;  // -1: unset
+  int64_t base_time_ms = kBaseUnset;
   int64_t divisor_ms = 10000;
   int64_t lateness_ms = 60000;
   int32_t unknown_ad = 0;
@@ -216,7 +222,7 @@ int64_t sb_encode_json(void* enc_, const char* buf,
       status[i] = 2;
       continue;
     }
-    if (enc->base_time_ms < 0) {
+    if (enc->base_time_ms == kBaseUnset) {
       enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
     }
     auto ad_it = enc->ad_index.find(

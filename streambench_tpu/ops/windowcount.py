@@ -25,11 +25,16 @@ Two scatter strategies are provided (``method=``):
 
 - ``"scatter"`` — a flat ``.at[].add`` scatter-add; masked rows get index -1
   which JAX scatters drop.
-- ``"onehot"``  — a one-hot f32 reduction, the classic MXU-friendly
-  formulation (f32 keeps integer exactness to 2^24; batch sizes are far
-  below that).
+- ``"onehot"``  — a one-hot f32 reduction over the flattened [C*W] cell
+  space; materializes a [B, C*W] intermediate, so only viable while C*W is
+  small.
+- ``"matmul"``  — the factored MXU formulation: the [C, W] count delta is
+  ``campaign_onehot[B,C]^T @ slot_onehot[B,W]``, a real f32 matmul on the
+  systolic array.  Intermediates are [B,C] + [B,W] (not [B,C*W]), so it
+  scales in C and W independently; f32 accumulation of 0/1 over B stays
+  exact to 2^24, far above any batch size.
 
-``bench.py`` picks per backend; both are bit-identical (tested).
+``bench.py`` picks per backend; all three are bit-identical (tested).
 
 All times are int32 ms relative to the encoder's ``base_time_ms``; window
 ids are int32.  Nothing here uses dynamic shapes or Python control flow, so
@@ -152,6 +157,17 @@ def step(state: WindowState, join_table: jax.Array,
         onehot = (flat[:, None] == jnp.arange(C * W, dtype=jnp.int32)[None, :])
         counts = state.counts + jnp.sum(
             onehot.astype(jnp.float32), axis=0).astype(jnp.int32).reshape(C, W)
+    elif method == "matmul":
+        # Masked rows have campaign -1 / arbitrary slot; zeroing their
+        # campaign one-hot row zeroes their whole outer-product contribution.
+        camp_oh = ((campaign[:, None] == jnp.arange(C, dtype=jnp.int32))
+                   & count_mask[:, None]).astype(jnp.float32)      # [B, C]
+        slot_oh = (slot[:, None] == jnp.arange(W, dtype=jnp.int32)
+                   ).astype(jnp.float32)                           # [B, W]
+        delta = jax.lax.dot_general(
+            camp_oh, slot_oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                    # [C, W]
+        counts = state.counts + delta.astype(jnp.int32)
     else:
         raise ValueError(f"unknown method {method!r}")
 

@@ -161,6 +161,13 @@ class DistributedWindowEngine(ShardedWindowEngine):
             np.array([lo, hi], np.int64))
         return int(spans[:, 0].min()), int(spans[:, 1].max())
 
+    # Lockstep: collective call counts must match across processes, so
+    # no multi-batch chunking of any kind.
+    SCAN_SUPPORTED = False
+
+    def process_chunk(self, lines: list[bytes]) -> int:
+        return self.process_lines(lines)
+
     def step_empty(self) -> None:
         """Participate in one step with no local data (peers still have
         events; collectives need every process)."""
@@ -265,4 +272,6 @@ def run_distributed_catchup(engine: DistributedWindowEngine, reader,
         if steps % flush_every == 0:
             engine.flush()
     engine.flush()
+    engine.drain_writes()  # flush() queues on the writer thread; the
+    # function's contract is "flushed to Redis", so block until it landed
     return engine.events_processed

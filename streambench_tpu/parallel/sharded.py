@@ -70,6 +70,56 @@ def sharded_init_state(num_campaigns: int, window_slots: int,
     )
 
 
+def _fold_one(counts, window_ids, watermark, dropped, join_table,
+              ad_idx, event_type, event_time, valid,
+              *, divisor_ms: int, lateness_ms: int, view_type: int):
+    """Per-batch fold, written against shard-local views inside shard_map.
+    Shared by the single-batch step and the scanned multi-batch step."""
+    Cl, W = counts.shape
+
+    campaign = join_table[ad_idx]                 # local [b] gather-join
+    wid = event_time // divisor_ms
+    wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+    batch_max = jnp.max(jnp.where(valid, event_time, NEG))
+    new_wm = jax.lax.pmax(jnp.maximum(watermark, batch_max), DATA_AXIS)
+
+    # Lateness vs the watermark as of batch start (see ops.windowcount).
+    min_wid = (watermark - lateness_ms) // divisor_ms
+    mask = wanted & (wid >= min_wid) & (wid >= 0)
+
+    # Global ring-slot claim: local masked scatter-max, then pmax so
+    # every device agrees which window owns each slot.
+    slot = wid % W
+    slot_or_pad = jnp.where(mask, slot, W)
+    padded = jnp.concatenate(
+        [window_ids, jnp.full((1,), -1, jnp.int32)])
+    padded = padded.at[slot_or_pad].max(wid)
+    new_ids = jax.lax.pmax(padded[:W], DATA_AXIS)
+
+    owns = new_ids[slot] == wid
+    count_mask = mask & owns
+
+    # Keyed-state routing without moving events: each device counts
+    # only campaigns in its shard, into a local delta; psum over the
+    # data axis completes every (campaign, window) cell.
+    c0 = jax.lax.axis_index(CAMPAIGN_AXIS) * Cl
+    local_c = campaign - c0
+    in_shard = count_mask & (local_c >= 0) & (local_c < Cl)
+    flat = jnp.where(in_shard, local_c * W + slot, Cl * W)
+    delta = (jnp.zeros((Cl * W,), jnp.int32)
+             .at[flat].add(1, mode="drop"))
+    delta = jax.lax.psum(delta, DATA_AXIS).reshape(Cl, W)
+    new_counts = counts + delta
+
+    counted = jax.lax.psum(
+        jnp.sum(in_shard.astype(jnp.int32)), (DATA_AXIS, CAMPAIGN_AXIS))
+    wanted_total = jax.lax.psum(
+        jnp.sum(wanted.astype(jnp.int32)), DATA_AXIS)
+    new_dropped = dropped + wanted_total - counted
+    return new_counts, new_ids, new_wm, new_dropped
+
+
 @functools.lru_cache(maxsize=None)
 def _build_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
                 view_type: int):
@@ -77,54 +127,47 @@ def _build_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
 
     def body(counts, window_ids, watermark, dropped, join_table,
              ad_idx, event_type, event_time, valid):
-        Cl, W = counts.shape
-
-        campaign = join_table[ad_idx]                 # local [b] gather-join
-        wid = event_time // divisor_ms
-        wanted = valid & (event_type == view_type) & (campaign >= 0)
-
-        batch_max = jnp.max(jnp.where(valid, event_time, NEG))
-        new_wm = jax.lax.pmax(jnp.maximum(watermark, batch_max), DATA_AXIS)
-
-        # Lateness vs the watermark as of batch start (see ops.windowcount).
-        min_wid = (watermark - lateness_ms) // divisor_ms
-        mask = wanted & (wid >= min_wid) & (wid >= 0)
-
-        # Global ring-slot claim: local masked scatter-max, then pmax so
-        # every device agrees which window owns each slot.
-        slot = wid % W
-        slot_or_pad = jnp.where(mask, slot, W)
-        padded = jnp.concatenate(
-            [window_ids, jnp.full((1,), -1, jnp.int32)])
-        padded = padded.at[slot_or_pad].max(wid)
-        new_ids = jax.lax.pmax(padded[:W], DATA_AXIS)
-
-        owns = new_ids[slot] == wid
-        count_mask = mask & owns
-
-        # Keyed-state routing without moving events: each device counts
-        # only campaigns in its shard, into a local delta; psum over the
-        # data axis completes every (campaign, window) cell.
-        c0 = jax.lax.axis_index(CAMPAIGN_AXIS) * Cl
-        local_c = campaign - c0
-        in_shard = count_mask & (local_c >= 0) & (local_c < Cl)
-        flat = jnp.where(in_shard, local_c * W + slot, Cl * W)
-        delta = (jnp.zeros((Cl * W,), jnp.int32)
-                 .at[flat].add(1, mode="drop"))
-        delta = jax.lax.psum(delta, DATA_AXIS).reshape(Cl, W)
-        new_counts = counts + delta
-
-        counted = jax.lax.psum(
-            jnp.sum(in_shard.astype(jnp.int32)), (DATA_AXIS, CAMPAIGN_AXIS))
-        wanted_total = jax.lax.psum(
-            jnp.sum(wanted.astype(jnp.int32)), DATA_AXIS)
-        new_dropped = dropped + wanted_total - counted
-        return new_counts, new_ids, new_wm, new_dropped
+        return _fold_one(counts, window_ids, watermark, dropped, join_table,
+                         ad_idx, event_type, event_time, valid,
+                         divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                         view_type=view_type)
 
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                view_type: int):
+    """Compile-cached scanned sharded step: fold [K, B] stacked batches in
+    one dispatch (the multi-device peer of ``ops.windowcount.scan_steps``).
+    Collectives run inside the scan body, so cross-device merges happen
+    per folded batch and semantics stay bit-identical to K single steps."""
+
+    def body(counts, window_ids, watermark, dropped, join_table,
+             ad_idx, event_type, event_time, valid):
+        def one(carry, xs):
+            c, ids, wm, dr = carry
+            a, e, t, v = xs
+            return _fold_one(c, ids, wm, dr, join_table, a, e, t, v,
+                             divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                             view_type=view_type), None
+
+        carry, _ = jax.lax.scan(
+            one, (counts, window_ids, watermark, dropped),
+            (ad_idx, event_type, event_time, valid))
+        return carry
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
+                  P(None, DATA_AXIS), P(None, DATA_AXIS),
+                  P(None, DATA_AXIS), P(None, DATA_AXIS)),
         out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
     )
     return jax.jit(mapped)
@@ -193,3 +236,11 @@ class ShardedWindowEngine(AdAnalyticsEngine):
             self.mesh, self.state, self.join_table,
             batch.ad_idx, batch.event_type, batch.event_time, batch.valid,
             divisor_ms=self.divisor, lateness_ms=self.lateness)
+
+    def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
+        fn = _build_scan(self.mesh, self.divisor, self.lateness, 0)
+        counts, ids, wm, dropped = fn(
+            self.state.counts, self.state.window_ids, self.state.watermark,
+            self.state.dropped, self.join_table,
+            ad_idx, event_type, event_time, valid)
+        self.state = WindowState(counts, ids, wm, dropped)

@@ -15,7 +15,7 @@ import jax
 import numpy as np
 import pytest
 
-from streambench_tpu.engine.pipeline import ONEHOT_MAX_CELLS, default_method
+from streambench_tpu.engine.pipeline import MATMUL_MAX_CAMPAIGNS, default_method
 from streambench_tpu.ops import windowcount as wc
 from streambench_tpu.parallel import (
     build_mesh,
@@ -30,12 +30,13 @@ DIV = 10_000
 LATE = 20_000
 
 
-def test_default_method_scales_by_cells():
-    # Small state may pick either formulation; big state must never
-    # pick one-hot regardless of backend.
-    assert default_method(C_BIG * W) == "scatter"
-    assert default_method(ONEHOT_MAX_CELLS + 1) == "scatter"
-    assert default_method() in ("scatter", "onehot")
+def test_default_method_scales_by_campaigns():
+    # Small key spaces may pick the MXU formulation; big ones must never
+    # pick it regardless of backend (a [B, 1e6] f32 one-hot operand).
+    assert default_method(C_BIG, W) == "scatter"
+    assert default_method(MATMUL_MAX_CAMPAIGNS + 1) == "scatter"
+    assert default_method() in ("scatter", "matmul")
+    assert default_method(100, 512) in ("scatter", "matmul")
 
 
 def test_million_campaign_sharded_exact():
@@ -91,9 +92,9 @@ def test_million_campaign_sharded_exact():
     assert any(c == C_BIG - 1 for c, _ in got)
 
 
-def test_scatter_and_onehot_bit_identical_small():
-    # The method choice is a performance decision only; both formulations
-    # must agree bit-for-bit wherever one-hot is legal.
+def test_all_methods_bit_identical_small():
+    # The method choice is a performance decision only; every formulation
+    # must agree bit-for-bit wherever it is legal.
     rng = np.random.default_rng(3)
     C, n_ads, B = 64, 200, 128
     join = np.concatenate([rng.integers(0, C, n_ads).astype(np.int32), [-1]])
@@ -105,8 +106,10 @@ def test_scatter_and_onehot_bit_identical_small():
     )
     s1 = wc.step(wc.init_state(C, W), join, *args, divisor_ms=DIV,
                  lateness_ms=LATE, method="scatter")
-    s2 = wc.step(wc.init_state(C, W), join, *args, divisor_ms=DIV,
-                 lateness_ms=LATE, method="onehot")
-    np.testing.assert_array_equal(np.asarray(s1.counts), np.asarray(s2.counts))
-    np.testing.assert_array_equal(np.asarray(s1.window_ids),
-                                  np.asarray(s2.window_ids))
+    for method in ("onehot", "matmul"):
+        s2 = wc.step(wc.init_state(C, W), join, *args, divisor_ms=DIV,
+                     lateness_ms=LATE, method=method)
+        np.testing.assert_array_equal(np.asarray(s1.counts),
+                                      np.asarray(s2.counts))
+        np.testing.assert_array_equal(np.asarray(s1.window_ids),
+                                      np.asarray(s2.window_ids))

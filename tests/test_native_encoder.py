@@ -100,3 +100,29 @@ def test_native_intern_consistency_across_fallback():
     b2 = nat.encode([slow], 2)
     assert b1.user_idx[0] == b2.user_idx[0]
     assert b1.page_idx[0] == b2.page_idx[0]
+
+
+def test_negative_base_time_is_stable_across_batches():
+    """Regression: small event times (t < divisor + lateness) produce a
+    legitimately NEGATIVE base_time_ms; the native encoder's old "< 0 ==
+    unset" sentinel re-rebased every batch, shifting window ids between
+    chunks (found by hypothesis differential testing)."""
+    import pytest
+
+    from streambench_tpu import native
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    from streambench_tpu.encode.encoder import EventEncoder
+    from streambench_tpu.encode.native_encoder import NativeEventEncoder
+
+    mapping = {"adX": "campX"}
+    mk = lambda t: (
+        '{"user_id": "u", "page_id": "p", "ad_id": "adX", "ad_type":'
+        ' "mail", "event_type": "view", "event_time": "%d"}' % t).encode()
+    py = EventEncoder(mapping, divisor_ms=10_000, lateness_ms=60_000)
+    nat = NativeEventEncoder(mapping, divisor_ms=10_000, lateness_ms=60_000)
+    for chunk in ([mk(49_954)], [mk(70_779)], [mk(39_867)]):
+        bp = py.encode(chunk, 4)
+        bn = nat.encode(chunk, 4)
+        assert bp.base_time_ms == bn.base_time_ms == -20_000
+        assert bp.event_time[0] == bn.event_time[0]

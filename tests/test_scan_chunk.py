@@ -1,0 +1,179 @@
+"""Dispatch-amortization + deferred-drain paths (round-3 perf work).
+
+The catchup hot loop folds K stacked micro-batches per device dispatch
+(``AdAnalyticsEngine.process_chunk`` -> ``ops.windowcount.scan_steps``)
+and defers drain materialization off the hot path
+(``_drain_device`` parks device arrays; ``_materialize_drains`` pulls
+them at flush/snapshot time).  These tests pin that every such shortcut
+is invisible to correctness: chunked == per-line, snapshots see parked
+deltas, and the sharded scan matches the per-batch sharded step.
+"""
+
+import random
+
+import jax
+import numpy as np
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.encode import EventEncoder
+from streambench_tpu.engine.pipeline import AdAnalyticsEngine
+
+
+def make_lines(n, seed=0, start=1_700_000_000_000, spacing_ms=10):
+    campaigns = [f"c{i}" for i in range(10)]
+    mapping = {f"ad{i}_{j}": campaigns[i]
+               for i in range(10) for j in range(10)}
+    src = gen.EventSource(ads=list(mapping),
+                          user_ids=[f"u{i}" for i in range(20)],
+                          page_ids=["p"], rng=random.Random(seed))
+    lines = [src.event_at(start + spacing_ms * i).encode()
+             for i in range(n)]
+    return lines, mapping, campaigns
+
+
+def drained_pending(eng):
+    """Drain + materialize WITHOUT flushing (flush clears _pending)."""
+    eng._drain_device()
+    eng._materialize_drains()
+    return dict(eng._pending)
+
+
+def run_engine(lines, mapping, campaigns, *, chunked, slots=16,
+               batch=256, scan_batches=4):
+    cfg = default_config(jax_batch_size=batch, jax_window_slots=slots,
+                         jax_scan_batches=scan_batches)
+    eng = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
+    if chunked:
+        step = batch * scan_batches
+        for off in range(0, len(lines), step):
+            eng.process_chunk(lines[off:off + step])
+    else:
+        for off in range(0, len(lines), batch):
+            eng.process_lines(lines[off:off + batch])
+    return eng
+
+
+def test_chunked_equals_per_line():
+    lines, mapping, campaigns = make_lines(5000, seed=2)
+    a = run_engine(lines, mapping, campaigns, chunked=False)
+    b = run_engine(lines, mapping, campaigns, chunked=True)
+    assert a.events_processed == b.events_processed == 5000
+    assert a.dropped == 0 and b.dropped == 0
+    pa, pb = drained_pending(a), drained_pending(b)
+    assert pa == pb and sum(pa.values()) > 0
+
+
+def test_chunked_spanning_many_windows_uses_guard():
+    # 4000 events at 100 ms spacing = 400 s of event time against a
+    # W=16 ring (80 s safe span): groups must drain via the span guard
+    # (or fall back per-batch) and still be exact.
+    lines, mapping, campaigns = make_lines(4000, seed=3, spacing_ms=100)
+    a = run_engine(lines, mapping, campaigns, chunked=False)
+    b = run_engine(lines, mapping, campaigns, chunked=True)
+    assert b.dropped == 0
+    pa, pb = drained_pending(a), drained_pending(b)
+    assert pa == pb and sum(pb.values()) > 0
+    # many distinct windows were actually produced
+    assert len({ts for _, ts in pb}) > 16
+
+
+def test_chunk_ragged_tail_and_empty():
+    lines, mapping, campaigns = make_lines(1000, seed=4)
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=4)
+    eng = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
+    eng.process_chunk([])                 # no-op
+    eng.process_chunk(lines[:700])        # 2 full + 1 ragged batch
+    eng.process_chunk(lines[700:])        # 1 full + ragged
+    assert eng.events_processed == 1000
+    assert sum(drained_pending(eng).values()) == sum(
+        1 for ln in lines if b'"view"' in ln)
+
+
+def test_snapshot_sees_parked_drains():
+    # Force a drain (parked, not materialized), then snapshot: the parked
+    # deltas must appear in the snapshot's pending list, not vanish.
+    lines, mapping, campaigns = make_lines(600, seed=5)
+    cfg = default_config(jax_batch_size=128, jax_window_slots=16)
+    eng = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
+    eng.process_lines(lines[:256])
+    eng._drain_device()                   # parks device arrays
+    assert eng._undrained
+    snap = eng.snapshot(offset=0)
+    assert not eng._undrained             # materialized by snapshot
+    total = sum(n for _, _, n in snap.pending)
+    views = sum(1 for ln in lines[:256] if b'"view"' in ln)
+    assert total == views
+
+    # restore into a fresh engine and continue: totals stay exact
+    eng2 = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
+    eng2.restore(snap)
+    eng2.process_lines(lines[256:])
+    all_views = sum(1 for ln in lines if b'"view"' in ln)
+    assert sum(drained_pending(eng2).values()) == all_views
+
+
+def test_sharded_scan_matches_per_batch_step():
+    from streambench_tpu.parallel import build_mesh
+    from streambench_tpu.parallel.sharded import ShardedWindowEngine
+
+    lines, mapping, campaigns = make_lines(2048, seed=6)
+    mesh = build_mesh(data=2, campaign=4, devices=jax.devices()[:8])
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=4)
+    a = ShardedWindowEngine(cfg, mapping, mesh, campaigns=campaigns)
+    for off in range(0, len(lines), 256):
+        a.process_lines(lines[off:off + 256])
+
+    b = ShardedWindowEngine(cfg, mapping, mesh, campaigns=campaigns)
+    assert b.SCAN_SUPPORTED
+    b.process_chunk(lines)
+
+    pa, pb = drained_pending(a), drained_pending(b)
+    assert pa == pb and sum(pa.values()) > 0
+    assert a.dropped == b.dropped == 0
+
+
+def test_failed_redis_write_is_reclaimed_and_retried():
+    """A transient Redis outage must not undercount windows: the writer
+    thread retains failed batches and the next flush retries them."""
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.redis_schema import as_redis, read_seen_counts
+
+    class FlakyRedis:
+        def __init__(self, inner):
+            self._inner = inner
+            self.fail = False
+
+        def execute(self, *a):
+            if self.fail:
+                raise OSError("redis down")
+            return self._inner.execute(*a)
+
+        def pipeline_execute(self, cmds):
+            if self.fail:
+                raise OSError("redis down")
+            return self._inner.pipeline_execute(cmds)
+
+    from streambench_tpu.io.redis_schema import seed_campaigns
+
+    lines, mapping, campaigns = make_lines(512, seed=9)
+    inner = as_redis(FakeRedisStore())
+    seed_campaigns(inner, campaigns)
+    r = FlakyRedis(inner)
+    cfg = default_config(jax_batch_size=128)
+    eng = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns, redis=r)
+
+    eng.process_lines(lines[:256])
+    r.fail = True
+    eng.flush(time_updated=111)      # write fails in the writer thread
+    eng.drain_writes()
+    r.fail = False
+    eng.process_lines(lines[256:])
+    eng.flush(time_updated=222)      # reclaims + retries the failed rows
+    eng.close()
+
+    total = sum(n for per in read_seen_counts(inner).values()
+                for n in per.values())
+    views = sum(1 for ln in lines if b'"view"' in ln)
+    assert total == views

@@ -82,10 +82,13 @@ def test_methods_agree():
     lines, mapping, campaigns = make_dataset(1500, seed=3)
     enc1 = EventEncoder(mapping, campaigns)
     s1 = run_engine(lines, enc1, method="scatter")
-    enc2 = EventEncoder(mapping, campaigns)
-    s2 = run_engine(lines, enc2, method="onehot")
-    assert np.array_equal(np.asarray(s1.counts), np.asarray(s2.counts))
-    assert np.array_equal(np.asarray(s1.window_ids), np.asarray(s2.window_ids))
+    for method in ("onehot", "matmul"):
+        enc2 = EventEncoder(mapping, campaigns)
+        s2 = run_engine(lines, enc2, method=method)
+        assert np.array_equal(np.asarray(s1.counts), np.asarray(s2.counts))
+        assert np.array_equal(np.asarray(s1.window_ids),
+                              np.asarray(s2.window_ids))
+        assert int(s1.dropped) == int(s2.dropped)
 
 
 def test_skewed_data_matches_golden_within_lateness():
