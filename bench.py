@@ -174,48 +174,62 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
     # (a reused topic would replay the previous run's journal from offset
     # 0 and poison both the throughput and the latency stamps).
     topic = f"{cfg.kafka_topic}-paced-{run_id}-{rate}"
+    # One Python generator tops out around ~180k ev/s; shard the load
+    # across producer processes + partitions so the sweep probes the
+    # ENGINE's ceiling, not the generator's (the reference scales load
+    # the same way: kafka.partitions + parallel producers).
+    n_prod = max(1, -(-rate // 140_000))
+    broker.create_topic(topic, n_prod)
     engine = AdAnalyticsEngine(cfg, mapping, redis=r)
-    runner = StreamRunner(engine, broker.reader(topic))
+    reader = (broker.multi_reader(topic) if n_prod > 1
+              else broker.reader(topic))
+    runner = StreamRunner(engine, reader)
 
-    # The producer runs as its OWN process (the reference's generator is a
-    # separate JVM, stream-bench.sh:229): in-process it contends with the
+    # Producers run as their OWN processes (the reference's generator is a
+    # separate JVM, stream-bench.sh:229): in-process they contend with the
     # engine for the GIL and the measured "unsustained" rate would be the
     # producer's starvation, not the engine's limit.
     from streambench_tpu.config import write_local_conf
 
     conf_path = os.path.join(workdir, f"paced-{run_id}-{rate}.yaml")
     write_local_conf(conf_path, {"kafka.topic": topic})
-    prod_log = os.path.join(workdir, f"paced-{run_id}-{rate}.log")
-    with open(prod_log, "wb") as logf:
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "streambench_tpu.datagen", "-r",
-             "-t", str(rate), "--duration", str(duration_s),
-             "--configPath", conf_path, "--workdir", workdir,
-             "--brokerDir", broker.root],
-            stdout=logf, stderr=subprocess.STDOUT,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for p_idx in range(n_prod):
+        share = rate // n_prod + (1 if p_idx < rate % n_prod else 0)
+        prod_log = os.path.join(workdir,
+                                f"paced-{run_id}-{rate}-{p_idx}.log")
+        with open(prod_log, "wb") as logf:
+            procs.append((prod_log, subprocess.Popen(
+                [sys.executable, "-m", "streambench_tpu.datagen", "-r",
+                 "-t", str(share), "--duration", str(duration_s),
+                 "--partition", str(p_idx),
+                 "--configPath", conf_path, "--workdir", workdir,
+                 "--brokerDir", broker.root],
+                stdout=logf, stderr=subprocess.STDOUT,
+                cwd=os.path.dirname(os.path.abspath(__file__)))))
 
     sent = {}
     behind = {"n": 0, "max_ms": 0.0}
     t0 = time.monotonic()
     runner.run(duration_s=duration_s + 5.0, idle_timeout_s=5.0)
-    try:
-        proc.wait(timeout=30)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.wait()
-        log(f"paced producer at {rate}/s overran its duration; killed")
-    if proc.returncode not in (0, -9):  # -9 = our own overrun kill
+    for prod_log, proc in procs:
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            log(f"paced producer at {rate}/s overran its duration; killed")
+        if proc.returncode not in (0, -9):  # -9 = our own overrun kill
+            with open(prod_log, "r", errors="replace") as f:
+                tail = f.read()[-400:]
+            raise RuntimeError(
+                f"paced producer exited rc={proc.returncode}: {tail}")
         with open(prod_log, "r", errors="replace") as f:
-            tail = f.read()[-400:]
-        raise RuntimeError(
-            f"paced producer exited rc={proc.returncode}: {tail}")
-    with open(prod_log, "r", errors="replace") as f:
-        for line in f:
-            if line.startswith("emitted "):
-                sent["n"] = int(line.split()[1])
-            elif line.startswith("Falling behind"):
-                behind["n"] += 1
+            for line in f:
+                if line.startswith("emitted "):
+                    sent["n"] = sent.get("n", 0) + int(line.split()[1])
+                elif line.startswith("Falling behind"):
+                    behind["n"] += 1
     engine.close()
     wall = time.monotonic() - t0
     log(engine.tracer.report())
@@ -247,7 +261,8 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
 
 def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
                    duration_s: float, sla_ms: int,
-                   max_runs: int = 3) -> dict:
+                   max_runs: int = 3, rate_ceiling: int | None = None
+                   ) -> dict:
     """Escalating-rate ladder (the reference's experimental method: find
     the max load the engine sustains at bounded latency,
     ``README.markdown:36-37``).  Starts at ``start_rate`` (the baseline
@@ -276,6 +291,8 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
         if sustained:
             best = max(best or 0, rate)
             rate = int(rate * 1.5)
+            if rate_ceiling and rate > rate_ceiling:
+                break  # can't sustain beyond catchup throughput anyway
         else:
             rate = max(int(rate * 0.5), 1_000)
             if best is not None and rate <= best:
@@ -379,10 +396,13 @@ def main() -> int:
         start_rate = paced_rate or int(min(BASELINE_EVENTS_PER_S,
                                            max(stats.events_per_s / 2,
                                                1_000)))
+        sweep_runs = int(os.environ.get("STREAMBENCH_BENCH_SWEEP_RUNS",
+                                        "3"))
         sweep = {}
         try:
             sweep = _latency_sweep(cfg, mapping, broker, wd, start_rate,
-                                   paced_dur, sla_ms)
+                                   paced_dur, sla_ms, max_runs=sweep_runs,
+                                   rate_ceiling=int(stats.events_per_s))
         except Exception as e:  # diagnostics must never kill the headline
             log(f"paced latency sweep failed (non-fatal): {e!r}")
 

@@ -48,6 +48,11 @@ STOP_STATS_GRACE_S = float(os.environ.get("STOP_STATS_GRACE", "2.5"))
 ENGINE = os.environ.get("ENGINE", "exact")   # exact|hll|sliding|session
 MICROBATCH = os.environ.get("MICROBATCH", "") not in ("", "0", "false", "no")
 CHECKPOINT_DIR = os.environ.get("CHECKPOINT_DIR", "")
+# Real-Kafka opt-in: "host:9092[,host2:9092]" routes every broker through
+# io.kafka.KafkaBroker instead of the file journal (the reference's
+# firehose, stream-bench.sh:107-115).  Errors loudly if confluent-kafka
+# is absent — no silent fallback.
+KAFKA_BROKERS = os.environ.get("KAFKA_BROKERS", "")
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -162,6 +167,7 @@ def op_setup() -> None:
     sys.path.insert(0, REPO_ROOT)
     from streambench_tpu.config import write_local_conf
     write_local_conf(CONF_FILE, {
+        "kafka.bootstrap": KAFKA_BROKERS,
         "kafka.brokers": ["localhost"],
         "zookeeper.servers": ["localhost"],
         "kafka.port": 9092,

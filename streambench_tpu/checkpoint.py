@@ -37,7 +37,10 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2: offset may be a per-partition vector (multi-partition topics).
+# v1 snapshots (scalar offset) remain readable.
+FORMAT_VERSION = 2
+READABLE_VERSIONS = (1, 2)
 
 
 class CheckpointVersionError(RuntimeError):
@@ -52,9 +55,15 @@ class CheckpointVersionError(RuntimeError):
 
 @dataclass
 class Snapshot:
-    """One engine checkpoint, decoded (see ``AdAnalyticsEngine.restore``)."""
+    """One engine checkpoint, decoded (see ``AdAnalyticsEngine.restore``).
 
-    offset: int
+    ``offset`` is the journal position to re-tail from: a single int for
+    one partition, or a per-partition vector (``MultiReader.offsets``)
+    for a multi-partition topic — the Kafka committed-offset-vector
+    analog (``AdvertisingTopologyNative.java:92``).
+    """
+
+    offset: int | list[int]
     meta: dict
     counts: np.ndarray        # [C, W] int32 undrained device deltas
     window_ids: np.ndarray    # [W] int32
@@ -75,8 +84,11 @@ class Snapshot:
 def _encode(snapshot: Snapshot) -> dict:
     pending = np.asarray(snapshot.pending, np.int64).reshape(-1, 3)
     latency = np.asarray(snapshot.latency, np.int64).reshape(-1, 2)
+    offset = (list(map(int, snapshot.offset))
+              if isinstance(snapshot.offset, (list, tuple))
+              else int(snapshot.offset))
     meta = dict(snapshot.meta)
-    meta.update(version=FORMAT_VERSION, offset=int(snapshot.offset),
+    meta.update(version=FORMAT_VERSION, offset=offset,
                 watermark=int(snapshot.watermark),
                 dropped=int(snapshot.dropped))
     out = dict(
@@ -93,12 +105,13 @@ def _encode(snapshot: Snapshot) -> dict:
 
 def _decode(z) -> Snapshot:
     meta = json.loads(bytes(z["meta"].tobytes()).decode())
-    if meta.get("version") != FORMAT_VERSION:
+    if meta.get("version") not in READABLE_VERSIONS:
         raise CheckpointVersionError(
             f"unsupported checkpoint version {meta.get('version')} "
-            f"(this build reads {FORMAT_VERSION})")
+            f"(this build reads {READABLE_VERSIONS})")
+    off = meta["offset"]
     return Snapshot(
-        offset=int(meta["offset"]),
+        offset=[int(o) for o in off] if isinstance(off, list) else int(off),
         meta=meta,
         counts=z["counts"],
         window_ids=z["window_ids"],

@@ -60,6 +60,12 @@ class BenchmarkConfig:
     redis_port: int = 6379                 # (Jedis default, AdvertisingSpark.scala:177)
     kafka_topic: str = "test1"             # :17
     kafka_partitions: int = 1              # :18
+    # Real-cluster opt-in (new key): a non-empty bootstrap string selects
+    # the confluent-kafka adapter (io.kafka.make_broker); empty keeps the
+    # hermetic file-journal broker.  The harness maps the KAFKA_BROKERS
+    # env var here (the reference's firehose IS Kafka,
+    # stream-bench.sh:107-115).
+    kafka_bootstrap: str = ""              # kafka.bootstrap
     process_hosts: int = 1                 # :20
     process_cores: int = 4                 # :21
     storm_workers: int = 1                 # :24
@@ -93,6 +99,12 @@ class BenchmarkConfig:
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
     # ------------------------------------------------------------------
+    @property
+    def kafka_bootstrap_servers(self) -> str | None:
+        """Bootstrap string when a real cluster is opted in, else None
+        (the ``io.kafka.make_broker`` switch input)."""
+        return self.kafka_bootstrap or None
+
     @property
     def kafka_host_list(self) -> str:
         """``host:port,host:port`` string, as built at ``core.clj:252-254``."""
@@ -158,6 +170,7 @@ class BenchmarkConfig:
             redis_port=geti("redis.port", 6379),
             kafka_topic=gets("kafka.topic", "test1"),
             kafka_partitions=geti("kafka.partitions", 1),
+            kafka_bootstrap=gets("kafka.bootstrap", ""),
             process_hosts=geti("process.hosts", 1),
             process_cores=geti("process.cores", 4),
             storm_workers=geti("storm.workers", 1),

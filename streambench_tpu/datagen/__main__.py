@@ -23,7 +23,7 @@ from streambench_tpu.config import (
     find_and_read_config_file,
 )
 from streambench_tpu.datagen import gen
-from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.kafka import make_broker
 from streambench_tpu.io.resp import RespClient
 
 
@@ -48,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--brokerDir", default=None)
     p.add_argument("--duration", type=float, default=None,
                    help="seconds to run -r for (default: until killed)")
+    p.add_argument("--partition", type=int, default=0,
+                   help="broker partition -r writes to (several generator "
+                        "processes can shard one paced load across "
+                        "partitions, like parallel Kafka producers)")
     p.add_argument("--maxEvents", type=int, default=None)
     p.add_argument("--eventsNum", type=int, default=None,
                    help="override events.num for -s")
@@ -66,7 +70,8 @@ def main(argv: list[str] | None = None) -> int:
         else:
             print(f"error: {e}", file=sys.stderr)
             return 2
-    broker = FileBroker(args.brokerDir or f"{args.workdir}/broker")
+    broker = make_broker(cfg.kafka_bootstrap_servers,
+                         args.brokerDir or f"{args.workdir}/broker")
 
     def redis():
         if cfg.redis_host == ":inprocess:":
@@ -118,8 +123,9 @@ def main(argv: list[str] | None = None) -> int:
             raise SystemExit(0)
 
         signal.signal(signal.SIGTERM, _term)
-        broker.create_topic(cfg.kafka_topic)
-        with broker.writer(cfg.kafka_topic) as sink:
+        broker.create_topic(cfg.kafka_topic,
+                            max(cfg.kafka_partitions, args.partition + 1))
+        with broker.writer(cfg.kafka_topic, args.partition) as sink:
             sent = gen.run_paced(
                 sink, args.throughput, duration_s=args.duration,
                 max_events=args.maxEvents, with_skew=args.with_skew,

@@ -30,7 +30,7 @@ from streambench_tpu.datagen import gen
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine
 from streambench_tpu.engine.runner import StreamRunner
 from streambench_tpu.io.fakeredis import FakeRedisStore
-from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.kafka import make_broker
 from streambench_tpu.io.redis_schema import as_redis
 from streambench_tpu.io.resp import RespClient
 
@@ -104,8 +104,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.microbatch:
         from streambench_tpu.engine.microbatch import run_microbatch
 
-        broker = FileBroker(args.brokerDir
-                            or os.path.join(args.workdir, "broker"))
+        broker = make_broker(cfg.kafka_bootstrap_servers,
+                             args.brokerDir
+                             or os.path.join(args.workdir, "broker"))
         merged, results = run_microbatch(cfg, broker, mapping,
                                          campaigns=campaigns, redis=redis)
         lats = sorted(lat for r in results for lat in r.latency.values())
@@ -143,18 +144,16 @@ def main(argv: list[str] | None = None) -> int:
 
     engine = make_engine(redis)
 
-    broker = FileBroker(args.brokerDir or os.path.join(args.workdir, "broker"))
+    broker = make_broker(cfg.kafka_bootstrap_servers,
+                         args.brokerDir
+                         or os.path.join(args.workdir, "broker"))
     broker.create_topic(cfg.kafka_topic)
+    # Checkpointing works for every engine family (sketch snapshots carry
+    # their device state + intern tables, engine.sketches) and for
+    # multi-partition topics (per-partition offset vector, checkpoint.py).
     checkpointer = None
-    if args.checkpointDir and args.engine != "exact":
-        raise SystemExit("--checkpointDir requires the exact engine "
-                         "(sketch states are not checkpointable yet)")
     n_parts = len(broker.partitions(cfg.kafka_topic))
     if args.checkpointDir:
-        if n_parts > 1:
-            raise SystemExit(
-                "--checkpointDir currently requires a single-partition "
-                f"topic (found {n_parts}); checkpoints store one offset")
         from streambench_tpu.checkpoint import Checkpointer
 
         checkpointer = Checkpointer(args.checkpointDir)
@@ -164,7 +163,7 @@ def main(argv: list[str] | None = None) -> int:
               else broker.reader(cfg.kafka_topic))
     runner = StreamRunner(engine, reader, checkpointer=checkpointer)
     if runner.resume():
-        print(f"resumed from checkpoint: offset={runner.reader.offset} "
+        print(f"resumed from checkpoint: offset={runner._reader_position()} "
               f"events={engine.events_processed}", flush=True)
 
     signal.signal(signal.SIGTERM, lambda *_: runner.stop())

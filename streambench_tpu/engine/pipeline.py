@@ -46,10 +46,11 @@ from streambench_tpu.utils.ids import now_ms
 MATMUL_MAX_CAMPAIGNS = 4_096
 
 
-def default_method(num_campaigns: int | None = None,
-                   window_slots: int | None = None) -> str:
+def default_method(num_campaigns: int | None = None) -> str:
     """Scatter-add on CPU or for large key spaces; the factored MXU matmul
-    on TPU while the campaign axis stays under ``MATMUL_MAX_CAMPAIGNS``."""
+    on TPU while the campaign axis stays under ``MATMUL_MAX_CAMPAIGNS``
+    (the [B, W] slot one-hot is never the binding operand: W is a ring of
+    open windows, bounded by config to a few hundred slots)."""
     if jax.default_backend() not in ("tpu", "axon"):
         return "scatter"
     if num_campaigns is not None and num_campaigns > MATMUL_MAX_CAMPAIGNS:
@@ -170,8 +171,7 @@ class AdAnalyticsEngine:
                                     use_native=cfg.jax_use_native_encoder)
         self.join_table = jnp.asarray(self.encoder.join_table)
         self.W = cfg.jax_window_slots
-        self.method = method or default_method(
-            self.encoder.num_campaigns, self.W)
+        self.method = method or default_method(self.encoder.num_campaigns)
         self.batch_size = cfg.jax_batch_size
         self.scan_batches = max(cfg.jax_scan_batches, 1)
         self._encode = (self.encoder.encode if input_format == "json"
@@ -415,7 +415,6 @@ class AdAnalyticsEngine:
         rows = [(self.encoder.campaigns[c], ts, n)
                 for (c, ts), n in self._pending.items()]
         self._pending.clear()
-        self.windows_written += len(rows)
         if self.redis is not None:
             if self._writer is None:
                 self._writer = _RedisWriter(
@@ -429,7 +428,10 @@ class AdAnalyticsEngine:
         return len(rows)
 
     def _note_written(self, rows, stamp: int) -> None:
-        """Latency bookkeeping at actual write time (writer thread)."""
+        """Latency + write-count bookkeeping at actual write time (writer
+        thread) — counting at submit time would double-count rows that
+        fail, get reclaimed, and are retried."""
+        self.windows_written += len(rows)
         for camp, ts, _ in rows:
             self.window_latency[ts] = stamp - ts
             self.latency_tracker.record(camp, ts, stamp)
@@ -443,7 +445,9 @@ class AdAnalyticsEngine:
         for batch in self._writer.take_failed():
             for camp, ts, n in batch:
                 if self.absolute_counts:
-                    self._pending[(idx[camp], ts)] = n
+                    # A fresher re-drained estimate already in _pending
+                    # supersedes the stale failed one — never clobber it.
+                    self._pending.setdefault((idx[camp], ts), n)
                 else:
                     self._pending[(idx[camp], ts)] += n
 

@@ -77,6 +77,14 @@ class StreamRunner:
     def stop(self) -> None:
         self._stop = True
 
+    def _reader_position(self) -> int | list[int]:
+        """Single-partition byte offset, or the per-partition offsets
+        vector of a ``MultiReader`` (whose scalar ``.offset`` raises)."""
+        try:
+            return self.reader.offset
+        except AttributeError:
+            return list(self.reader.offsets)
+
     def resume(self) -> bool:
         """Restore engine + reader from the newest checkpoint, if any.
         Call before ``run``; returns True when a snapshot was applied."""
@@ -86,11 +94,14 @@ class StreamRunner:
         if snap is None:
             return False
         self.engine.restore(snap)
-        self.reader.seek(snap.offset)
+        if isinstance(snap.offset, list):
+            self.reader.seek_offsets(snap.offset)
+        else:
+            self.reader.seek(snap.offset)
         return True
 
     def _checkpoint_now(self, now: float) -> None:
-        self.checkpointer.save(self.engine.snapshot(self.reader.offset))
+        self.checkpointer.save(self.engine.snapshot(self._reader_position()))
         self._last_ckpt = now
 
     def _checkpoint_due(self, now: float) -> bool:

@@ -225,13 +225,24 @@ class MultiReader:
             "multi-partition run needs the per-partition vector)")
 
     def poll(self, max_records: int = 65536) -> list[bytes]:
+        """Drain partitions in bounded round-robin slices.
+
+        Each partition contributes at most ``max_records // n`` per
+        sweep, so consumption stays time-balanced across partitions.
+        Letting one partition satisfy a whole request (the old behavior)
+        skews inter-partition progress by the full request's event-time
+        span — enough to push the lagging partitions past allowed
+        lateness and silently drop their events once the watermark has
+        advanced (Kafka consumers likewise interleave partition fetches).
+        """
         out: list[bytes] = []
         n = len(self._readers)
+        slice_cap = max(max_records // n, 1)
         empty_streak = 0
         while len(out) < max_records and empty_streak < n:
             r = self._readers[self._next]
             self._next = (self._next + 1) % n
-            got = r.poll(max_records=max_records - len(out))
+            got = r.poll(max_records=min(slice_cap, max_records - len(out)))
             if got:
                 out.extend(got)
                 empty_streak = 0

@@ -217,9 +217,21 @@ class KafkaBroker:
 
 
 def make_broker(brokers: str | None, journal_root: str):
-    """The one switch point: a real cluster when ``brokers`` names one and
-    the client library exists, else the hermetic file journal."""
-    if brokers and available():
+    """The one switch point: a real cluster when ``brokers`` names one,
+    else the hermetic file journal.
+
+    A named cluster with no client library is an ERROR, not a silent
+    fallback — an operator who pointed the harness at Kafka must not get
+    a file journal pretending to be one
+    (``stream-bench.sh:107-115`` likewise hard-fails without Kafka).
+    """
+    if brokers:
+        if not available():
+            raise KafkaUnavailableError(
+                f"kafka bootstrap {brokers!r} was configured but "
+                "confluent-kafka is not installed; install it or drop "
+                "the kafka.bootstrap / KAFKA_BROKERS setting to use the "
+                "file-journal broker")
         return KafkaBroker(brokers)
     from streambench_tpu.io.journal import FileBroker
 

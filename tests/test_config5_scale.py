@@ -33,10 +33,10 @@ LATE = 20_000
 def test_default_method_scales_by_campaigns():
     # Small key spaces may pick the MXU formulation; big ones must never
     # pick it regardless of backend (a [B, 1e6] f32 one-hot operand).
-    assert default_method(C_BIG, W) == "scatter"
+    assert default_method(C_BIG) == "scatter"
     assert default_method(MATMUL_MAX_CAMPAIGNS + 1) == "scatter"
     assert default_method() in ("scatter", "matmul")
-    assert default_method(100, 512) in ("scatter", "matmul")
+    assert default_method(100) in ("scatter", "matmul")
 
 
 def test_million_campaign_sharded_exact():

@@ -107,10 +107,41 @@ def test_unavailable_guard_raises_actionably():
         kafka.KafkaBroker("localhost:9092")
 
 
-def test_make_broker_falls_back_to_journal(tmp_path):
+def test_make_broker_switch_point(tmp_path):
+    # no brokers named -> the hermetic file journal
     b = kafka.make_broker(None, str(tmp_path / "j"))
     assert isinstance(b, FileBroker)
+    b2 = kafka.make_broker("", str(tmp_path / "j2"))
+    assert isinstance(b2, FileBroker)
     if not kafka.available():
-        # even with brokers named, no library -> hermetic fallback
-        b2 = kafka.make_broker("localhost:9092", str(tmp_path / "j2"))
-        assert isinstance(b2, FileBroker)
+        # brokers named but no client library: ERROR, never a silent
+        # file-journal pretending to be the configured cluster
+        with pytest.raises(kafka.KafkaUnavailableError,
+                           match="KAFKA_BROKERS"):
+            kafka.make_broker("localhost:9092", str(tmp_path / "j3"))
+
+
+def test_engine_cli_reaches_kafka_adapter(tmp_path):
+    """kafka.bootstrap in the config must route the engine CLI through
+    make_broker — in this image that means the actionable
+    KafkaUnavailableError, not a quiet FileBroker."""
+    import subprocess
+    import sys
+
+    from streambench_tpu.config import write_local_conf
+
+    if kafka.available():  # pragma: no cover
+        pytest.skip("confluent-kafka IS installed here")
+    conf = tmp_path / "conf.yaml"
+    write_local_conf(conf, {"kafka.bootstrap": "kafkahost:9092",
+                            "redis.host": ":inprocess:"})
+    # engine needs a mapping file; write a minimal one
+    (tmp_path / "ad-to-campaign-ids.txt").write_text("ad1,c1\n")
+    p = subprocess.run(
+        [sys.executable, "-m", "streambench_tpu.engine",
+         "--confPath", str(conf), "--workdir", str(tmp_path),
+         "--catchup"],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode != 0
+    assert "KafkaUnavailable" in p.stderr or "confluent-kafka" in p.stderr
