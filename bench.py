@@ -212,6 +212,10 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
     behind = {"n": 0, "max_ms": 0.0}
     t0 = time.monotonic()
     runner.run(duration_s=duration_s + 5.0, idle_timeout_s=5.0)
+    # Reap EVERY producer before judging any of them — raising on the
+    # first bad one would orphan the rest, which then keep emitting into
+    # the next sweep rung's measurement window.
+    failures = []
     for prod_log, proc in procs:
         try:
             proc.wait(timeout=30)
@@ -221,15 +225,18 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
             log(f"paced producer at {rate}/s overran its duration; killed")
         if proc.returncode not in (0, -9):  # -9 = our own overrun kill
             with open(prod_log, "r", errors="replace") as f:
-                tail = f.read()[-400:]
-            raise RuntimeError(
-                f"paced producer exited rc={proc.returncode}: {tail}")
+                failures.append(
+                    f"rc={proc.returncode}: {f.read()[-400:]}")
+            continue
         with open(prod_log, "r", errors="replace") as f:
             for line in f:
                 if line.startswith("emitted "):
                     sent["n"] = sent.get("n", 0) + int(line.split()[1])
                 elif line.startswith("Falling behind"):
                     behind["n"] += 1
+    if failures:
+        raise RuntimeError(
+            f"{len(failures)} paced producer(s) failed: {failures[0]}")
     engine.close()
     wall = time.monotonic() - t0
     log(engine.tracer.report())

@@ -135,6 +135,17 @@ class EventEncoder:
         self.page_index = {bytes(p): i for i, p in enumerate(pages)}
 
     # -- interning helpers --------------------------------------------
+    def user_key(self, idx: int) -> bytes:
+        """Reverse lookup: interned index -> user id.  Amortized O(1):
+        the index-order list is rebuilt only when the table grew since
+        the last call (insertion order IS index order; _intern only
+        appends), so k lookups at report time don't each pay an O(users)
+        scan."""
+        cache = getattr(self, "_user_key_cache", None)
+        if cache is None or len(cache) != len(self.user_index):
+            cache = self._user_key_cache = list(self.user_index)
+        return cache[idx]
+
     def _intern(self, table: dict[bytes, int], key: bytes) -> int:
         idx = table.get(key)
         if idx is None:

@@ -286,7 +286,7 @@ class SessionCMSEngine(_SketchEngineBase):
                  redis: RedisLike | None = None,
                  gap_ms: int = 30_000, user_capacity: int = 1 << 16,
                  cms_depth: int = 4, cms_width: int = 2048,
-                 top_k: int = 16,
+                 top_k: int = 16, candidate_capacity: int | None = None,
                  input_format: str = "json"):
         # The heavy-hitter report needs user-id NAMES; only the Python
         # encoder keeps the user intern table host-side (the native one
@@ -299,6 +299,11 @@ class SessionCMSEngine(_SketchEngineBase):
         self.top_k = top_k
         self.state = session.init_state(user_capacity)
         self.cms = cms.init_state(depth=cms_depth, width=cms_width)
+        # Device-side heavy-hitter candidate ring: report cost is O(ring),
+        # NOT O(interned users) — at config #4 scale (1e5+ users) a
+        # full-universe query per report defeats the sketch's
+        # sublinearity.
+        self.topk = cms.init_topk(candidate_capacity or max(8 * top_k, 128))
         self.sessions_closed = 0
         self.session_clicks = 0
 
@@ -325,6 +330,8 @@ class SessionCMSEngine(_SketchEngineBase):
                    "sess_start": np.asarray(self.state.sess_start),
                    "sess_clicks": np.asarray(self.state.clicks),
                    "cms_table": np.asarray(self.cms.table),
+                   "hh_keys": np.asarray(self.topk.keys),
+                   "hh_ests": np.asarray(self.topk.ests),
                    **self._intern_extra()},
         )
 
@@ -346,10 +353,34 @@ class SessionCMSEngine(_SketchEngineBase):
         self.session_clicks = int(snap.meta["session_clicks"])
         self._restore_interns(snap)
         self._restore_host(snap)
+        if "hh_keys" in snap.extra:
+            self.topk = cms.TopKState(
+                keys=jnp.asarray(snap.extra["hh_keys"]),
+                ests=jnp.asarray(snap.extra["hh_ests"]))
+        else:
+            # Legacy snapshot (pre-candidate-ring): seed the ring with a
+            # ONE-TIME scan of the restored intern universe, or every
+            # pre-crash heavy hitter would vanish from reports until it
+            # happened to reappear.  Interns must be restored first.
+            self._seed_topk_from_universe()
+
+    def _seed_topk_from_universe(self, chunk: int = 8192) -> None:
+        n = len(self.encoder.user_index)
+        for off in range(0, n, chunk):
+            keys = np.zeros(chunk, np.int32)
+            width = min(chunk, n - off)
+            keys[:width] = np.arange(off, off + width, dtype=np.int32)
+            mask = np.zeros(chunk, bool)
+            mask[:width] = True
+            self.topk = cms.update_topk(self.cms, self.topk,
+                                        jnp.asarray(keys),
+                                        jnp.asarray(mask))
 
     def _absorb(self, closed: session.ClosedSessions) -> None:
         self.cms = cms.update(self.cms, closed.user, closed.clicks,
                               closed.valid)
+        self.topk = cms.update_topk(self.cms, self.topk, closed.user,
+                                    closed.valid)
         v = np.asarray(closed.valid)
         self.sessions_closed += int(v.sum())
         self.session_clicks += int(np.asarray(closed.clicks)[v].sum())
@@ -374,18 +405,27 @@ class SessionCMSEngine(_SketchEngineBase):
         return 0  # sessions have no canonical window rows
 
     def heavy_hitters(self) -> list[tuple[str, int]]:
-        """Top-k (user, estimated clicks), est > 0 only."""
-        users = [u.decode() if isinstance(u, bytes) else u
-                 for u in self.encoder.user_index]
-        n = len(users)
-        if n == 0:
+        """Top-k (user, estimated clicks), est > 0 only.
+
+        Candidates come from the device-side ring (bounded), re-queried
+        against the final CMS so early entries report current counts;
+        only the winning <=k ids are reverse-looked-up to user names.
+        """
+        ring_keys = np.asarray(self.topk.keys)
+        cand = ring_keys[ring_keys >= 0]
+        if cand.size == 0:
             return []
-        cand = jnp.arange(n, dtype=jnp.int32)
-        vals, idx = cms.heavy_hitters(self.cms, cand,
-                                      k=min(self.top_k, n))
+        vals, idx = cms.heavy_hitters(self.cms, jnp.asarray(cand),
+                                      k=min(self.top_k, int(cand.size)))
         vals = np.asarray(vals)
         idx = np.asarray(idx)
-        return [(users[int(i)], int(v)) for v, i in zip(vals, idx) if v > 0]
+        out = []
+        for v, i in zip(vals, idx):
+            if v > 0:
+                u = self.encoder.user_key(int(cand[int(i)]))
+                out.append((u.decode() if isinstance(u, bytes) else u,
+                            int(v)))
+        return out
 
     def close(self) -> None:
         self.state, final = session.flush(

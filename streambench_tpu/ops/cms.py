@@ -85,8 +85,60 @@ def heavy_hitters(state: CMSState, candidate_keys: jax.Array, *,
                   k: int = 16):
     """Top-k candidates by CMS estimate: (values, indices into candidates).
 
-    The candidate set is the interned key universe (dense ids from the
-    encoder) — query them all, take top-k on device.
+    Query cost is linear in the CANDIDATE set — callers must keep that
+    bounded (see ``TopKState``); enumerating the whole interned key
+    universe here defeats the sketch's sublinearity.
     """
     est = query(state, candidate_keys)
     return jax.lax.top_k(est, k)
+
+
+class TopKState(NamedTuple):
+    """Fixed-size device-resident heavy-hitter candidate ring.
+
+    The classic CMS + candidate-set idiom with the candidate set ON
+    DEVICE and bounded: ``keys [M]`` (int32 interned ids, -1 empty) with
+    their last-queried estimates ``ests [M]``.  Every update batch's keys
+    compete against the ring by estimate; a true heavy hitter keeps
+    reappearing in the stream, so it re-enters with its ever-growing
+    estimate even if it was evicted while still small.  Report cost is
+    O(M), independent of the key universe.
+    """
+
+    keys: jax.Array   # [M] int32, -1 = empty slot
+    ests: jax.Array   # [M] int32, -1 for empty slots
+
+
+def init_topk(capacity: int = 128) -> TopKState:
+    return TopKState(keys=jnp.full((capacity,), -1, jnp.int32),
+                     ests=jnp.full((capacity,), -1, jnp.int32))
+
+
+@jax.jit
+def update_topk(state: CMSState, topk: TopKState, keys: jax.Array,
+                mask: jax.Array) -> TopKState:
+    """Fold one batch of (masked) keys into the candidate ring.
+
+    Concatenate ring + batch, dedupe by key keeping the max estimate
+    (sort by a combined (key, -est) int64 rank; duplicates collapse to
+    their first = largest entry), then keep the top-M by estimate.  All
+    shapes static; one sort + one top_k on device.
+    """
+    M = topk.keys.shape[0]
+    est = jnp.where(mask, query(state, keys), -1).astype(jnp.int32)
+    k_new = jnp.where(mask, keys.astype(jnp.int32), -1)
+    allk = jnp.concatenate([topk.keys, k_new])
+    alle = jnp.concatenate([topk.ests, est])
+    # rank: group by key ascending, largest estimate first within a key;
+    # empty slots (key -1) sort first and are masked below.
+    rank = (allk.astype(jnp.int64) << 32) - alle.astype(jnp.int64)
+    order = jnp.argsort(rank)
+    k_sorted = allk[order]
+    e_sorted = alle[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), k_sorted[1:] != k_sorted[:-1]])
+    keep = first & (k_sorted >= 0)
+    e_uniq = jnp.where(keep, e_sorted, -1)
+    vals, idx = jax.lax.top_k(e_uniq, M)
+    return TopKState(keys=jnp.where(vals >= 0, k_sorted[idx], -1),
+                     ests=vals)

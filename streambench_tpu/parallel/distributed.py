@@ -117,32 +117,49 @@ class DistributedWindowEngine(ShardedWindowEngine):
                          redis=redis, input_format=input_format)
         self.encoder.set_base_time(base_time_ms)
 
+    # -- the ONE copy of the lockstep ring-safety invariant ------------
+    # Every device-program call in this engine is an SPMD collective, so
+    # drain decisions must be byte-identical on every process: they are
+    # always computed from GLOBAL (voted/allgathered) spans through these
+    # two helpers — never from local batch times (the base class also
+    # halves over-wide batches, a shape change that would diverge).
+
+    def drain_due(self, lo: int, hi: int) -> bool:
+        """Deterministic drain decision for one lockstep step with
+        global event-time span [lo, hi].  Raises if a single step
+        outspans the ring (lockstep batches cannot halve)."""
+        if hi - lo > self._span_guard:
+            raise ValueError(
+                f"one lockstep batch spans {hi - lo} ms of event time; "
+                f"ring-safe span is {self._span_guard} ms — lower "
+                "jax_batch_size or raise jax_window_slots (lockstep "
+                "batches cannot halve: shapes must match across "
+                "processes)")
+        return (self._span_start is not None
+                and hi - self._span_start > self._span_guard)
+
+    def apply_drain(self, lo: int) -> None:
+        with self.tracer.span("drain"):
+            self._drain_device()
+        self._span_start = lo
+
+    def note_span(self, lo: int) -> None:
+        if self._span_start is None:
+            self._span_start = lo
+
     def _fold(self, batch) -> None:
-        """Lockstep fold: every device-program call below is an SPMD
-        collective, so the drain decision must be byte-identical on every
-        process.  The base class decides from LOCAL batch times and can
-        halve over-wide batches (shape changes) — both would diverge.
-        Here the span accounting runs on GLOBAL batch extrema, exchanged
-        with one tiny host allgather per step, and an over-wide global
-        batch is a hard error (sized by jax_batch_size x event spacing;
-        see class docstring)."""
+        """Lockstep fold of one batch: span accounting on GLOBAL batch
+        extrema, exchanged with one tiny host allgather per step (the
+        batched-vote catchup path in ``run_distributed_catchup`` amortizes
+        this to one exchange per round)."""
         from streambench_tpu.utils.ids import now_ms as _now_ms
 
         gmin, gmax = self._global_batch_span(batch)
         if gmax >= gmin:  # any process had data
-            if gmax - gmin > self._span_guard:
-                raise ValueError(
-                    f"global batch spans {gmax - gmin} ms of event time; "
-                    f"ring-safe span is {self._span_guard} ms — lower "
-                    "jax_batch_size or raise jax_window_slots (distributed "
-                    "mode cannot halve batches: shapes must match across "
-                    "processes)")
-            if self._span_start is None:
-                self._span_start = gmin
-            if gmax - self._span_start > self._span_guard:
-                with self.tracer.span("drain"):
-                    self._drain_device()
-                self._span_start = gmin
+            if self.drain_due(gmin, gmax):
+                self.apply_drain(gmin)
+            else:
+                self.note_span(gmin)
         self._device_step(batch)
         self.events_processed += batch.n
         self.last_event_ms = _now_ms()
@@ -188,6 +205,38 @@ class DistributedWindowEngine(ShardedWindowEngine):
             self.mesh, self.state, self.join_table,
             cols[0], cols[1], cols[2], cols[3],
             divisor_ms=self.divisor, lateness_ms=self.lateness)
+
+    def fold_round(self, batches: list, steps: int) -> None:
+        """Fold ``steps`` lockstep batches in ONE device dispatch (the
+        scanned sharded step, ``_device_scan``) with NO host exchanges.
+
+        The caller has already agreed the round globally — every process
+        calls with the same ``steps`` and drain decisions were taken from
+        voted global spans — so the only cross-host traffic here is the
+        device collectives inside the scan body.  Local batches short of
+        ``steps`` are padded with all-invalid batches (no-ops in the
+        kernel; peers still fold real data those iterations).
+        """
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from streambench_tpu.utils.ids import now_ms as _now_ms
+
+        if steps <= 0:
+            return
+        template = batches[0] if batches else self._encode([], self.batch_size)
+        sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        cols = []
+        for name in ("ad_idx", "event_type", "event_time", "valid"):
+            arrs = [getattr(b, name) for b in batches]
+            arrs += [np.zeros_like(getattr(template, name))
+                     ] * (steps - len(batches))
+            cols.append(jax.make_array_from_process_local_data(
+                sh, np.stack(arrs)))
+        self._device_scan(*cols)
+        self.events_processed += sum(b.n for b in batches)
+        self.last_event_ms = _now_ms()
 
     def process_lines(self, lines: list[bytes]) -> int:
         """One lockstep step per call: at most one batch of lines (the
@@ -243,35 +292,107 @@ class DistributedWindowEngine(ShardedWindowEngine):
 
 def run_distributed_catchup(engine: DistributedWindowEngine, reader,
                             flush_every: int = 64,
-                            max_steps: int | None = None) -> int:
-    """Lockstep catchup over every process's local reader.
+                            max_steps: int | None = None,
+                            vote_every: int = 8) -> dict:
+    """Lockstep catchup over every process's local reader, voting once
+    per ``vote_every``-step ROUND instead of once per step.
 
-    Each iteration: poll ONE local batch, vote (host allgather) on
-    whether any process still has data, fold — processes that ran dry
-    feed empty steps so collectives stay aligned — and flush to Redis on
-    a deterministic step cadence.  Returns local events processed.
+    Each round: poll + encode up to ``vote_every`` local batches, then
+    ONE host allgather exchanges ``[n_batches, span_lo, span_hi]`` per
+    process.  That single vote settles (a) the round length (max over
+    processes; short processes pad with no-op batches), (b) termination
+    (everyone at 0), and (c) the drain decision from the GLOBAL span —
+    after which the whole round folds in one scanned device dispatch
+    with no further host traffic (replaces the per-step flag vote + the
+    per-step span allgather, a 2/step -> 1/round reduction; the fork's
+    per-window Redis barrier analog, ``AdvertisingTopologyNative.java:
+    228-254``).  The vote cost is measured and returned:
+    ``{"events", "steps", "rounds", "votes", "vote_s"}``.
     """
+    import time
+
     from jax.experimental import multihost_utils
 
-    steps = 0
+    B = engine.batch_size
+    NONE_LO, NONE_HI = np.iinfo(np.int64).max, np.iinfo(np.int64).min
+    stats = {"events": 0, "steps": 0, "rounds": 0, "votes": 0,
+             "vote_s": 0.0}
     done_local = False
-    while max_steps is None or steps < max_steps:
-        lines = [] if done_local else reader.poll(
-            max_records=engine.batch_size)
+    while max_steps is None or stats["steps"] < max_steps:
+        k = vote_every
+        if max_steps is not None:
+            k = min(k, max_steps - stats["steps"])
+        lines = [] if done_local else reader.poll(max_records=B * k)
         if not lines:
             done_local = True
-        flags = multihost_utils.process_allgather(
-            np.array([0 if lines else 1], np.int32))
-        if int(flags.sum()) == flags.shape[0]:
+        batches = []
+        for off in range(0, len(lines), B):
+            b = engine._encode(lines[off:off + B], B)
+            if b.n:
+                batches.append(b)
+        # Vote payload: [has_more, n_batches, lo_0, hi_0, ...] — PER-
+        # BATCH spans, so the round driver can reconstruct global
+        # per-step spans and place drains mid-round deterministically
+        # (a round-level min/max alone would force a hard error whenever
+        # a whole round outspans the ring, which sparse journals do).
+        # ``has_more`` is separate from the batch count: a poll that
+        # returned only unparseable lines yields ZERO batches while the
+        # journal still has data behind them — termination must wait for
+        # every process to actually run dry, not merely encode nothing
+        # this round.
+        base = engine.encoder.base_time_ms or 0
+        payload = np.empty(2 + 2 * k, np.int64)
+        payload[0] = 0 if (done_local and not batches) else 1
+        payload[1] = len(batches)
+        payload[2::2], payload[3::2] = NONE_LO, NONE_HI
+        for i, b in enumerate(batches):
+            vt = b.event_time[:b.n]
+            payload[2 + 2 * i] = int(vt.min()) + base
+            payload[3 + 2 * i] = int(vt.max()) + base
+
+        t0 = time.perf_counter()
+        summary = multihost_utils.process_allgather(payload)
+        stats["votes"] += 1
+        stats["vote_s"] += time.perf_counter() - t0
+
+        if int(summary[:, 0].max()) == 0:
             break  # every process is dry
-        if lines:
-            engine.process_lines(lines)
-        else:
-            engine.step_empty()
-        steps += 1
-        if steps % flush_every == 0:
+        round_steps = int(summary[:, 1].max())
+        if round_steps == 0:
+            continue  # someone is mid-journal but encoded nothing yet
+        step_lo = summary[:, 2::2].min(axis=0)   # [k] global per-step
+        step_hi = summary[:, 3::2].max(axis=0)
+
+        # Walk the round's steps, grouping them into drain-separated
+        # segments — identical arithmetic on identical voted data, so
+        # every process folds the same segments and drains at the same
+        # points (engine.drain_due holds the one copy of the invariant).
+        seg_start = 0
+
+        def fold_segment(end: int) -> None:
+            engine.fold_round(batches[seg_start:end],
+                              end - seg_start)
+
+        for i in range(round_steps):
+            lo_i, hi_i = int(step_lo[i]), int(step_hi[i])
+            if lo_i > hi_i:
+                continue  # no process had data at step i
+            if engine.drain_due(lo_i, hi_i):
+                fold_segment(i)
+                seg_start = i
+                engine.apply_drain(lo_i)
+            else:
+                engine.note_span(lo_i)
+        fold_segment(round_steps)
+
+        prev = stats["steps"]
+        stats["steps"] += round_steps
+        stats["rounds"] += 1
+        # deterministic flush cadence: same step counts -> same flushes
+        if stats["steps"] // flush_every != prev // flush_every:
             engine.flush()
     engine.flush()
     engine.drain_writes()  # flush() queues on the writer thread; the
     # function's contract is "flushed to Redis", so block until it landed
-    return engine.events_processed
+    stats["events"] = engine.events_processed
+    return stats

@@ -1,0 +1,127 @@
+"""Config #4 at scale: heavy-hitter reporting must stay sublinear.
+
+The r02 verdict flagged that ``heavy_hitters`` enumerated every interned
+user per report (1e5+ queries at scale).  Now candidates live in a
+fixed-size device ring (``ops.cms.TopKState``): these tests pin (a) the
+ring finds the true heavy hitters in a skewed 1e5-user stream, (b) the
+report queries O(ring) not O(users), and (c) ``user_capacity`` overflow
+is counted, not silently wrong.
+"""
+
+import json
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from streambench_tpu.config import default_config
+from streambench_tpu.engine.sketches import SessionCMSEngine
+from streambench_tpu.ops import cms
+
+MAPPING = {f"ad{i}": f"c{i % 5}" for i in range(20)}
+
+
+def click_line(user: str, t: int) -> bytes:
+    return json.dumps({
+        "user_id": user, "page_id": "p0",
+        "ad_id": f"ad{t % 20}", "ad_type": "banner",
+        "event_type": "click", "event_time": str(t),
+    }).encode()
+
+
+def test_topk_ring_finds_true_heavy_hitters_among_1e5_users():
+    rng = random.Random(3)
+    hot = [f"hot{i}" for i in range(8)]
+    t = 1_700_000_000_000
+    lines = []
+    # 30k events: 60% from 8 hot users, rest from a 1e5-user cold pool;
+    # sessions close via the 30s gap as event time advances.
+    for i in range(30_000):
+        if rng.random() < 0.6:
+            u = hot[rng.randrange(8)]
+        else:
+            u = f"cold{rng.randrange(100_000)}"
+        lines.append(click_line(u, t))
+        t += 40  # 40 ms stride -> old sessions expire as time passes
+    cfg = default_config(jax_batch_size=1024)
+    eng = SessionCMSEngine(cfg, MAPPING, user_capacity=1 << 17, top_k=8)
+    for off in range(0, len(lines), 1024):
+        eng.process_lines(lines[off:off + 1024])
+    eng.close()
+
+    # report cost: candidates bounded by the ring, not the user universe
+    ring = np.asarray(eng.topk.keys)
+    assert ring.shape[0] == 128
+    assert len(eng.encoder.user_index) > 10_000  # ring 128 << universe
+
+    hh = dict(eng.heavy_hitters())
+    assert len(hh) <= 8
+    # every reported heavy hitter is a hot user (cold users have ~1-2
+    # clicks; CMS overestimation is bounded by width 2048 at this load)
+    assert set(hh) <= set(hot), hh
+    assert len(set(hh) & set(hot)) >= 6, hh
+
+
+def test_update_topk_dedupes_and_keeps_max_estimate():
+    state = cms.init_state(depth=4, width=256)
+    topk = cms.init_topk(8)
+    keys = jnp.asarray(np.array([5, 5, 9, 3], np.int32))
+    w = jnp.asarray(np.array([10, 7, 2, 1], np.int32))
+    mask = jnp.asarray(np.array([True, True, True, False]))
+    state = cms.update(state, keys, w, mask)
+    topk = cms.update_topk(state, topk, keys, mask)
+    ks = np.asarray(topk.keys)
+    # key 5 appears once despite two batch occurrences; masked key 3 absent
+    assert list(ks[ks >= 0]) in ([5, 9], [9, 5])
+    assert sorted(ks[ks >= 0].tolist()) == [5, 9]
+    es = dict(zip(ks.tolist(), np.asarray(topk.ests).tolist()))
+    assert es[5] == 17 and es[9] == 2
+
+
+def test_user_capacity_overflow_is_counted_not_silent():
+    cfg = default_config(jax_batch_size=256)
+    eng = SessionCMSEngine(cfg, MAPPING, user_capacity=64, top_k=4)
+    t = 1_700_000_000_000
+    lines = [click_line(f"u{i}", t + i) for i in range(300)]
+    eng.process_lines(lines)
+    eng.close()
+    # 300 distinct users against capacity 64: the overflow is visible
+    assert eng.dropped > 0
+    assert eng.dropped >= 300 - 64
+    # the engine still reports a bounded, well-formed top-k
+    hh = eng.heavy_hitters()
+    assert len(hh) <= 4
+    for user, est in hh:
+        assert est >= 1 and user.startswith("u")
+
+
+def test_legacy_snapshot_without_ring_reseeds_candidates():
+    """Restoring a pre-ring snapshot (no hh_keys) must not silently lose
+    pre-crash heavy hitters: the ring reseeds from the restored intern
+    universe once at restore time."""
+    cfg = default_config(jax_batch_size=512)
+    eng = SessionCMSEngine(cfg, MAPPING, user_capacity=1 << 12, top_k=4)
+    t = 1_700_000_000_000
+    lines = []
+    rng = random.Random(5)
+    # "star" is hot early then goes silent: its session CLOSES via the
+    # 30 s gap as event time advances and feeds the CMS with a big count
+    # (a continuously-active user's session never closes pre-snapshot).
+    for i in range(4000):
+        if i < 1500 and rng.random() < 0.4:
+            u = "star"
+        else:
+            u = f"u{rng.randrange(2000)}"
+        lines.append(click_line(u, t))
+        t += 50
+    for off in range(0, len(lines), 512):
+        eng.process_lines(lines[off:off + 512])
+    eng.flush()
+    snap = eng.snapshot(offset=0)
+    del snap.extra["hh_keys"]
+    del snap.extra["hh_ests"]
+
+    eng2 = SessionCMSEngine(cfg, MAPPING, user_capacity=1 << 12, top_k=4)
+    eng2.restore(snap)
+    hh = dict(eng2.heavy_hitters())
+    assert "star" in hh, hh
