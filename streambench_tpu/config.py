@@ -74,6 +74,11 @@ class BenchmarkConfig:
 
     # --- TPU-engine knobs (new; same namespacing style as storm.*/spark.*) ---
     jax_batch_size: int = 8192             # events per device micro-batch
+    jax_encode_workers: int = 1            # parallel encode threads (>1 =
+    #   per-thread native encoders; ctypes releases the GIL, so this
+    #   scales on multi-core hosts.  Exact-count engines only — sketch
+    #   engines need one consistent intern table.  Default off: the CI
+    #   host is single-core)
     jax_scan_batches: int = 8              # batches folded per device dispatch
     #   (catchup mode stacks this many micro-batches and folds them in one
     #   lax.scan call, amortizing per-dispatch latency; streaming mode and
@@ -177,6 +182,7 @@ class BenchmarkConfig:
             storm_ackers=geti("storm.ackers", 2),
             spark_batchtime=geti("spark.batchtime", 2000),
             jax_batch_size=geti("jax.batch.size", 8192),
+            jax_encode_workers=geti("jax.encode.workers", 1),
             jax_scan_batches=geti("jax.scan.batches", 8),
             jax_buffer_timeout_ms=geti("jax.buffer.timeout.ms", 100),
             jax_num_campaigns=geti("jax.num.campaigns", 100),

@@ -74,7 +74,14 @@ class EventEncoder:
     and page ids are interned on first sight, unbounded, like the reference's
     in-process LRU caches but without eviction (a uuid string + int is ~100
     bytes; 10^6 users ~= 100 MB, acceptable for benchmark runs).
+
+    ``RELEASES_GIL`` marks whether ``encode`` spends its time outside the
+    GIL (the native subclass's ctypes call does) — the signal the
+    parallel encode pool uses; threading a GIL-bound encoder is pure
+    overhead.
     """
+
+    RELEASES_GIL = False
 
     def __init__(self, ad_to_campaign: dict[str, str],
                  campaigns: list[str] | None = None,

@@ -1,0 +1,70 @@
+"""Parallel micro-batch encoding: per-thread encoders behind one pool.
+
+The encode stage is the TPU engine's deserialize bottleneck (SURVEY.md §7
+"hard parts": string->index encoding at line rate).  The native scanner
+is one ctypes call per batch — ctypes releases the GIL for the call's
+duration — so N worker threads with N independent encoder instances
+parallelize it near-linearly.
+
+Soundness: worker encoders intern user/page ids INDEPENDENTLY, so their
+``user_idx``/``page_idx`` columns are not comparable across batches.
+That is fine for the exact-count engine family, whose kernel reads only
+``ad_idx``/``event_type``/``event_time``/``valid`` (the ad table is
+fixed up front and shared read-only).  Sketch engines key device state
+by interned indices and MUST NOT use this pool
+(``_SketchEngineBase.PARALLEL_ENCODE_OK = False``).
+
+Time rebasing: all encoders must share one ``base_time_ms`` or window
+ids would shift between batches.  The pool pins the primary encoder's
+base (encoding the first-ever batch sequentially to establish it) and
+syncs every worker before its job runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
+
+
+class ParallelEncodePool:
+    def __init__(self, primary, factory: Callable[[], object],
+                 workers: int = 4):
+        self.primary = primary
+        self._factory = factory
+        self._tls = threading.local()
+        self._pool = ThreadPoolExecutor(max_workers=max(workers, 1),
+                                        thread_name_prefix="encode")
+
+    def _job(self, lines: list[bytes], batch_size: int, base: int):
+        enc = getattr(self._tls, "enc", None)
+        if enc is None:
+            enc = self._tls.enc = self._factory()
+        if enc.base_time_ms != base:
+            enc.set_base_time(base)
+        return enc.encode(lines, batch_size)
+
+    def encode_chunks(self, chunks: list[list[bytes]], batch_size: int):
+        """Encode each chunk into an ``EncodedBatch``, order-preserving."""
+        out = [None] * len(chunks)
+        start = 0
+        if self.primary.base_time_ms is None and chunks:
+            # First data ever: establish the shared rebase origin on the
+            # primary before any worker encodes against it.
+            out[0] = self.primary.encode(chunks[0], batch_size)
+            start = 1
+            if self.primary.base_time_ms is None:
+                # all-bad first chunk: no base yet; stay sequential
+                for i in range(start, len(chunks)):
+                    out[i] = self.primary.encode(chunks[i], batch_size)
+                return out
+        base = self.primary.base_time_ms
+        futures = [(i, self._pool.submit(self._job, chunks[i],
+                                         batch_size, base))
+                   for i in range(start, len(chunks))]
+        for i, fut in futures:
+            out[i] = fut.result()
+        return out
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

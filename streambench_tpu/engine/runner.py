@@ -203,13 +203,26 @@ class StreamRunner:
         st.started_ms = now_ms()
         last_flush = time.monotonic()
         chunk = self.batch_size * getattr(self.engine, "scan_batches", 1)
+        # Block-mode ingest (native encoder scans raw bytes; no per-line
+        # Python objects) when both ends support it; MultiReader and the
+        # Kafka adapter stay on the line path.
+        block_mode = (getattr(self.engine, "supports_block_ingest", False)
+                      and hasattr(self.reader, "poll_block"))
+        block_bytes = chunk * 256   # ~wire bytes per event, rounded up
         while not self._stop:
-            lines = self.reader.poll(max_records=chunk)
-            if not lines:
-                break
-            self.engine.process_chunk(lines)
-            st.events += len(lines)
-            st.batches += 1
+            if block_mode:
+                data = self.reader.poll_block(block_bytes)
+                if not data:
+                    break
+                st.events += self.engine.process_block(data)
+                st.batches += 1
+            else:
+                lines = self.reader.poll(max_records=chunk)
+                if not lines:
+                    break
+                self.engine.process_chunk(lines)
+                st.events += len(lines)
+                st.batches += 1
             if max_events and st.events >= max_events:
                 break
             now = time.monotonic()

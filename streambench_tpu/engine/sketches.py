@@ -48,6 +48,9 @@ class _SketchEngineBase(AdAnalyticsEngine):
     # Sketch kernels have no scanned form yet; process_chunk folds
     # per-batch (deferred drains still apply).
     SCAN_SUPPORTED = False
+    # Sketch device state is keyed by interned indices: one consistent
+    # intern table is mandatory, so no per-thread parallel encoders.
+    PARALLEL_ENCODE_OK = False
 
     @staticmethod
     def _pack_keys(keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:

@@ -174,6 +174,42 @@ class JournalReader:
                 return lines
             time.sleep(poll_interval_s)
 
+    def poll_block(self, max_bytes: int | None = None) -> bytes:
+        """Raw complete-line bytes for block-mode ingest (the native
+        encoder scans record boundaries itself; no per-line objects).
+
+        Returns up to ``max_bytes`` ending on a line boundary; ``offset``
+        advances over exactly the returned bytes, so checkpoints stay
+        record-exact.  Cannot be mixed with line-mode ``poll`` while its
+        read-ahead holds parsed-but-undelivered lines.
+        """
+        if self._readahead:
+            raise RuntimeError(
+                "poll_block after line-mode poll left read-ahead lines; "
+                "one reader must stick to one ingest mode")
+        if not self._ensure_open():
+            return b""
+        budget = max_bytes or self._byte_budget
+        while True:
+            data = self._fh.read(budget)
+            if not data:
+                return b""
+            end = data.rfind(b"\n")
+            if end >= 0:
+                break
+            if len(data) < budget:
+                # partial trailing line, writer not done yet; rewind
+                self._fh.seek(self._fh.tell() - len(data))
+                return b""
+            budget *= 2  # one line longer than the budget: retry bigger
+            self._fh.seek(self._fh.tell() - len(data))
+        tail = len(data) - (end + 1)
+        if tail:
+            self._fh.seek(self._fh.tell() - tail)
+            data = data[:end + 1]
+        self.offset += len(data)
+        return data
+
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()

@@ -52,6 +52,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c_p, ctypes.c_char_p, ctypes.POINTER(c_i64), ctypes.c_int32,
         i32p, i32p, i32p, i32p, i32p, i32p,
         ctypes.POINTER(ctypes.c_uint8)]
+    lib.sb_encode_block.restype = c_i64
+    lib.sb_encode_block.argtypes = [
+        c_p, ctypes.c_char_p, c_i64, c_i64, c_i64,
+        i32p, i32p, i32p, i32p, i32p, i32p,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(c_i64)]
     return lib
 
 

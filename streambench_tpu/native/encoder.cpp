@@ -108,6 +108,67 @@ inline int32_t event_type_code(const Tok& t) {
 
 }  // namespace
 
+namespace {
+
+// Parse one wire-format line [p, end) into row i of the column buffers.
+// status[i]: 1 = parsed, 2 = layout mismatch (python fallback), 0 = bad.
+// Returns 1 on success, 0 otherwise.
+inline int parse_one(Encoder* enc, const char* p, const char* end,
+                     int64_t i, int32_t* ad_idx, int32_t* etype,
+                     int32_t* etime, int32_t* user_idx, int32_t* page_idx,
+                     int32_t* ad_type, uint8_t* status) {
+  // split on '"' into the first 24 tokens (memchr: SIMD-accelerated)
+  Tok toks[24];
+  int nt = 0;
+  const char* start = p;
+  while (nt < 24) {
+    const char* q = static_cast<const char*>(
+        std::memchr(start, '"', static_cast<size_t>(end - start)));
+    if (q == nullptr) break;
+    toks[nt].p = start;
+    toks[nt].len = static_cast<size_t>(q - start);
+    ++nt;
+    start = q + 1;
+  }
+  if (nt < 24 || !tok_eq(toks[1], "user_id", 7) ||
+      !tok_eq(toks[5], "page_id", 7) || !tok_eq(toks[9], "ad_id", 5) ||
+      !tok_eq(toks[13], "ad_type", 7) ||
+      !tok_eq(toks[17], "event_type", 10) ||
+      !tok_eq(toks[21], "event_time", 10)) {
+    status[i] = 2;
+    return 0;
+  }
+  // event_time digits
+  int64_t t = 0;
+  bool tok_ok = toks[23].len > 0 && toks[23].len <= 15;
+  if (tok_ok) {
+    for (size_t k = 0; k < toks[23].len; ++k) {
+      char c = toks[23].p[k];
+      if (c < '0' || c > '9') { tok_ok = false; break; }
+      t = t * 10 + (c - '0');
+    }
+  }
+  if (!tok_ok) {
+    status[i] = 2;
+    return 0;
+  }
+  if (enc->base_time_ms == kBaseUnset) {
+    enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
+  }
+  auto ad_it = enc->ad_index.find(std::string(toks[11].p, toks[11].len));
+  ad_idx[i] = ad_it == enc->ad_index.end() ? enc->unknown_ad
+                                           : ad_it->second;
+  etype[i] = event_type_code(toks[19]);
+  etime[i] = static_cast<int32_t>(t - enc->base_time_ms);
+  user_idx[i] = enc->users.intern(toks[3].p, toks[3].len);
+  page_idx[i] = enc->pages.intern(toks[7].p, toks[7].len);
+  ad_type[i] = ad_type_code(toks[15]);
+  status[i] = 1;
+  return 1;
+}
+
+}  // namespace
+
 extern "C" {
 
 void* sb_encoder_new(const char* ads_buf, const int64_t* ad_offsets,
@@ -183,61 +244,42 @@ int64_t sb_encode_json(void* enc_, const char* buf,
                        int32_t* ad_type, uint8_t* status) {
   auto* enc = static_cast<Encoder*>(enc_);
   int64_t ok = 0;
-  Tok toks[24];
   for (int32_t i = 0; i < n_lines; ++i) {
-    const char* p = buf + line_offsets[i];
-    const char* end = buf + line_offsets[i + 1];
-    // split on '"' into the first 24 tokens
-    int nt = 0;
-    const char* start = p;
-    const char* q = p;
-    while (q < end && nt < 24) {
-      if (*q == '"') {
-        toks[nt].p = start;
-        toks[nt].len = static_cast<size_t>(q - start);
-        ++nt;
-        start = q + 1;
-      }
-      ++q;
-    }
-    if (nt < 24 || !tok_eq(toks[1], "user_id", 7) ||
-        !tok_eq(toks[5], "page_id", 7) || !tok_eq(toks[9], "ad_id", 5) ||
-        !tok_eq(toks[13], "ad_type", 7) ||
-        !tok_eq(toks[17], "event_type", 10) ||
-        !tok_eq(toks[21], "event_time", 10)) {
-      status[i] = 2;
-      continue;
-    }
-    // event_time digits
-    int64_t t = 0;
-    bool tok_ok = toks[23].len > 0 && toks[23].len <= 15;
-    if (tok_ok) {
-      for (size_t k = 0; k < toks[23].len; ++k) {
-        char c = toks[23].p[k];
-        if (c < '0' || c > '9') { tok_ok = false; break; }
-        t = t * 10 + (c - '0');
-      }
-    }
-    if (!tok_ok) {
-      status[i] = 2;
-      continue;
-    }
-    if (enc->base_time_ms == kBaseUnset) {
-      enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
-    }
-    auto ad_it = enc->ad_index.find(
-        std::string(toks[11].p, toks[11].len));
-    ad_idx[i] = ad_it == enc->ad_index.end() ? enc->unknown_ad
-                                             : ad_it->second;
-    etype[i] = event_type_code(toks[19]);
-    etime[i] = static_cast<int32_t>(t - enc->base_time_ms);
-    user_idx[i] = enc->users.intern(toks[3].p, toks[3].len);
-    page_idx[i] = enc->pages.intern(toks[7].p, toks[7].len);
-    ad_type[i] = ad_type_code(toks[15]);
-    status[i] = 1;
-    ++ok;
+    ok += parse_one(enc, buf + line_offsets[i], buf + line_offsets[i + 1],
+                    i, ad_idx, etype, etime, user_idx, page_idx, ad_type,
+                    status);
   }
   return ok;
+}
+
+// Scan up to max_records NEWLINE-DELIMITED records straight out of a raw
+// journal block and parse them in the same pass — no per-line buffers or
+// offset arrays cross the FFI (the fork's mmap'd columnar handoff taken
+// to its conclusion: bytes in, columns out).  Scanning starts at
+// buf[start]; rec_offsets[i] records each record's start (for the rare
+// Python fallback on layout-mismatch rows) and rec_offsets[n] the total
+// consumed length, excluding any incomplete trailing record.
+int64_t sb_encode_block(void* enc_, const char* buf, int64_t len,
+                        int64_t start, int64_t max_records,
+                        int32_t* ad_idx, int32_t* etype, int32_t* etime,
+                        int32_t* user_idx, int32_t* page_idx,
+                        int32_t* ad_type, uint8_t* status,
+                        int64_t* rec_offsets) {
+  auto* enc = static_cast<Encoder*>(enc_);
+  int64_t n = 0;
+  int64_t pos = start;
+  while (n < max_records && pos < len) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(buf + pos, '\n', static_cast<size_t>(len - pos)));
+    if (nl == nullptr) break;  // incomplete trailing record: not consumed
+    rec_offsets[n] = pos;
+    parse_one(enc, buf + pos, nl, n, ad_idx, etype, etime, user_idx,
+              page_idx, ad_type, status);
+    pos = (nl - buf) + 1;
+    ++n;
+  }
+  rec_offsets[n] = pos;
+  return n;
 }
 
 }  // extern "C"

@@ -177,3 +177,70 @@ def test_failed_redis_write_is_reclaimed_and_retried():
                 for n in per.values())
     views = sum(1 for ln in lines if b'"view"' in ln)
     assert total == views
+
+
+def test_block_ingest_equals_line_ingest():
+    """process_block (native zero-copy scan) must produce byte-identical
+    window deltas to the line path, including bad lines and a ragged
+    tail."""
+    import pytest
+
+    from streambench_tpu import native
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    lines, mapping, campaigns = make_lines(4000, seed=12)
+    lines.insert(100, b"not json at all")
+    lines.insert(2000, b'{"weird": 1}')
+
+    a = run_engine(lines, mapping, campaigns, chunked=True)
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=4)
+    b_eng = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
+    assert b_eng.supports_block_ingest
+    data = b"\n".join(lines) + b"\n"
+    # feed in uneven block slices ending on line boundaries
+    cut = data.find(b"\n", len(data) // 3) + 1
+    events = b_eng.process_block(data[:cut])
+    events += b_eng.process_block(data[cut:])
+    assert events == 4000  # the 2 bad lines are not events
+
+    assert drained_pending(a) == drained_pending(b_eng)
+
+
+def test_poll_block_roundtrip_and_offset(tmp_path):
+    from streambench_tpu.io.journal import FileBroker
+
+    broker = FileBroker(str(tmp_path))
+    w = broker.writer("t")
+    w.append(b"aaa")
+    w.append(b"bb")
+    w.flush()
+    r = broker.reader("t")
+    data = r.poll_block()
+    assert data == b"aaa\nbb\n"
+    assert r.offset == 7
+    # mixing modes with pending read-ahead is refused
+    w.append(b"c")
+    w.append(b"d")
+    w.flush()
+    got = r.poll(max_records=1)
+    assert got == [b"c"] and r._readahead
+    import pytest
+    with pytest.raises(RuntimeError):
+        r.poll_block()
+
+
+def test_parallel_encode_pool_matches_sequential():
+    lines, mapping, campaigns = make_lines(3000, seed=13)
+    cfg1 = default_config(jax_batch_size=256, jax_scan_batches=4)
+    a = AdAnalyticsEngine(cfg1, mapping, campaigns=campaigns)
+    a.process_chunk(lines)
+
+    cfg2 = default_config(jax_batch_size=256, jax_scan_batches=4,
+                          jax_encode_workers=3)
+    b = AdAnalyticsEngine(cfg2, mapping, campaigns=campaigns)
+    assert b._encode_pool is not None
+    b.process_chunk(lines)
+
+    assert a.events_processed == b.events_processed == 3000
+    assert drained_pending(a) == drained_pending(b)
