@@ -230,6 +230,9 @@ class AdAnalyticsEngine:
     # this; process_chunk then folds per-batch (still with deferred
     # drains) instead of through the scanned exact kernel.
     SCAN_SUPPORTED = True
+    # EncodedBatch columns the scanned kernel consumes, in _device_scan
+    # argument order (sketch engines need e.g. user_idx).
+    SCAN_COLUMNS = ("ad_idx", "event_type", "event_time", "valid")
     # Engines whose kernel reads interned user/page columns must keep a
     # single consistent intern table and clear this (encode.parallel).
     PARALLEL_ENCODE_OK = True
@@ -301,7 +304,14 @@ class AdAnalyticsEngine:
         if hi - self._span_start > self._span_guard:
             with self.tracer.span("drain"):
                 self._drain_device()
-            self._span_start = lo
+            # _drain_device may pin _span_start to an OLDER still-open
+            # window (HLL keeps open-window registers on device); only
+            # move it forward to the group minimum if that is older —
+            # clobbering it would under-measure the unflushed span and
+            # let a new window claim a still-open slot (same rule as
+            # _fold).
+            if self._span_start is None or lo < self._span_start:
+                self._span_start = lo
 
         # Pad the stack to the next power-of-two group size so the scan
         # compiles once per bucket (log2(K)+1 shapes, not one per group
@@ -312,15 +322,14 @@ class AdAnalyticsEngine:
         while k < len(batches):
             k *= 2
         pad = min(k, self.scan_batches) - len(batches)
-        cols = {}
-        for name in ("ad_idx", "event_type", "event_time", "valid"):
+        cols = []
+        for name in self.SCAN_COLUMNS:
             arrs = [getattr(b, name) for b in batches]
             if pad:
                 arrs += [np.zeros_like(arrs[0])] * pad
-            cols[name] = jnp.asarray(np.stack(arrs))
+            cols.append(jnp.asarray(np.stack(arrs)))
         with self.tracer.span("device_scan"):
-            self._device_scan(cols["ad_idx"], cols["event_type"],
-                              cols["event_time"], cols["valid"])
+            self._device_scan(*cols)
         self.events_processed += sum(b.n for b in batches)
         self.last_event_ms = now_ms()
 

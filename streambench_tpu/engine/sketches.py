@@ -20,7 +20,9 @@ variants come from the same mesh treatment as the exact engine.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -105,6 +107,12 @@ class HLLDistinctEngine(_SketchEngineBase):
         self.state = hll.init_state(self.encoder.num_campaigns, self.W,
                                     num_registers=registers)
 
+    # HLL has a scanned kernel; the intern consistency the sketch base
+    # guards against lives in the SHARED encoder (pool stays off).
+    SCAN_SUPPORTED = True
+    SCAN_COLUMNS = ("ad_idx", "user_idx", "event_type", "event_time",
+                    "valid")
+
     def _device_step(self, batch) -> None:
         self.state = hll.step(
             self.state, self.join_table,
@@ -112,6 +120,13 @@ class HLLDistinctEngine(_SketchEngineBase):
             jnp.asarray(batch.event_type), jnp.asarray(batch.event_time),
             jnp.asarray(batch.valid),
             divisor_ms=self.divisor, lateness_ms=self.lateness)
+
+    def _device_scan(self, ad_idx, user_idx, event_type, event_time,
+                     valid) -> None:
+        self.state = hll.scan_steps(
+            self.state, self.join_table, ad_idx, user_idx, event_type,
+            event_time, valid, divisor_ms=self.divisor,
+            lateness_ms=self.lateness)
 
     ENGINE_FAMILY = "hll"
 
@@ -248,7 +263,15 @@ class SlidingTDigestEngine(_SketchEngineBase):
             self.state, self.join_table, ad, et, tm, valid,
             size_ms=self.size_ms, slide_ms=self.slide_ms,
             lateness_ms=self.base_lateness)
-        # latency sample per view event, bucketed per campaign
+        # Latency sample per view event, bucketed per campaign.
+        # TWO-CLOCK CAVEAT (SURVEY.md §7 "faithful latency semantics"):
+        # now_ms() is THIS host's clock, event_time the generator's; the
+        # difference is only meaningful when both run on one node or are
+        # NTP-disciplined — exactly the reference's assumption
+        # (core.clj:149 subtracts generator stamps from engine-side
+        # update times the same way).  Cross-host skew shifts the whole
+        # digest by the offset; the clamp below only stops negative skew
+        # from corrupting the digest with negative "latencies".
         base = self.encoder.base_time_ms or 0
         now_rel = np.clip(np.int64(now_ms()) - base, 0, 2**31 - 2)
         lat = jnp.maximum(jnp.int32(now_rel) - tm, 0)
@@ -272,6 +295,40 @@ class SlidingTDigestEngine(_SketchEngineBase):
                     cmds.append(("HSET", table, f"{name}:p{int(qq * 100)}",
                                  f"{q[c, j]:.1f}"))
             self.redis.pipeline_execute(cmds)
+
+
+@functools.partial(jax.jit, static_argnames=("gap_ms", "lateness_ms"))
+def _session_cms_scan(sess_state, cms_state, topk_state, closed_n,
+                      clicks_n, user_idx, event_type, event_time, valid,
+                      *, gap_ms: int, lateness_ms: int):
+    """Fused session + CMS + heavy-hitter scan over ``[N, B]`` batches.
+
+    The whole config-#4 pipeline — session windowing, CMS fold of closed
+    sessions, candidate-ring update, counters — stays device-resident for
+    a chunk: one dispatch, zero host syncs (the per-batch path used to
+    pull closed-session masks to the host every step).
+    """
+
+    def absorb(cm, tk, cn, ck, closed):
+        cm = cms.update(cm, closed.user, closed.clicks, closed.valid)
+        tk = cms.update_topk(cm, tk, closed.user, closed.valid)
+        cn = cn + jnp.sum(closed.valid.astype(jnp.int32))
+        ck = ck + jnp.sum(jnp.where(closed.valid, closed.clicks, 0))
+        return cm, tk, cn, ck
+
+    def body(carry, xs):
+        st, cm, tk, cn, ck = carry
+        u, et, t, v = xs
+        st, in_batch, carried = session.step(
+            st, u, et, t, v, gap_ms=gap_ms, lateness_ms=lateness_ms)
+        cm, tk, cn, ck = absorb(cm, tk, cn, ck, in_batch)
+        cm, tk, cn, ck = absorb(cm, tk, cn, ck, carried)
+        return (st, cm, tk, cn, ck), None
+
+    carry, _ = jax.lax.scan(
+        body, (sess_state, cms_state, topk_state, closed_n, clicks_n),
+        (user_idx, event_type, event_time, valid))
+    return carry
 
 
 class SessionCMSEngine(_SketchEngineBase):
@@ -311,6 +368,35 @@ class SessionCMSEngine(_SketchEngineBase):
         self.session_clicks = 0
 
     ENGINE_FAMILY = "session_cms"
+    # The fused scan keeps session windowing + CMS + ring + counters on
+    # device for a whole chunk (no per-batch host syncs).
+    SCAN_SUPPORTED = True
+    SCAN_COLUMNS = ("user_idx", "event_type", "event_time", "valid")
+
+    # Counters live as device scalars so absorbing a batch never blocks;
+    # reading them (snapshot/close/stats) materializes.
+    @property
+    def sessions_closed(self) -> int:
+        return int(self._closed_dev)
+
+    @sessions_closed.setter
+    def sessions_closed(self, v: int) -> None:
+        self._closed_dev = jnp.int32(v)
+
+    @property
+    def session_clicks(self) -> int:
+        return int(self._clicks_dev)
+
+    @session_clicks.setter
+    def session_clicks(self, v: int) -> None:
+        self._clicks_dev = jnp.int32(v)
+
+    def _device_scan(self, user_idx, event_type, event_time, valid) -> None:
+        (self.state, self.cms, self.topk, self._closed_dev,
+         self._clicks_dev) = _session_cms_scan(
+            self.state, self.cms, self.topk, self._closed_dev,
+            self._clicks_dev, user_idx, event_type, event_time, valid,
+            gap_ms=self.gap_ms, lateness_ms=self.lateness)
 
     def snapshot(self, offset: int):
         from streambench_tpu.checkpoint import Snapshot
@@ -384,9 +470,11 @@ class SessionCMSEngine(_SketchEngineBase):
                               closed.valid)
         self.topk = cms.update_topk(self.cms, self.topk, closed.user,
                                     closed.valid)
-        v = np.asarray(closed.valid)
-        self.sessions_closed += int(v.sum())
-        self.session_clicks += int(np.asarray(closed.clicks)[v].sum())
+        # device-scalar counters: no host sync on the hot path
+        self._closed_dev = self._closed_dev + jnp.sum(
+            closed.valid.astype(jnp.int32))
+        self._clicks_dev = self._clicks_dev + jnp.sum(
+            jnp.where(closed.valid, closed.clicks, 0))
 
     def _device_step(self, batch) -> None:
         self.state, in_batch, carried = session.step(

@@ -129,10 +129,11 @@ def update_topk(state: CMSState, topk: TopKState, keys: jax.Array,
     k_new = jnp.where(mask, keys.astype(jnp.int32), -1)
     allk = jnp.concatenate([topk.keys, k_new])
     alle = jnp.concatenate([topk.ests, est])
-    # rank: group by key ascending, largest estimate first within a key;
-    # empty slots (key -1) sort first and are masked below.
-    rank = (allk.astype(jnp.int64) << 32) - alle.astype(jnp.int64)
-    order = jnp.argsort(rank)
+    # Group by key ascending with the largest estimate first within each
+    # key (lexsort: last key is primary).  Stays in int32 — a packed
+    # (key << 32 | est) int64 rank would silently truncate under JAX's
+    # default x64-disabled mode and destroy the grouping.
+    order = jnp.lexsort((-alle, allk))
     k_sorted = allk[order]
     e_sorted = alle[order]
     first = jnp.concatenate(

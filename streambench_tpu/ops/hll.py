@@ -145,3 +145,26 @@ def flush(state: HLLState, *, divisor_ms: int = 10_000,
     regs = jnp.where(freed[None, :, None], 0, state.registers)
     return est, state.window_ids, HLLState(
         regs, new_ids, state.watermark, state.dropped)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("divisor_ms", "lateness_ms", "view_type"))
+def scan_steps(state: HLLState, join_table: jax.Array,
+               ad_idx: jax.Array, user_idx: jax.Array,
+               event_type: jax.Array, event_time: jax.Array,
+               valid: jax.Array,
+               *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
+               view_type: int = 0) -> HLLState:
+    """Fold ``[N, B]`` stacked micro-batches via ``lax.scan`` — one
+    dispatch per chunk, same amortization as
+    ``ops.windowcount.scan_steps``."""
+
+    def body(carry, xs):
+        a, u, e, t, v = xs
+        return step(carry, join_table, a, u, e, t, v,
+                    divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                    view_type=view_type), None
+
+    final, _ = jax.lax.scan(
+        body, state, (ad_idx, user_idx, event_type, event_time, valid))
+    return final

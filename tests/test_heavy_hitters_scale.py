@@ -125,3 +125,23 @@ def test_legacy_snapshot_without_ring_reseeds_candidates():
     eng2.restore(snap)
     hh = dict(eng2.heavy_hitters())
     assert "star" in hh, hh
+
+
+def test_update_topk_dedup_survives_interleaved_estimates():
+    """Regression: dedup must group by KEY, not by estimate rank — an
+    int64-packed rank truncates to int32 under default JAX and lets the
+    same key occupy several ring slots, shrinking effective capacity."""
+    state = cms.init_state(depth=4, width=1024)
+    topk = cms.init_topk(4)
+    # weights chosen so key 7's two updates bracket key 2's estimate
+    keys = jnp.asarray(np.array([7, 2, 7, 9, 5], np.int32))
+    w = jnp.asarray(np.array([10, 8, 3, 2, 1], np.int32))
+    mask = jnp.ones(5, bool)
+    state = cms.update(state, keys, w, mask)
+    topk = cms.update_topk(state, topk, keys, mask)
+    ks = np.asarray(topk.keys)
+    live = ks[ks >= 0].tolist()
+    assert len(live) == len(set(live)), f"duplicate keys in ring: {live}"
+    assert set(live) == {7, 2, 9, 5}
+    es = dict(zip(ks.tolist(), np.asarray(topk.ests).tolist()))
+    assert es[7] == 13 and es[2] == 8

@@ -149,3 +149,78 @@ def test_session_cms_engine_heavy_hitters(tmp_path):
     assert max(hh.values()) >= true_top
     table = r.hgetall(f"{cfg.redis_hashtable}_hh")
     assert len(table) == len(hh)
+
+
+def test_hll_scan_matches_per_batch():
+    """HLL's scanned kernel must produce the same registers as the
+    per-batch step (process_chunk with scan vs process_lines)."""
+    import random as pyrandom
+
+    import numpy as np
+
+    from streambench_tpu.config import default_config
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.engine.sketches import HLLDistinctEngine
+
+    campaigns = [f"c{i}" for i in range(5)]
+    mapping = {f"ad{i}": campaigns[i % 5] for i in range(20)}
+    src = gen.EventSource(ads=list(mapping),
+                          user_ids=[f"u{i}" for i in range(200)],
+                          page_ids=["p"], rng=pyrandom.Random(4))
+    lines = [src.event_at(1_700_000_000_000 + 15 * i).encode()
+             for i in range(3000)]
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=4)
+    a = HLLDistinctEngine(cfg, mapping, campaigns=campaigns)
+    for off in range(0, len(lines), 256):
+        a.process_lines(lines[off:off + 256])
+
+    b = HLLDistinctEngine(cfg, mapping, campaigns=campaigns)
+    assert b.SCAN_SUPPORTED
+    b.process_chunk(lines)
+
+    np.testing.assert_array_equal(np.asarray(a.state.registers),
+                                  np.asarray(b.state.registers))
+    assert int(a.state.watermark) == int(b.state.watermark)
+
+
+def test_session_fused_scan_matches_per_batch():
+    """The fused session+CMS+ring scan must agree with the per-batch
+    path on every piece of state."""
+    import random as pyrandom
+
+    import numpy as np
+
+    from streambench_tpu.config import default_config
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.engine.sketches import SessionCMSEngine
+
+    campaigns = [f"c{i}" for i in range(5)]
+    mapping = {f"ad{i}": campaigns[i % 5] for i in range(20)}
+    src = gen.EventSource(ads=list(mapping),
+                          user_ids=[f"u{i}" for i in range(50)],
+                          page_ids=["p"], rng=pyrandom.Random(9))
+    # 40 ms stride x 50 users -> 2 s between a user's events; use a
+    # small gap so sessions actually close mid-stream
+    lines = [src.event_at(1_700_000_000_000 + 40 * i).encode()
+             for i in range(4000)]
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=4)
+    a = SessionCMSEngine(cfg, mapping, campaigns=campaigns, gap_ms=1_000)
+    for off in range(0, len(lines), 256):
+        a.process_lines(lines[off:off + 256])
+
+    b = SessionCMSEngine(cfg, mapping, campaigns=campaigns, gap_ms=1_000)
+    assert b.SCAN_SUPPORTED
+    b.process_chunk(lines)
+
+    assert a.sessions_closed == b.sessions_closed > 0
+    assert a.session_clicks == b.session_clicks > 0
+    np.testing.assert_array_equal(np.asarray(a.cms.table),
+                                  np.asarray(b.cms.table))
+    np.testing.assert_array_equal(np.asarray(a.state.last_time),
+                                  np.asarray(b.state.last_time))
+    # candidate rings hold the same key set (order may differ on ties)
+    ka = np.asarray(a.topk.keys)
+    kb = np.asarray(b.topk.keys)
+    assert set(ka[ka >= 0].tolist()) == set(kb[kb >= 0].tolist())
