@@ -367,7 +367,10 @@ def main() -> int:
                 f"{device['device_ms_est']} ms = "
                 f"{device['device_ns_per_event']} ns/event)")
 
-        engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+        # optional kernel override (scatter|onehot|matmul|pallas); default
+        # is the per-backend choice in engine.pipeline.default_method
+        method = os.environ.get("STREAMBENCH_BENCH_METHOD") or None
+        engine = AdAnalyticsEngine(cfg, mapping, redis=r, method=method)
         log(f"engine: method={engine.method} W={engine.W} "
             f"B={engine.batch_size} K={engine.scan_batches}")
         runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))

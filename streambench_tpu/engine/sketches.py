@@ -184,6 +184,35 @@ class HLLDistinctEngine(_SketchEngineBase):
         return int(self.state.dropped)
 
 
+@functools.partial(jax.jit, static_argnames=("size_ms", "slide_ms",
+                                             "lateness_ms"))
+def _sliding_tdigest_scan(win_state, digest, join_table, now_rel,
+                          ad_idx, event_type, event_time, valid,
+                          *, size_ms: int, slide_ms: int,
+                          lateness_ms: int):
+    """Fused sliding-window + t-digest scan over ``[N, B]`` batches.
+
+    One dispatch per chunk, digest samples taken against a single
+    ``now_rel`` stamp captured at dispatch time (the same two-clock
+    semantics as the per-batch path, which also reads the host clock
+    once per Python-level step)."""
+
+    def body(carry, xs):
+        st, dg = carry
+        a, et, t, v = xs
+        st = sliding.step(st, join_table, a, et, t, v, size_ms=size_ms,
+                          slide_ms=slide_ms, lateness_ms=lateness_ms)
+        lat = jnp.maximum(now_rel - t, 0)
+        campaign = join_table[a]
+        mask = v & (et == 0) & (campaign >= 0)
+        dg = tdigest.update(dg, campaign, lat, mask)
+        return (st, dg), None
+
+    carry, _ = jax.lax.scan(body, (win_state, digest),
+                            (ad_idx, event_type, event_time, valid))
+    return carry
+
+
 class SlidingTDigestEngine(_SketchEngineBase):
     """Sliding-window view counts + per-campaign latency t-digest.
 
@@ -221,6 +250,21 @@ class SlidingTDigestEngine(_SketchEngineBase):
                                          compression=compression)
 
     ENGINE_FAMILY = "sliding_tdigest"
+    SCAN_SUPPORTED = True  # fused sliding+digest scan (columns: default)
+
+    def _now_rel(self) -> jnp.int32:
+        """Host clock rebased to the encoder origin, clamped into int32
+        (the ONE copy of the two-clock rebase used by both the per-batch
+        and the scanned digest-sampling paths)."""
+        base = self.encoder.base_time_ms or 0
+        return jnp.int32(np.clip(np.int64(now_ms()) - base, 0, 2**31 - 2))
+
+    def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
+        self.state, self.digest = _sliding_tdigest_scan(
+            self.state, self.digest, self.join_table, self._now_rel(),
+            ad_idx, event_type, event_time, valid,
+            size_ms=self.size_ms, slide_ms=self.slide_ms,
+            lateness_ms=self.base_lateness)
 
     def snapshot(self, offset: int):
         from streambench_tpu.checkpoint import Snapshot
@@ -270,11 +314,9 @@ class SlidingTDigestEngine(_SketchEngineBase):
         # NTP-disciplined — exactly the reference's assumption
         # (core.clj:149 subtracts generator stamps from engine-side
         # update times the same way).  Cross-host skew shifts the whole
-        # digest by the offset; the clamp below only stops negative skew
-        # from corrupting the digest with negative "latencies".
-        base = self.encoder.base_time_ms or 0
-        now_rel = np.clip(np.int64(now_ms()) - base, 0, 2**31 - 2)
-        lat = jnp.maximum(jnp.int32(now_rel) - tm, 0)
+        # digest by the offset; the _now_rel clamp only stops negative
+        # skew from corrupting the digest with negative "latencies".
+        lat = jnp.maximum(self._now_rel() - tm, 0)
         campaign = self.join_table[ad]
         mask = valid & (et == 0) & (campaign >= 0)
         self.digest = tdigest.update(self.digest, campaign, lat, mask)

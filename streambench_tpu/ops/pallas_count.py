@@ -1,0 +1,84 @@
+"""Pallas TPU kernel for the hot op: masked (campaign, slot) counting.
+
+The MXU formulation of the window count (``ops.windowcount.step`` with
+``method="matmul"``) computes ``campaign_onehot^T @ slot_onehot`` through
+XLA, which may materialize the ``[B, C]``/``[B, W]`` one-hot operands in
+HBM between fusions.  This kernel fuses one-hot construction and the
+matmul accumulation inside VMEM: the batch streams through in tiles, the
+``[C, W]`` accumulator never leaves VMEM, and each tile's one-hots exist
+only as kernel-local values (pallas_guide.md: grid + BlockSpec
+accumulation pattern).
+
+Optional by design: ``method="pallas"`` in ``windowcount.step`` selects
+it; the default remains XLA's fusion (``matmul``/``scatter``), which this
+kernel is bit-identical to (tested in interpret mode, which also makes it
+runnable on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(camp_ref, slot_ref, mask_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    T = camp_ref.shape[1]
+    C, W = out_ref.shape
+    camp = camp_ref[0, :]
+    slot = slot_ref[0, :]
+    mask = mask_ref[0, :] != 0
+    camp_oh = ((camp[:, None]
+                == jax.lax.broadcasted_iota(jnp.int32, (T, C), 1))
+               & mask[:, None]).astype(jnp.float32)
+    slot_oh = (slot[:, None]
+               == jax.lax.broadcasted_iota(jnp.int32, (T, W), 1)
+               ).astype(jnp.float32)
+    out_ref[...] += jax.lax.dot_general(
+        camp_oh, slot_oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def count_tiles(counts: jax.Array, campaign: jax.Array, slot: jax.Array,
+                count_mask: jax.Array, *, tile: int = 512,
+                interpret: bool | None = None) -> jax.Array:
+    """``counts[c, w] += #{masked rows with campaign c, slot w}``.
+
+    ``campaign``/``slot`` are int32 ``[B]``; masked-out rows may hold any
+    values.  ``B`` is padded to a tile multiple internally.  ``interpret``
+    defaults to True off-TPU so tests exercise identical semantics on the
+    CPU mesh.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    B = campaign.shape[0]
+    C, W = counts.shape
+    nb = -(-B // tile)
+    pad = nb * tile - B
+    mask_i = count_mask.astype(jnp.int32)
+    if pad:
+        campaign = jnp.pad(campaign, (0, pad))
+        slot = jnp.pad(slot, (0, pad))
+        mask_i = jnp.pad(mask_i, (0, pad))
+    camp2 = campaign.reshape(nb, tile)
+    slot2 = slot.reshape(nb, tile)
+    mask2 = mask_i.reshape(nb, tile)
+    delta = pl.pallas_call(
+        _kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((1, tile), lambda i: (i, 0))
+                  for _ in range(3)],
+        out_specs=pl.BlockSpec((C, W), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, W), jnp.int32),
+        interpret=interpret,
+    )(camp2, slot2, mask2)
+    return counts + delta

@@ -21,7 +21,7 @@ Redis writeback accumulates with HINCRBY, exactly the reference's
 partial-flush semantics (``AdvertisingSpark.scala:203``,
 ``CampaignProcessorCommon.java:91-98``).
 
-Two scatter strategies are provided (``method=``):
+Four counting strategies are provided (``method=``):
 
 - ``"scatter"`` — a flat ``.at[].add`` scatter-add; masked rows get index -1
   which JAX scatters drop.
@@ -33,8 +33,11 @@ Two scatter strategies are provided (``method=``):
   systolic array.  Intermediates are [B,C] + [B,W] (not [B,C*W]), so it
   scales in C and W independently; f32 accumulation of 0/1 over B stays
   exact to 2^24, far above any batch size.
+- ``"pallas"``  — the same factored matmul as a hand-fused Pallas kernel
+  (``ops.pallas_count``): one-hots and the [C, W] accumulator live in
+  VMEM only, streamed over batch tiles.
 
-``bench.py`` picks per backend; all three are bit-identical (tested).
+``bench.py`` picks per backend; all methods are bit-identical (tested).
 
 All times are int32 ms relative to the encoder's ``base_time_ms``; window
 ids are int32.  Nothing here uses dynamic shapes or Python control flow, so
@@ -168,6 +171,10 @@ def step(state: WindowState, join_table: jax.Array,
             camp_oh, slot_oh, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                    # [C, W]
         counts = state.counts + delta.astype(jnp.int32)
+    elif method == "pallas":
+        from streambench_tpu.ops.pallas_count import count_tiles
+
+        counts = count_tiles(state.counts, campaign, slot, count_mask)
     else:
         raise ValueError(f"unknown method {method!r}")
 
