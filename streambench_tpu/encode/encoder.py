@@ -83,6 +83,13 @@ class EventEncoder:
 
     RELEASES_GIL = False
 
+    def set_intern_ids(self, on: bool) -> None:
+        """Disable/enable user/page interning.  Engines whose kernels
+        never read the interned columns (exact counts, sliding windows)
+        turn it off: the per-row hash probes are the biggest per-event
+        cost after tokenization, and the columns then carry zeros."""
+        self.intern_ids = bool(on)
+
     def __init__(self, ad_to_campaign: dict[str, str],
                  campaigns: list[str] | None = None,
                  divisor_ms: int = 10_000, lateness_ms: int = 60_000):
@@ -107,6 +114,7 @@ class EventEncoder:
         self.unknown_ad = len(self.ads)   # maps to campaign -1
         self.user_index: dict[bytes, int] = {}
         self.page_index: dict[bytes, int] = {}
+        self.intern_ids = True
         self.base_time_ms: int | None = None
         self.fallback_lines = 0
         self.bad_lines = 0
@@ -239,8 +247,9 @@ class EventEncoder:
             ad_idx[i] = self._ad_lookup(ad)
             etype[i] = EVENT_TYPE_INDEX_B.get(et, -1)
             etime[i] = t - self.base_time_ms
-            user_idx[i] = self._intern(self.user_index, u)
-            page_idx[i] = self._intern(self.page_index, p)
+            if self.intern_ids:
+                user_idx[i] = self._intern(self.user_index, u)
+                page_idx[i] = self._intern(self.page_index, p)
             ad_type[i] = AD_TYPE_INDEX_B.get(at, -1)
             valid[i] = True
             n += 1
@@ -283,8 +292,9 @@ class EventEncoder:
             ad_idx[n] = self._ad_lookup(ad)
             etype[n] = EVENT_TYPE_INDEX_B.get(et, -1)
             etime[n] = ti - self.base_time_ms
-            user_idx[n] = self._intern(self.user_index, u)
-            page_idx[n] = self._intern(self.page_index, p)
+            if self.intern_ids:
+                user_idx[n] = self._intern(self.user_index, u)
+                page_idx[n] = self._intern(self.page_index, p)
             ad_type[n] = AD_TYPE_INDEX_B.get(at, -1)
             valid[n] = True
             n += 1

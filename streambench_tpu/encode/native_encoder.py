@@ -55,6 +55,10 @@ class NativeEventEncoder(EventEncoder):
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             len(ads_b), divisor_ms, lateness_ms)
 
+    def set_intern_ids(self, on: bool) -> None:
+        super().set_intern_ids(on)
+        self._lib.sb_encoder_set_intern_ids(self._enc, 1 if on else 0)
+
     def set_base_time(self, base_time_ms: int | None) -> None:
         super().set_base_time(base_time_ms)
         self._lib.sb_encoder_set_base_time(

@@ -165,10 +165,19 @@ class AdAnalyticsEngine:
         self.redis = redis
         self.divisor = cfg.jax_time_divisor_ms
         self.lateness = cfg.jax_allowed_lateness_ms
-        self.encoder = make_encoder(ad_to_campaign, campaigns,
-                                    divisor_ms=self.divisor,
-                                    lateness_ms=self.lateness,
-                                    use_native=cfg.jax_use_native_encoder)
+        def _new_encoder():
+            """ONE construction+configuration site: the primary encoder
+            and every pool worker must be configured identically."""
+            e = make_encoder(ad_to_campaign, campaigns,
+                             divisor_ms=self.divisor,
+                             lateness_ms=self.lateness,
+                             use_native=cfg.jax_use_native_encoder)
+            if not self.NEEDS_INTERNED_IDS:
+                e.set_intern_ids(False)
+            return e
+
+        self._new_encoder = _new_encoder
+        self.encoder = _new_encoder()
         self.join_table = jnp.asarray(self.encoder.join_table)
         self.W = cfg.jax_window_slots
         self.method = method or default_method(self.encoder.num_campaigns)
@@ -219,11 +228,7 @@ class AdAnalyticsEngine:
             from streambench_tpu.encode.parallel import ParallelEncodePool
 
             self._encode_pool = ParallelEncodePool(
-                self.encoder,
-                lambda: make_encoder(ad_to_campaign, campaigns,
-                                     divisor_ms=self.divisor,
-                                     lateness_ms=self.lateness,
-                                     use_native=cfg.jax_use_native_encoder),
+                self.encoder, self._new_encoder,
                 workers=cfg.jax_encode_workers)
 
     # Subclasses whose _device_step is not the exact-count kernel clear
@@ -236,6 +241,10 @@ class AdAnalyticsEngine:
     # Engines whose kernel reads interned user/page columns must keep a
     # single consistent intern table and clear this (encode.parallel).
     PARALLEL_ENCODE_OK = True
+    # Whether the device kernel reads the interned user/page columns.
+    # When False, the encoder skips interning entirely (two hash probes
+    # per row — the biggest per-event encode cost after tokenization).
+    NEEDS_INTERNED_IDS = False
 
     # ------------------------------------------------------------------
     def process_lines(self, lines: list[bytes]) -> int:

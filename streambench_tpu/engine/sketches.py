@@ -51,8 +51,10 @@ class _SketchEngineBase(AdAnalyticsEngine):
     # per-batch (deferred drains still apply).
     SCAN_SUPPORTED = False
     # Sketch device state is keyed by interned indices: one consistent
-    # intern table is mandatory, so no per-thread parallel encoders.
+    # intern table is mandatory, so no per-thread parallel encoders and
+    # interning stays ON.
     PARALLEL_ENCODE_OK = False
+    NEEDS_INTERNED_IDS = True
 
     @staticmethod
     def _pack_keys(keys: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
@@ -251,6 +253,12 @@ class SlidingTDigestEngine(_SketchEngineBase):
 
     ENGINE_FAMILY = "sliding_tdigest"
     SCAN_SUPPORTED = True  # fused sliding+digest scan (columns: default)
+    # Sliding counts + latency digests never read user/page columns, so
+    # interning is skipped AND per-thread parallel encoders are safe
+    # (the sketch-base restriction is about intern consistency, which
+    # this engine doesn't depend on).
+    NEEDS_INTERNED_IDS = False
+    PARALLEL_ENCODE_OK = True
 
     def _now_rel(self) -> jnp.int32:
         """Host clock rebased to the encoder origin, clamped into int32

@@ -15,18 +15,42 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <string_view>
 #include <unordered_map>
 #include <vector>
 
 namespace {
 
+// Transparent (heterogeneous) hashing: lets the hot loop probe the maps
+// with a string_view into the input buffer — NO std::string temporary,
+// no heap allocation per row (C++20 unordered heterogeneous lookup).
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view sv) const {
+    return std::hash<std::string_view>{}(sv);
+  }
+  size_t operator()(const std::string& s) const {
+    return std::hash<std::string_view>{}(std::string_view(s));
+  }
+};
+struct SvEq {
+  using is_transparent = void;
+  bool operator()(std::string_view a, std::string_view b) const {
+    return a == b;
+  }
+};
+using SvMap = std::unordered_map<std::string, int32_t, SvHash, SvEq>;
+
 struct StringInterner {
-  std::unordered_map<std::string, int32_t> map;
+  SvMap map;
   int32_t next = 0;
 
   int32_t intern(const char* s, size_t len) {
-    auto r = map.emplace(std::string(s, len), next);
-    if (r.second) ++next;
+    std::string_view sv(s, len);
+    auto it = map.find(sv);           // no alloc on the hit path
+    if (it != map.end()) return it->second;
+    auto r = map.emplace(std::string(sv), next);
+    ++next;
     return r.first->second;
   }
 
@@ -63,9 +87,13 @@ struct StringInterner {
 constexpr int64_t kBaseUnset = INT64_MIN;
 
 struct Encoder {
-  std::unordered_map<std::string, int32_t> ad_index;
+  SvMap ad_index;
   StringInterner users;
   StringInterner pages;
+  // When false, user/page ids are NOT interned (columns get 0): the
+  // exact-count kernels never read them, and the two hash probes per
+  // row are the single largest per-event cost after tokenization.
+  bool intern_ids = true;
   int64_t base_time_ms = kBaseUnset;
   int64_t divisor_ms = 10000;
   int64_t lateness_ms = 60000;
@@ -155,13 +183,19 @@ inline int parse_one(Encoder* enc, const char* p, const char* end,
   if (enc->base_time_ms == kBaseUnset) {
     enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
   }
-  auto ad_it = enc->ad_index.find(std::string(toks[11].p, toks[11].len));
+  auto ad_it = enc->ad_index.find(std::string_view(toks[11].p,
+                                                   toks[11].len));
   ad_idx[i] = ad_it == enc->ad_index.end() ? enc->unknown_ad
                                            : ad_it->second;
   etype[i] = event_type_code(toks[19]);
   etime[i] = static_cast<int32_t>(t - enc->base_time_ms);
-  user_idx[i] = enc->users.intern(toks[3].p, toks[3].len);
-  page_idx[i] = enc->pages.intern(toks[7].p, toks[7].len);
+  if (enc->intern_ids) {
+    user_idx[i] = enc->users.intern(toks[3].p, toks[3].len);
+    page_idx[i] = enc->pages.intern(toks[7].p, toks[7].len);
+  } else {
+    user_idx[i] = 0;
+    page_idx[i] = 0;
+  }
   ad_type[i] = ad_type_code(toks[15]);
   status[i] = 1;
   return 1;
@@ -194,6 +228,12 @@ int64_t sb_encoder_base_time(void* enc) {
 
 void sb_encoder_set_base_time(void* enc, int64_t base) {
   static_cast<Encoder*>(enc)->base_time_ms = base;
+}
+
+// 0 disables user/page interning (columns become 0) for engines whose
+// kernels never read those columns; 1 (default) re-enables it.
+void sb_encoder_set_intern_ids(void* enc, int32_t on) {
+  static_cast<Encoder*>(enc)->intern_ids = on != 0;
 }
 
 int64_t sb_encoder_n_users(void* enc) {
