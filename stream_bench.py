@@ -53,6 +53,11 @@ CHECKPOINT_DIR = os.environ.get("CHECKPOINT_DIR", "")
 # firehose, stream-bench.sh:107-115).  Errors loudly if confluent-kafka
 # is absent — no silent fallback.
 KAFKA_BROKERS = os.environ.get("KAFKA_BROKERS", "")
+# Engine tuning knobs forwarded into localConf (jax.* keys): batches per
+# device dispatch, window ring slots, parallel encode threads.
+SCAN_BATCHES = int(os.environ.get("SCAN_BATCHES", "8"))
+WINDOW_SLOTS = int(os.environ.get("WINDOW_SLOTS", "16"))
+ENCODE_WORKERS = int(os.environ.get("ENCODE_WORKERS", "1"))
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -181,6 +186,9 @@ def op_setup() -> None:
         "map.partitions": PARTITIONS,
         "process.hosts": 1,
         "process.cores": 4,
+        "jax.scan.batches": SCAN_BATCHES,
+        "jax.window.slots": WINDOW_SLOTS,
+        "jax.encode.workers": ENCODE_WORKERS,
     })
     log(f"wrote {CONF_FILE}")
     try:

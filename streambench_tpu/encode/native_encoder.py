@@ -245,8 +245,13 @@ class NativeEventEncoder(EventEncoder):
             self.ad_index.get(ad, self.unknown_ad),
             EVENT_TYPE_INDEX.get(str(ev.get("event_type", "")), -1),
             t - base,
-            self._lib.sb_intern_user(self._enc, u, len(u)),
-            self._lib.sb_intern_page(self._enc, p, len(p)),
+            # the fallback honors the interning switch exactly like the
+            # fast path: stray fallback rows must not grow the maps or
+            # break the zeros invariant when interning is off
+            self._lib.sb_intern_user(self._enc, u, len(u))
+            if self.intern_ids else 0,
+            self._lib.sb_intern_page(self._enc, p, len(p))
+            if self.intern_ids else 0,
             AD_TYPE_INDEX.get(str(ev.get("ad_type", "")), -1),
         )
 

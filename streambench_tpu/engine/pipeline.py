@@ -165,6 +165,7 @@ class AdAnalyticsEngine:
         self.redis = redis
         self.divisor = cfg.jax_time_divisor_ms
         self.lateness = cfg.jax_allowed_lateness_ms
+
         def _new_encoder():
             """ONE construction+configuration site: the primary encoder
             and every pool worker must be configured identically."""
@@ -176,7 +177,6 @@ class AdAnalyticsEngine:
                 e.set_intern_ids(False)
             return e
 
-        self._new_encoder = _new_encoder
         self.encoder = _new_encoder()
         self.join_table = jnp.asarray(self.encoder.join_table)
         self.W = cfg.jax_window_slots
@@ -227,8 +227,10 @@ class AdAnalyticsEngine:
             # only the native encoder's ctypes scan parallelizes.
             from streambench_tpu.encode.parallel import ParallelEncodePool
 
+            # the pool holds the factory; no reference is kept otherwise
+            # (the closure pins ad_to_campaign, unnecessary pool-less)
             self._encode_pool = ParallelEncodePool(
-                self.encoder, self._new_encoder,
+                self.encoder, _new_encoder,
                 workers=cfg.jax_encode_workers)
 
     # Subclasses whose _device_step is not the exact-count kernel clear
