@@ -46,6 +46,10 @@ class RunStats:
 class StreamRunner:
     """Drives one engine from one journal reader until stopped."""
 
+    # Wire bytes per event, rounded up (sizes block-mode reads; the
+    # generator's JSON events run ~230 B).
+    EST_EVENT_BYTES = 256
+
     def __init__(self, engine: AdAnalyticsEngine, reader: JournalReader,
                  batch_size: int | None = None,
                  buffer_timeout_ms: int | None = None,
@@ -117,7 +121,14 @@ class StreamRunner:
         deadline = (time.monotonic() + duration_s) if duration_s else None
         last_flush = time.monotonic()
         last_data = time.monotonic()
-        pending: list[bytes] = []
+        # Block mode (native scanner over raw bytes) when both ends
+        # support it; pending then holds byte blocks, counted by newline
+        # (a memchr scan, ~free) instead of per-line Python objects.
+        block_mode = (getattr(self.engine, "supports_block_ingest", False)
+                      and hasattr(self.reader, "poll_block"))
+        est_bytes = self.EST_EVENT_BYTES
+        pending: list[bytes] = []      # lines, or raw blocks in block mode
+        pending_n = 0                  # records pending
         pending_since: float | None = None
         # Adaptive batching under backlog: while the reader keeps handing
         # back full reads (producer is ahead of us), grow the dispatch
@@ -129,11 +140,19 @@ class StreamRunner:
         target = self.batch_size
 
         def dispatch() -> None:
-            nonlocal pending, pending_since, last_data
-            self.engine.process_chunk(pending)
-            st.events += len(pending)
+            nonlocal pending, pending_n, pending_since, last_data
+            # count PARSED events in both modes (events_processed delta),
+            # so max_events cutoffs and throughput stats don't depend on
+            # which ingest mode the reader supports
+            before = self.engine.events_processed
+            if block_mode:
+                self.engine.process_block(b"".join(pending))
+            else:
+                self.engine.process_chunk(pending)
+            st.events += self.engine.events_processed - before
             st.batches += 1
             pending = []
+            pending_n = 0
             pending_since = None
             last_data = time.monotonic()  # processing isn't idleness
 
@@ -144,19 +163,37 @@ class StreamRunner:
             if max_events and st.events >= max_events:
                 break
 
-            room = target - len(pending)
-            lines = self.reader.poll(max_records=max(room, 0)) if room else []
-            if lines:
+            room = target - pending_n
+            full_read = False
+            if room <= 0:
+                got = 0
+            elif block_mode:
+                budget = room * est_bytes
+                data = self.reader.poll_block(budget)
+                got = data.count(b"\n") if data else 0
+                # records can be longer than the estimate, so judge
+                # backlog by BYTES: a read that nearly filled its budget
+                # means more data is waiting
+                full_read = len(data) >= budget - est_bytes
+                if got:
+                    pending.append(data)
+            else:
+                lines = self.reader.poll(max_records=room)
+                got = len(lines)
+                full_read = got >= room
+                if got:
+                    pending.extend(lines)
+            if got:
                 last_data = now
                 if pending_since is None:
                     pending_since = now
-                pending.extend(lines)
-                if len(lines) >= room:       # backlog: scale the batch up
+                pending_n += got
+                if full_read:                # backlog: scale the batch up
                     target = min(target * 2, chunk_cap)
-                elif len(pending) < self.batch_size:
+                elif pending_n < self.batch_size:
                     target = self.batch_size
             else:
-                if len(pending) < self.batch_size:
+                if pending_n < self.batch_size:
                     target = self.batch_size
                 if (idle_timeout_s and not pending
                         and now - last_data >= idle_timeout_s):
@@ -167,9 +204,9 @@ class StreamRunner:
 
             batch_old = (pending_since is not None and
                          (now - pending_since) * 1000 >= self.buffer_timeout_ms)
-            if len(pending) >= target or (pending and batch_old):
+            if pending_n >= target or (pending and batch_old):
                 dispatch()
-            elif not lines:
+            elif not got:
                 time.sleep(0.001)  # nothing due and nothing new: yield
 
             if (now - last_flush) * 1000 >= self.flush_interval_ms:
@@ -208,21 +245,21 @@ class StreamRunner:
         # Kafka adapter stay on the line path.
         block_mode = (getattr(self.engine, "supports_block_ingest", False)
                       and hasattr(self.reader, "poll_block"))
-        block_bytes = chunk * 256   # ~wire bytes per event, rounded up
+        block_bytes = chunk * self.EST_EVENT_BYTES
         while not self._stop:
+            before = self.engine.events_processed
             if block_mode:
                 data = self.reader.poll_block(block_bytes)
                 if not data:
                     break
-                st.events += self.engine.process_block(data)
-                st.batches += 1
+                self.engine.process_block(data)
             else:
                 lines = self.reader.poll(max_records=chunk)
                 if not lines:
                     break
                 self.engine.process_chunk(lines)
-                st.events += len(lines)
-                st.batches += 1
+            st.events += self.engine.events_processed - before
+            st.batches += 1
             if max_events and st.events >= max_events:
                 break
             now = time.monotonic()
