@@ -44,7 +44,10 @@ def test_jax_test_end_to_end(tmp_path):
         "WORKDIR": wd,
         "REDIS_PORT": str(free_port()),
         "LOAD": "400",
-        "TEST_TIME": "10",
+        # generous: under full-suite CPU contention the engine's warmup
+        # can eat several seconds before the first flush lands
+        "TEST_TIME": "15",
+        "STOP_STATS_GRACE": "4",
         "TOPIC": "ad-events",
     }
     proc = run_harness(["JAX_TEST"], env)
