@@ -221,6 +221,22 @@ def find_and_read_config_file(path: str | os.PathLike[str]) -> BenchmarkConfig:
     return BenchmarkConfig.from_mapping(data)
 
 
+def load_config_or_default(path: str | os.PathLike[str], *,
+                           is_default_path: bool) -> "BenchmarkConfig":
+    """CLI convention shared by the datagen/handoff entry points: a
+    MISSING file at the parser's DEFAULT path falls back to built-in
+    defaults (hermetic runs need no config file), while an explicitly
+    given path must exist.  Parse errors always raise ``ConfigError``."""
+    import sys
+
+    path = os.fspath(path)
+    if is_default_path and not os.path.exists(path):
+        print(f"note: config file not found: {path}; using built-in "
+              "defaults", file=sys.stderr)
+        return default_config()
+    return find_and_read_config_file(path)
+
+
 def default_config(**overrides: Any) -> BenchmarkConfig:
     """A config with the checked-in ``benchmarkConf.yaml`` defaults.
 

@@ -17,11 +17,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from streambench_tpu.config import (
-    ConfigError,
-    default_config,
-    find_and_read_config_file,
-)
+from streambench_tpu.config import ConfigError, load_config_or_default
 from streambench_tpu.datagen import gen
 from streambench_tpu.io.kafka import make_broker
 from streambench_tpu.io.resp import RespClient
@@ -62,14 +58,12 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     parser_default = build_parser().get_default("configPath")
     try:
-        cfg = find_and_read_config_file(args.configPath)
+        cfg = load_config_or_default(
+            args.configPath,
+            is_default_path=args.configPath == parser_default)
     except ConfigError as e:
-        if args.configPath == parser_default and "not found" in str(e):
-            print(f"note: {e}; using built-in defaults", file=sys.stderr)
-            cfg = default_config()
-        else:
-            print(f"error: {e}", file=sys.stderr)
-            return 2
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     broker = make_broker(cfg.kafka_bootstrap_servers,
                          args.brokerDir or f"{args.workdir}/broker")
 

@@ -115,14 +115,20 @@ def _main(argv: list[str] | None = None) -> int:
     p.add_argument("--inprocessRedis", action="store_true")
     args = p.parse_args(argv)
 
-    from streambench_tpu.config import find_and_read_config_file
+    from streambench_tpu.config import ConfigError, load_config_or_default
     from streambench_tpu.datagen import gen
     from streambench_tpu.encode.native_encoder import make_encoder
     from streambench_tpu.io.fakeredis import FakeRedisStore
     from streambench_tpu.io.redis_schema import as_redis
     from streambench_tpu.io.resp import RespClient
 
-    cfg = find_and_read_config_file(args.confPath)
+    try:
+        cfg = load_config_or_default(
+            args.confPath,
+            is_default_path=args.confPath == p.get_default("confPath"))
+    except ConfigError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     table = args.table or f"{cfg.redis_hashtable}_handoff"
     rng = random.Random(1234)
     campaigns = gen.make_ids(cfg.jax_num_campaigns, rng)
@@ -171,4 +177,7 @@ def _main(argv: list[str] | None = None) -> int:
 if __name__ == "__main__":
     import sys
 
+    from streambench_tpu.utils.platform import pin_jax_platform
+
+    pin_jax_platform()  # honor JAX_PLATFORMS before any backend init
     sys.exit(_main())
