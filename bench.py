@@ -150,13 +150,7 @@ def _measure_device_time(cfg, mapping, broker) -> dict:
     t0 = time.perf_counter()
     for _ in range(iters):
         if use_block:
-            start = 0
-            while start < len(block):
-                _, consumed = eng.encoder.encode_block(
-                    block, cfg.jax_batch_size, start)
-                if consumed <= 0:
-                    break
-                start += consumed
+            eng.encoder.carve_block(block, cfg.jax_batch_size)
         else:
             for off in range(0, n, cfg.jax_batch_size):
                 eng._encode(lines[off:off + cfg.jax_batch_size],

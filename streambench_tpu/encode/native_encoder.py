@@ -227,6 +227,24 @@ class NativeEventEncoder(EventEncoder):
                             if self.base_time_ms is not None else 0), \
             consumed
 
+    def carve_block(self, data: bytes, batch_size: int, start: int = 0,
+                    max_batches: int | None = None
+                    ) -> tuple[list[EncodedBatch], int]:
+        """Encode consecutive batches out of a raw block: returns the
+        non-empty batches plus the offset where consumption stopped
+        (either end-of-complete-records or the ``max_batches`` cap).
+        The shared carve loop for every block-mode call site."""
+        batches: list[EncodedBatch] = []
+        while ((max_batches is None or len(batches) < max_batches)
+               and start < len(data)):
+            b, consumed = self.encode_block(data, batch_size, start)
+            if consumed <= 0:
+                break
+            start += consumed
+            if b.n:
+                batches.append(b)
+        return batches, start
+
     def _parse_fallback(self, line: bytes):
         try:
             ev = json.loads(line)

@@ -384,23 +384,15 @@ class AdAnalyticsEngine:
             self.process_chunk(lines)
             return self.events_processed - before
         B = self.batch_size
-        batches = []
-        start = 0
-        while start < len(data):
-            with self.tracer.span("encode"):
-                b, consumed = self.encoder.encode_block(data, B, start)
-            if consumed <= 0:
+        with self.tracer.span("encode"):
+            batches, start = self.encoder.carve_block(data, B)
+            if start < len(data):
                 # unterminated trailing record (poll_block never produces
                 # one, but direct callers can): parse it as one line so
                 # both process_block branches see identical events
-                with self.tracer.span("encode"):
-                    b = self._encode([data[start:]], B)
+                b = self._encode([data[start:]], B)
                 if b.n:
                     batches.append(b)
-                break
-            start += consumed
-            if b.n:
-                batches.append(b)
         if not self.SCAN_SUPPORTED or self.scan_batches <= 1:
             for b in batches:
                 self._fold(b)

@@ -317,19 +317,44 @@ def run_distributed_catchup(engine: DistributedWindowEngine, reader,
     NONE_LO, NONE_HI = np.iinfo(np.int64).max, np.iinfo(np.int64).min
     stats = {"events": 0, "steps": 0, "rounds": 0, "votes": 0,
              "vote_s": 0.0}
+    from streambench_tpu.engine.runner import StreamRunner
+
+    est_bytes = StreamRunner.EST_EVENT_BYTES
+    block_mode = (getattr(engine, "supports_block_ingest", False)
+                  and hasattr(reader, "poll_block"))
+    carry = b""        # block-mode bytes beyond this round's k batches
     done_local = False
     while max_steps is None or stats["steps"] < max_steps:
         k = vote_every
         if max_steps is not None:
             k = min(k, max_steps - stats["steps"])
-        lines = [] if done_local else reader.poll(max_records=B * k)
-        if not lines:
-            done_local = True
         batches = []
-        for off in range(0, len(lines), B):
-            b = engine._encode(lines[off:off + B], B)
-            if b.n:
-                batches.append(b)
+        if block_mode and not (done_local and not carry):
+            # block-mode ingest (same fast path as the single-host
+            # runner; per-process local data, so lockstep alignment is
+            # untouched — batches stay local until the vote).  Records
+            # can be shorter than the byte estimate, so a read may hold
+            # MORE than k batches: the surplus carries to the next round
+            # (its bytes are already consumed from the reader).
+            data = carry
+            budget = B * k * est_bytes - len(carry)
+            if not done_local and budget > 0:  # poll_block(0) != "none"
+                fresh = reader.poll_block(budget)
+                if fresh:
+                    data = carry + fresh
+                else:
+                    done_local = True
+            batches, start = engine.encoder.carve_block(
+                data, B, max_batches=k)
+            carry = data[start:]
+        elif not block_mode and not done_local:
+            lines = reader.poll(max_records=B * k)
+            if not lines:
+                done_local = True
+            for off in range(0, len(lines), B):
+                b = engine._encode(lines[off:off + B], B)
+                if b.n:
+                    batches.append(b)
         # Vote payload: [has_more, n_batches, lo_0, hi_0, ...] — PER-
         # BATCH spans, so the round driver can reconstruct global
         # per-step spans and place drains mid-round deterministically
@@ -342,7 +367,7 @@ def run_distributed_catchup(engine: DistributedWindowEngine, reader,
         # this round.
         base = engine.encoder.base_time_ms or 0
         payload = np.empty(2 + 2 * k, np.int64)
-        payload[0] = 0 if (done_local and not batches) else 1
+        payload[0] = 0 if (done_local and not batches and not carry) else 1
         payload[1] = len(batches)
         payload[2::2], payload[3::2] = NONE_LO, NONE_HI
         for i, b in enumerate(batches):
@@ -391,6 +416,11 @@ def run_distributed_catchup(engine: DistributedWindowEngine, reader,
         # deterministic flush cadence: same step counts -> same flushes
         if stats["steps"] // flush_every != prev // flush_every:
             engine.flush()
+    if carry:
+        # max_steps exit with consumed-but-unfolded bytes: rewind the
+        # reader so a resume (or checkpoint of reader.offset) replays
+        # them instead of silently skipping records
+        reader.seek(reader.offset - len(carry))
     engine.flush()
     engine.drain_writes()  # flush() queues on the writer thread; the
     # function's contract is "flushed to Redis", so block until it landed
