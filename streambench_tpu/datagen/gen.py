@@ -19,10 +19,13 @@ Deliberate fixes over the fork (capabilities, not bugs, are ported):
 
 from __future__ import annotations
 
+import ctypes as _ctypes
 import json
 import os
 import random
 import time
+
+import numpy as _np
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
@@ -149,6 +152,83 @@ class EventSource:
     def events_at(self, ts_ms: Iterable[int]) -> list[str]:
         return [self.event_at(t) for t in ts_ms]
 
+    # -- native fast path -------------------------------------------------
+    # The Python formatter costs ~3 us/event; on a single-core host a paced
+    # producer at 100k ev/s would then eat a third of the core the engine
+    # under test needs.  The C formatter (native/gen.cpp) renders the same
+    # wire format at ~50 ns/event.  RNG streams differ (splitmix64 vs
+    # Python's) — irrelevant to correctness: the oracle replays the journal,
+    # so only the distributions are contractual (core.clj:163-181).
+
+    def _native_ctx(self):
+        if getattr(self, "_nat", None) is None:
+            from streambench_tpu import native as _native
+
+            lib = _native.load()
+            if lib is None or not all(
+                    len(x) == len(self.ads[0]) for x in self.ads):
+                self._nat = False
+                return False
+            ulen = len(self.user_ids[0])
+            plen = len(self.page_ids[0])
+            if (not all(len(u) == ulen for u in self.user_ids)
+                    or not all(len(p) == plen for p in self.page_ids)):
+                self._nat = False
+                return False
+            at_lens = _np.asarray([len(t) for t in AD_TYPES], _np.int32)
+            et_lens = _np.asarray([len(t) for t in EVENT_TYPES], _np.int32)
+            per_event = lib.sb_format_events_cap(
+                ulen, plen, len(self.ads[0]),
+                at_lens.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int32)),
+                len(AD_TYPES),
+                et_lens.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int32)),
+                len(EVENT_TYPES))
+            self._nat = dict(
+                lib=lib,
+                users="".join(self.user_ids).encode(), ulen=ulen,
+                pages="".join(self.page_ids).encode(), plen=plen,
+                ads="".join(self.ads).encode(), alen=len(self.ads[0]),
+                at="".join(AD_TYPES).encode(), at_lens=at_lens,
+                et="".join(EVENT_TYPES).encode(), et_lens=et_lens,
+                per_event=int(per_event),
+                state=_ctypes.c_uint64(self.rng.getrandbits(64)),
+                # persistent output buffer: create_string_buffer would
+                # zero-fill (a hidden memset of the whole capacity) on
+                # every call
+                buf=_np.empty(0, _np.uint8),
+            )
+        return self._nat
+
+    def events_blob_at(self, ts_ms: "Iterable[int]") -> bytes | None:
+        """Render events as ONE newline-terminated byte block via the
+        native formatter; None when the native library is unavailable
+        (callers fall back to ``events_at``)."""
+        ctx = self._native_ctx()
+        if not ctx:
+            return None
+        ts = (ts_ms if isinstance(ts_ms, _np.ndarray)
+              else _np.fromiter(ts_ms, dtype=_np.int64))
+        ts = _np.ascontiguousarray(ts, dtype=_np.int64)
+        if ts.size == 0:
+            return b""
+        cap = int(ts.size) * ctx["per_event"]
+        if ctx["buf"].size < cap:
+            ctx["buf"] = _np.empty(cap, _np.uint8)
+        out = ctx["buf"]
+        i32p = _ctypes.POINTER(_ctypes.c_int32)
+        n = ctx["lib"].sb_format_events(
+            ctx["users"], ctx["ulen"], len(self.user_ids),
+            ctx["pages"], ctx["plen"], len(self.page_ids),
+            ctx["ads"], ctx["alen"], len(self.ads),
+            ctx["at"], ctx["at_lens"].ctypes.data_as(i32p), len(AD_TYPES),
+            ctx["et"], ctx["et_lens"].ctypes.data_as(i32p), len(EVENT_TYPES),
+            ts.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int64)), ts.size,
+            _ctypes.byref(ctx["state"]), 1 if self.with_skew else 0,
+            _ctypes.cast(out.ctypes.data, _ctypes.c_char_p), cap)
+        if n < 0:
+            return None
+        return out[:n].tobytes()
+
 
 # ----------------------------------------------------------------------
 # modes
@@ -220,19 +300,33 @@ def do_setup(r: RedisLike | None, cfg: BenchmarkConfig,
     sinks = ([broker.writer(topic, p, append=False)
               for p in range(partitions)] if broker is not None else [])
     written = 0
-    with open(os.path.join(workdir, KAFKA_JSON_FILE), "w") as journal:
+    # Single-partition fast path: the native formatter renders each batch
+    # as one byte block shared by journal and topic (multi-partition keeps
+    # the line path — round-robin slicing needs per-event boundaries).
+    blob_ok = len(sinks) <= 1 and all(
+        hasattr(s, "append_bytes") for s in sinks)
+    with open(os.path.join(workdir, KAFKA_JSON_FILE), "wb") as journal:
         batch = 100_000
         for base in range(0, n_events, batch):
             hi = min(base + batch, n_events)
-            lines = src.events_at(start + 10 * n for n in range(base, hi))
-            journal.write("".join(l + "\n" for l in lines))
-            if sinks:
-                if len(sinks) == 1:
-                    sinks[0].append_many(lines)
-                else:
-                    for p, sink in enumerate(sinks):
-                        off = (p - base) % len(sinks)
-                        sink.append_many(lines[off::len(sinks)])
+            ts = start + 10 * _np.arange(base, hi, dtype=_np.int64)
+            blob = src.events_blob_at(ts) if blob_ok else None
+            if blob is not None:
+                journal.write(blob)
+                if sinks:
+                    sinks[0].append_bytes(blob)
+            else:
+                lines = src.events_at(
+                    start + 10 * n for n in range(base, hi))
+                journal.write(b"".join(
+                    l.encode() + b"\n" for l in lines))
+                if sinks:
+                    if len(sinks) == 1:
+                        sinks[0].append_many(lines)
+                    else:
+                        for p, sink in enumerate(sinks):
+                            off = (p - base) % len(sinks)
+                            sink.append_many(lines[off::len(sinks)])
             written = hi
             if progress:
                 progress(written)
@@ -269,31 +363,44 @@ def run_paced(sink: JournalWriter, throughput: int,
                       page_ids=make_ids(100, rng), with_skew=with_skew, rng=rng)
 
     period_ns = int(1e9 / throughput)
+    # Blob mode: native formatter renders the tick's batch as one byte
+    # block straight into the journal (no per-event Python objects) —
+    # essential when producer and engine share one core.
+    blob_ok = hasattr(sink, "append_bytes")
     start_ns = time.time_ns()
     sent = 0
-    while True:
-        if max_events is not None and sent >= max_events:
-            break
-        now_ns = time.time_ns()
-        if duration_s is not None and now_ns - start_ns >= duration_s * 1e9:
-            break
-        due = min(
-            int((now_ns - start_ns) / period_ns) + 1,
-            max_events if max_events is not None else 1 << 62,
-        )
-        if due > sent:
-            behind_ms = (now_ns - (start_ns + sent * period_ns)) / 1e6
-            if behind_ms > 100 and on_behind:
-                on_behind(behind_ms)  # "Falling behind by: N ms"
-            ts = [(start_ns + n * period_ns) // 1_000_000
-                  for n in range(sent, due)]
-            sink.append_many(src.events_at(ts))
-            # Make the batch visible to tailing consumers immediately:
-            # producer buffering must not pollute end-to-end latency.
-            sink.flush()
-            sent = due
-        else:
-            time.sleep(tick_s)
+    try:
+        while True:
+            if max_events is not None and sent >= max_events:
+                break
+            now_ns = time.time_ns()
+            if duration_s is not None and now_ns - start_ns >= duration_s * 1e9:
+                break
+            due = min(
+                int((now_ns - start_ns) / period_ns) + 1,
+                max_events if max_events is not None else 1 << 62,
+            )
+            if due > sent:
+                behind_ms = (now_ns - (start_ns + sent * period_ns)) / 1e6
+                if behind_ms > 100 and on_behind:
+                    on_behind(behind_ms)  # "Falling behind by: N ms"
+                ts = (start_ns + _np.arange(sent, due, dtype=_np.int64)
+                      * period_ns) // 1_000_000
+                blob = src.events_blob_at(ts) if blob_ok else None
+                if blob is not None:
+                    sink.append_bytes(blob)
+                else:
+                    sink.append_many(src.events_at(ts.tolist()))
+                # Make the batch visible to tailing consumers immediately:
+                # producer buffering must not pollute end-to-end latency.
+                sink.flush()
+                sent = due
+            else:
+                time.sleep(tick_s)
+    except SystemExit:
+        # STOP_LOAD's SIGTERM (stream-bench.sh:231) raised mid-loop: stop
+        # cleanly so the caller still reports/flushes the true count.
+        pass
     sink.flush()
     return sent
 

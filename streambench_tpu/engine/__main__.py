@@ -169,21 +169,15 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGTERM, lambda *_: runner.stop())
     signal.signal(signal.SIGINT, lambda *_: runner.stop())
 
-    # Pre-compile the device step on a throwaway same-shape engine before
-    # announcing readiness, so the load phase never races XLA compilation
-    # (~20-40 s on first TPU use) — the JVM engines likewise deploy their
+    # Pre-compile every device program (single step, all scan group
+    # sizes, the drain) on a throwaway same-shape engine before announcing
+    # readiness, so the load phase never races XLA compilation (~20-40 s
+    # on first TPU use; a mid-run compile also starves co-located
+    # producers on small hosts) — the JVM engines likewise deploy their
     # tasks before the harness starts the generator.
-    import random as _random
-
-    from streambench_tpu.utils.ids import now_ms
-
-    _rng = _random.Random(0)
-    src = gen.EventSource(ads=list(mapping), user_ids=gen.make_ids(4, _rng),
-                          page_ids=gen.make_ids(4, _rng), rng=_rng)
     warm = make_engine(None)
-    warm.process_lines([ln.encode("utf-8") if isinstance(ln, str) else ln
-                        for ln in src.events_at([now_ms()] * 8)])
-    warm.flush()
+    warm.warmup()
+    warm.close()
     del warm
     print(f"engine up: topic={cfg.kafka_topic} redis={cfg.redis_host}:"
           f"{cfg.redis_port} batch={engine.batch_size}", flush=True)

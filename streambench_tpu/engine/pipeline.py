@@ -76,6 +76,9 @@ class _RedisWriter:
         self._absolute = absolute
         self._tracer = tracer
         self._on_written = on_written   # (rows, stamp) latency bookkeeping
+        # window/list-UUID memo across flushes (sole-writer assumption,
+        # see write_windows_pipelined); only this thread touches it
+        self._uuid_cache: dict = {}
         self._q: queue.Queue = queue.Queue(maxsize=8)
         self._error: BaseException | None = None
         self._lock = threading.Lock()
@@ -99,7 +102,8 @@ class _RedisWriter:
                     with self._tracer.span("redis_flush"):
                         write_windows_pipelined(self._redis, rows,
                                                 time_updated=stamp,
-                                                absolute=self._absolute)
+                                                absolute=self._absolute,
+                                                cache=self._uuid_cache)
                 except BaseException as e:  # retained for reclaim/retry
                     import sys
                     print(f"redis writer: write of {len(rows)} rows "
@@ -205,7 +209,10 @@ class AdAnalyticsEngine:
         # time, when the 1 Hz cadence has let the queue drain naturally.
         self._undrained: list[tuple[jax.Array, jax.Array]] = []
         # pending Redis deltas: (campaign_idx, abs_window_ts) -> count
+        # (dict = slow path for reclaims/snapshots; _pending_np = numpy
+        # triples straight from drains, the hot path)
         self._pending: dict[tuple[int, int], int] = defaultdict(int)
+        self._pending_np: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         self.events_processed = 0
         self.windows_written = 0
         self.started_ms = now_ms()
@@ -247,6 +254,44 @@ class AdAnalyticsEngine:
     # When False, the encoder skips interning entirely (two hash probes
     # per row — the biggest per-event encode cost after tokenization).
     NEEDS_INTERNED_IDS = False
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> None:
+        """Compile every device program the ingest paths can dispatch —
+        the single-batch step, each power-of-2 scan group size (the
+        ``_fold_group`` padding buckets), and the drain — using an
+        all-invalid batch, then block until done.
+
+        Call once before measuring or serving: a cold XLA compile landing
+        mid-run stalls this process for seconds, and on a single-core
+        host it also starves every co-located process (the round-3 bench
+        saw a paced producer pushed to ~1.5k ev/s by exactly this).
+        Invalid rows are masked in every kernel, so state is semantically
+        unchanged.
+        """
+        import jax as _jax
+
+        zb = self._encode([], self.batch_size)
+        with self.tracer.span("warmup"):
+            self._device_step(zb)
+            if self.SCAN_SUPPORTED and self.scan_batches > 1:
+                sizes = []
+                k = 2
+                while k < self.scan_batches:
+                    sizes.append(k)
+                    k *= 2
+                # _fold_group caps padding at scan_batches, so the largest
+                # real shape is scan_batches itself (which is only a
+                # power of two when the config says so).
+                sizes.append(self.scan_batches)
+                for k in sizes:
+                    cols = [jnp.asarray(np.stack([getattr(zb, c)] * k))
+                            for c in self.SCAN_COLUMNS]
+                    self._device_scan(*cols)
+            self._drain_device()
+            self._materialize_drains()
+            _jax.block_until_ready(self.state)
+        self._span_start = None
 
     # ------------------------------------------------------------------
     def process_lines(self, lines: list[bytes]) -> int:
@@ -481,7 +526,15 @@ class AdAnalyticsEngine:
         self._span_start = None
 
     def _materialize_drains(self) -> None:
-        """Merge parked drain results into the host pending buffer."""
+        """Merge parked drain results into the host pending buffers.
+
+        Stays in numpy: the (campaign, window, count) triples land in
+        ``_pending_np`` as arrays (at catchup flush sizes a per-cell
+        Python dict loop costs ~1.4 us x 10^5 cells per flush).  The
+        ``_pending`` dict remains the slow-path buffer for reclaimed
+        failed writes; ``_fold_pending_arrays`` merges the two views
+        whenever dict semantics are required (snapshots).
+        """
         if not self._undrained:
             return
         base = self.encoder.base_time_ms or 0
@@ -489,13 +542,26 @@ class AdAnalyticsEngine:
             deltas = np.asarray(deltas_d)
             wids = np.asarray(wids_d)
             ci, si = np.nonzero(deltas)
-            for c, s in zip(ci.tolist(), si.tolist()):
-                wid = int(wids[s])
-                if wid < 0:
-                    continue
-                abs_ts = base + wid * self.divisor
-                self._pending[(c, abs_ts)] += int(deltas[c, s])
+            if ci.size == 0:
+                continue
+            wid = wids[si]
+            keep = wid >= 0
+            if not keep.all():
+                ci, si, wid = ci[keep], si[keep], wid[keep]
+            if ci.size:
+                self._pending_np.append(
+                    (ci.astype(np.int64),
+                     base + wid.astype(np.int64) * self.divisor,
+                     deltas[ci, si].astype(np.int64)))
         self._undrained.clear()
+
+    def _fold_pending_arrays(self) -> None:
+        """Merge ``_pending_np`` array triples into the ``_pending`` dict
+        (snapshot/restore need the dict view; never on the hot path)."""
+        for ci, ts, cnt in self._pending_np:
+            for c, t, n in zip(ci.tolist(), ts.tolist(), cnt.tolist()):
+                self._pending[(c, t)] += n
+        self._pending_np.clear()
 
     def flush(self, time_updated: int | None = None) -> int:
         """Drain device + write all pending deltas to Redis.
@@ -508,11 +574,19 @@ class AdAnalyticsEngine:
             self._drain_device()
             self._materialize_drains()
         self._reclaim_failed_writes()
-        if not self._pending:
+        if not self._pending and not self._pending_np:
             return 0
-        rows = [(self.encoder.campaigns[c], ts, n)
+        campaigns = self.encoder.campaigns
+        rows = [(campaigns[c], ts, n)
                 for (c, ts), n in self._pending.items()]
         self._pending.clear()
+        # Array triples append in drain order; duplicates across drains
+        # are fine (HINCRBY accumulates; for absolute engines the later,
+        # fresher row wins because write order is preserved).
+        for ci, ts_a, cnt in self._pending_np:
+            rows.extend(zip((campaigns[c] for c in ci.tolist()),
+                            ts_a.tolist(), cnt.tolist()))
+        self._pending_np.clear()
         if self.redis is not None:
             if self._writer is None:
                 self._writer = _RedisWriter(
@@ -568,6 +642,7 @@ class AdAnalyticsEngine:
         _pending so the snapshot carries them.  Every snapshot() override
         calls this first."""
         self._materialize_drains()
+        self._fold_pending_arrays()
         self.drain_writes()
         self._reclaim_failed_writes()
 
@@ -638,6 +713,7 @@ class AdAnalyticsEngine:
         self.started_ms = int(snap.meta["started_ms"])
         self.last_event_ms = int(snap.meta["last_event_ms"])
         self._pending = defaultdict(int)
+        self._pending_np = []
         for c, ts, n in snap.pending:
             self._pending[(int(c), int(ts))] = int(n)
         self.window_latency = {int(ts): int(v) for ts, v in snap.latency}

@@ -76,12 +76,20 @@ class FakeRedisStore:
             self._check_type(key, self._sets)
             return sorted(self._sets.get(key, set()))
 
-    def hset(self, key: str, field: str, value: str) -> int:
+    def hset(self, key: str, field: str, value: str, *more: str) -> int:
+        """HSET with the (Redis >= 4.0) multi-field form: additional
+        field/value pairs in ``more``."""
+        if len(more) % 2:
+            raise RespError("ERR wrong number of arguments for 'hset'")
         with self._lock:
             self._check_type(key, self._hashes)
             h = self._hashes.setdefault(key, {})
             new = 0 if field in h else 1
             h[field] = value
+            for i in range(0, len(more), 2):
+                if more[i] not in h:
+                    new += 1
+                h[more[i]] = more[i + 1]
             return new
 
     def hget(self, key: str, field: str) -> str | None:

@@ -62,6 +62,15 @@ class JournalWriter:
                 self._f.flush()
                 self._since_sync = 0
 
+    def append_bytes(self, data: bytes) -> None:
+        """Append a pre-rendered block of newline-terminated records in one
+        write — the zero-copy sink for the native event formatter (the
+        producer-side peer of the engine's block-mode ingest).  A distinct
+        method (not an alias semantics-wise) so sinks without block
+        support fail the caller's ``hasattr`` capability probe."""
+        if data:
+            self.append(data)
+
     def flush(self) -> None:
         with self._lock:
             self._f.flush()

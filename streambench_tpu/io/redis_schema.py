@@ -21,7 +21,8 @@ in-process) so engine code and tests share one code path.
 
 from __future__ import annotations
 
-import uuid
+import itertools
+import os
 from typing import Any, Iterable, Mapping
 
 from streambench_tpu.io.fakeredis import FakeRedisStore
@@ -34,6 +35,12 @@ class StoreAdapter:
 
     def __init__(self, store: FakeRedisStore):
         self._store = store
+        # pipeline fast path: command name -> bound store method; callers
+        # of pipeline_execute always pass str args, so the dispatch
+        # coercions are pure overhead for these
+        self._fast = {name: getattr(store, name.lower())
+                      for name in ("HGET", "HSET", "HINCRBY", "LPUSH",
+                                   "SADD", "GET", "SET")}
 
     def execute(self, *args: Any) -> Any:
         return self._store.dispatch(list(args))
@@ -41,10 +48,16 @@ class StoreAdapter:
     def pipeline_execute(self, commands: Iterable[tuple]) -> list[Any]:
         # Match RespClient semantics: per-command errors are returned
         # in-list, not raised, and never abort the rest of the batch.
+        # Hot commands bypass `dispatch` (its per-arg string coercion +
+        # name lookup is ~10x the actual dict operation; the canonical
+        # window writeback pushes 10^5+ commands per flush through here).
+        fast = self._fast
         out: list[Any] = []
         for c in commands:
             try:
-                out.append(self._store.dispatch(list(c)))
+                h = fast.get(c[0])
+                out.append(h(*c[1:]) if h is not None
+                           else self._store.dispatch(list(c)))
             except RespError as e:
                 out.append(e)
         return out
@@ -64,6 +77,23 @@ class StoreAdapter:
 
 
 RedisLike = RespClient | StoreAdapter
+
+
+# Fresh opaque keys for window/list structures.  The reference uses
+# UUID.randomUUID (AdvertisingSpark.scala:190,196) but the -g reader treats
+# them as opaque strings, so a random-prefix counter is schema-equivalent —
+# and ~6x cheaper than uuid.uuid4 (os.urandom per call), which matters at
+# catchup flush sizes (10^5 new windows per flush).  The prefix is re-drawn
+# per pid so forked workers writing one Redis can't collide.
+_ID_STATE: dict = {"pid": None}
+
+
+def _fresh_id() -> str:
+    st = _ID_STATE
+    if st["pid"] != os.getpid():
+        st.update(pid=os.getpid(), prefix=os.urandom(8).hex(),
+                  counter=itertools.count())
+    return f"{st['prefix']}-{next(st['counter']):010x}"
 
 
 def as_redis(obj: RespClient | StoreAdapter | FakeRedisStore) -> RedisLike:
@@ -111,11 +141,11 @@ def write_window(r: RedisLike, campaign: str, window_ts: int | str,
     wts = str(window_ts)
     window_uuid = r.execute("HGET", campaign, wts)
     if window_uuid is None:
-        window_uuid = str(uuid.uuid4())
+        window_uuid = _fresh_id()
         r.execute("HSET", campaign, wts, window_uuid)
         window_list_uuid = r.execute("HGET", campaign, "windows")
         if window_list_uuid is None:
-            window_list_uuid = str(uuid.uuid4())
+            window_list_uuid = _fresh_id()
             r.execute("HSET", campaign, "windows", window_list_uuid)
         r.execute("LPUSH", window_list_uuid, wts)
     r.execute("HINCRBY", window_uuid, "seen_count", int(seen_count))
@@ -126,7 +156,8 @@ def write_window(r: RedisLike, campaign: str, window_ts: int | str,
 def write_windows_pipelined(r: RedisLike,
                             entries: Iterable[tuple[str, int, int]],
                             time_updated: int | None = None,
-                            absolute: bool = False) -> int:
+                            absolute: bool = False,
+                            cache: dict | None = None) -> int:
     """Flush many ``(campaign, window_ts, count)`` rows efficiently.
 
     Same observable schema as ``write_window``, but the existence probes for
@@ -138,43 +169,112 @@ def write_windows_pipelined(r: RedisLike,
     aggregators whose flushed value is an absolute snapshot rather than a
     delta (HLL distinct estimates: re-flushing a still-open window must
     replace, not accumulate).
+
+    ``cache`` (caller-owned, initially ``{}``) memoizes window/list UUIDs
+    across flushes.  Sound whenever the caller is the only writer of these
+    campaigns — the reference makes the same assumption (each campaign's
+    windows are written by exactly one keyed CampaignProcessor instance,
+    ``AdvertisingTopology.java:232-233``).  Cuts the two existence probes
+    per already-seen row, which at catchup flush sizes (10^5 rows) is most
+    of the Redis round-trip volume.
     """
     rows = [(c, str(w), int(n)) for c, w, n in entries]
     if not rows:
         return 0
     stamp = str(now_ms() if time_updated is None else int(time_updated))
 
-    probes = r.pipeline_execute(
-        [("HGET", c, w) for c, w, _ in rows]
-        + [("HGET", c, "windows") for c, w, _ in rows]
-    )
-    win_uuids = probes[: len(rows)]
-    list_uuids = probes[len(rows):]
+    win_cache = cache.setdefault("win", {}) if cache is not None else {}
+    list_cache = cache.setdefault("list", {}) if cache is not None else {}
+    if isinstance(r, StoreAdapter):
+        # In-process store: one lock hold, no command tuples — the
+        # embedded-state-store fast path (the RESP/TCP path below stays
+        # byte-identical for real Redis).
+        _bulk_write_windows(r._store, rows, stamp, absolute,
+                            win_cache, list_cache)
+        return len(rows)
+    # Probe only rows the cache can't resolve.
+    need = [i for i, (c, w, _) in enumerate(rows)
+            if (c, w) not in win_cache]
+    if need:
+        probes = r.pipeline_execute(
+            [("HGET", rows[i][0], rows[i][1]) for i in need]
+            + [("HGET", rows[i][0], "windows") for i in need]
+        )
+        for j, i in enumerate(need):
+            c, w, _ = rows[i]
+            if probes[j]:
+                win_cache[(c, w)] = probes[j]
+            if probes[len(need) + j] and c not in list_cache:
+                list_cache[c] = probes[len(need) + j]
 
     # Assign UUIDs for missing structures; campaigns and even whole rows may
-    # repeat within one flush, so keep a local view of what we've created.
-    new_lists: dict[str, str] = {}
-    new_windows: dict[tuple[str, str], str] = {}
+    # repeat within one flush, so the cache doubles as the local view of
+    # what this call just created.
+    # Stage this call's new ids locally and commit them to the caller's
+    # cache only after the pipeline lands: caching an id whose HSET/LPUSH
+    # registration then failed would make every retry write to an orphan
+    # hash the campaign never references (permanently missing windows).
+    new_win: dict[tuple[str, str], str] = {}
+    new_list: dict[str, str] = {}
     muts: list[tuple] = []
-    for i, (campaign, wts, count) in enumerate(rows):
-        wuuid = win_uuids[i] or new_windows.get((campaign, wts))
+    for campaign, wts, count in rows:
+        wuuid = win_cache.get((campaign, wts)) or new_win.get(
+            (campaign, wts))
         if wuuid is None:
-            wuuid = str(uuid.uuid4())
-            new_windows[(campaign, wts)] = wuuid
+            wuuid = _fresh_id()
+            new_win[(campaign, wts)] = wuuid
             muts.append(("HSET", campaign, wts, wuuid))
-            luuid = list_uuids[i] or new_lists.get(campaign)
+            luuid = list_cache.get(campaign) or new_list.get(campaign)
             if luuid is None:
-                luuid = str(uuid.uuid4())
-                new_lists[campaign] = luuid
+                luuid = _fresh_id()
+                new_list[campaign] = luuid
                 muts.append(("HSET", campaign, "windows", luuid))
             muts.append(("LPUSH", luuid, wts))
         if absolute:
-            muts.append(("HSET", wuuid, "seen_count", count))
+            muts.append(("HSET", wuuid, "seen_count", str(count),
+                         "time_updated", stamp))
         else:
-            muts.append(("HINCRBY", wuuid, "seen_count", count))
-        muts.append(("HSET", wuuid, "time_updated", stamp))
+            muts.append(("HINCRBY", wuuid, "seen_count", str(count)))
+            muts.append(("HSET", wuuid, "time_updated", stamp))
     r.pipeline_execute(muts)
+    win_cache.update(new_win)
+    list_cache.update(new_list)
     return len(rows)
+
+
+def _bulk_write_windows(store: FakeRedisStore, rows, stamp: str,
+                        absolute: bool, win_cache: dict,
+                        list_cache: dict) -> None:
+    """Canonical-schema writeback directly against the in-process store's
+    dicts, one lock hold for the whole flush.  Observable state is
+    IDENTICAL to the pipelined path (same keys, same hash fields, same
+    list contents) — asserted by the schema round-trip tests."""
+    with store._lock:
+        hashes = store._hashes
+        lists = store._lists
+        for campaign, wts, count in rows:
+            wuuid = win_cache.get((campaign, wts))
+            if wuuid is None:
+                probe = hashes.get(campaign)
+                wuuid = probe.get(wts) if probe else None
+                if wuuid is None:
+                    wuuid = _fresh_id()
+                    ch = hashes.setdefault(campaign, {})
+                    ch[wts] = wuuid
+                    luuid = list_cache.get(campaign) or ch.get("windows")
+                    if luuid is None:
+                        luuid = _fresh_id()
+                        ch["windows"] = luuid
+                    list_cache[campaign] = luuid
+                    lists.setdefault(luuid, []).insert(0, wts)
+                win_cache[(campaign, wts)] = wuuid
+            wh = hashes.setdefault(wuuid, {})
+            if absolute:
+                wh["seen_count"] = str(count)
+            else:
+                wh["seen_count"] = str(int(wh.get("seen_count", "0"))
+                                       + count)
+            wh["time_updated"] = stamp
 
 
 # ----------------------------------------------------------------------

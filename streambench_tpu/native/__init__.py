@@ -58,6 +58,20 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c_p, ctypes.c_char_p, c_i64, c_i64, c_i64,
         i32p, i32p, i32p, i32p, i32p, i32p,
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(c_i64)]
+    c_i32 = ctypes.c_int32
+    lib.sb_format_events.restype = c_i64
+    lib.sb_format_events.argtypes = [
+        ctypes.c_char_p, c_i32, c_i32,          # users
+        ctypes.c_char_p, c_i32, c_i32,          # pages
+        ctypes.c_char_p, c_i32, c_i32,          # ads
+        ctypes.c_char_p, i32p, c_i32,           # ad types
+        ctypes.c_char_p, i32p, c_i32,           # event types
+        ctypes.POINTER(c_i64), c_i64,           # timestamps
+        ctypes.POINTER(ctypes.c_uint64), c_i32,  # rng state, with_skew
+        ctypes.c_char_p, c_i64]                 # out, cap
+    lib.sb_format_events_cap.restype = c_i64
+    lib.sb_format_events_cap.argtypes = [
+        c_i32, c_i32, c_i32, i32p, c_i32, i32p, c_i32]
     return lib
 
 
@@ -70,10 +84,12 @@ def load(rebuild: bool = False) -> ctypes.CDLL | None:
         if _tried and not rebuild:
             return _lib
         _tried = True
-        src = os.path.join(_HERE, "encoder.cpp")
+        srcs = [os.path.join(_HERE, "encoder.cpp"),
+                os.path.join(_HERE, "gen.cpp")]
         try:
-            if rebuild or not os.path.exists(_SO) or (
-                    os.path.getmtime(_SO) < os.path.getmtime(src)):
+            if rebuild or not os.path.exists(_SO) or any(
+                    os.path.getmtime(_SO) < os.path.getmtime(s)
+                    for s in srcs):
                 subprocess.run(["make", "-C", _HERE], check=True,
                                capture_output=True, timeout=120)
             _lib = _configure(ctypes.CDLL(_SO))

@@ -1,0 +1,153 @@
+"""Native event formatter (native/gen.cpp) + engine warmup.
+
+The formatter renders the reference wire format (``make-kafka-event-at``,
+``core.clj:163-181``) from C.  RNG streams differ from the Python path by
+design, so the contract tested here is *format* identity (field order,
+quoting, value domains) and *distribution* sanity — not byte equality.
+"""
+
+import json
+import random
+import re
+
+import numpy as np
+import pytest
+
+from streambench_tpu import native
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine import AdAnalyticsEngine
+from streambench_tpu.io.journal import FileBroker
+
+pytestmark = pytest.mark.skipif(native.load() is None,
+                                reason="native library unavailable")
+
+
+def make_source(with_skew=False, seed=7):
+    rng = random.Random(seed)
+    ads = gen.make_ids(50, rng)
+    return gen.EventSource(ads=ads, user_ids=gen.make_ids(10, rng),
+                           page_ids=gen.make_ids(10, rng),
+                           with_skew=with_skew, rng=rng), ads
+
+
+def test_blob_format_matches_python_template():
+    src, ads = make_source()
+    blob = src.events_blob_at(np.arange(100, dtype=np.int64) * 10)
+    assert blob is not None and blob.endswith(b"\n")
+    lines = blob.split(b"\n")[:-1]
+    assert len(lines) == 100
+    py = src.event_at(0).encode()
+    key_order = re.findall(rb'"(\w+)":', py)
+    for i, line in enumerate(lines):
+        assert re.findall(rb'"(\w+)":', line) == key_order
+        ev = json.loads(line)
+        assert ev["event_time"] == str(i * 10)
+        assert ev["ad_id"] in ads
+        assert ev["ip_address"] == "1.2.3.4"
+        assert ev["event_type"] in gen.EVENT_TYPES
+        assert ev["ad_type"] in gen.AD_TYPES
+
+
+def test_blob_deterministic_per_seed_and_distribution():
+    src1, _ = make_source(seed=3)
+    src2, _ = make_source(seed=3)
+    ts = np.arange(30_000, dtype=np.int64)
+    assert src1.events_blob_at(ts) == src2.events_blob_at(ts)
+    # uniform-ish event_type split (exact thirds would be suspicious too)
+    kinds = [json.loads(l)["event_type"]
+             for l in src1.events_blob_at(ts).split(b"\n")[:-1]]
+    for t in gen.EVENT_TYPES:
+        assert 0.25 < kinds.count(t) / len(kinds) < 0.42
+
+
+def test_blob_skew_semantics():
+    """±50 ms skew; ~1/100k late by up to 60 s (core.clj:166-174)."""
+    src, _ = make_source(with_skew=True)
+    base = 10_000_000
+    ts = np.full(300_000, base, dtype=np.int64)
+    stamps = [int(json.loads(l)["event_time"])
+              for l in src.events_blob_at(ts).split(b"\n")[:-1]]
+    assert max(stamps) <= base + 50
+    late = [s for s in stamps if s < base - 60]
+    assert len(late) < 30                       # ~3 expected at 1/100k
+    assert min(stamps) >= base - 50 - 60_000
+
+
+def test_blob_feeds_engine_oracle_exact(tmp_path):
+    """Native-formatted events through the real engine must count exactly
+    like the golden model (the oracle is format-blind: it replays the
+    journal, ``dostats`` ``core.clj:101-128``)."""
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.redis_schema import as_redis
+
+    r = as_redis(FakeRedisStore())
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2)
+    broker = FileBroker(str(tmp_path / "broker"))
+    n = gen.do_setup(r, cfg, broker=broker, events_num=5_000,
+                     rng=random.Random(5), workdir=str(tmp_path))
+    assert n == 5_000
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    from streambench_tpu.engine import StreamRunner
+
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    runner = StreamRunner(eng, broker.reader(cfg.kafka_topic))
+    runner.run_catchup()
+    eng.close()
+    correct, differ, missing = gen.check_correct(
+        r, workdir=str(tmp_path), log=lambda s: None,
+        time_divisor_ms=cfg.jax_time_divisor_ms)
+    assert differ == 0 and missing == 0 and correct > 0
+
+
+def test_run_paced_blob_path_counts(tmp_path):
+    broker = FileBroker(str(tmp_path / "broker"))
+    broker.create_topic("t", 1)
+    rng = random.Random(1)
+    gen.write_ids(gen.make_ids(10, rng), gen.make_ids(100, rng),
+                  str(tmp_path))
+    with broker.writer("t", 0) as sink:
+        sent = gen.run_paced(sink, 50_000, duration_s=0.5,
+                             workdir=str(tmp_path))
+    assert sent > 0
+    lines = broker.reader("t").poll(max_records=1 << 30)
+    assert len(lines) == sent
+    json.loads(lines[-1])                       # last record is complete
+
+
+def test_warmup_compiles_without_state_change():
+    cfg = default_config(jax_batch_size=128, jax_scan_batches=4)
+    rng = random.Random(2)
+    ads = gen.make_ids(20, rng)
+    mapping = {a: f"c{i % 4}" for i, a in enumerate(ads)}
+    eng = AdAnalyticsEngine(cfg, mapping)
+    eng.warmup()
+    assert eng.events_processed == 0
+    assert not eng._pending
+    assert int(np.asarray(eng.state.counts).sum()) == 0
+    # engine still counts correctly after warmup
+    src = gen.EventSource(ads=ads, user_ids=gen.make_ids(4, rng),
+                          page_ids=gen.make_ids(4, rng), rng=rng)
+    lines = [l.encode() for l in src.events_at([50_000] * 300)]
+    views = sum(1 for l in lines if b'"view"' in l)
+    assert views > 0
+    eng.process_chunk(lines)
+    eng.flush()
+    assert eng.events_processed == 300
+    assert eng.dropped == 0
+    assert eng.windows_written >= 1
+    assert sum(eng.latency_tracker.counts.values()
+               if hasattr(eng.latency_tracker, "counts") else [1]) >= 1
+
+
+def test_sketch_engine_warmup():
+    from streambench_tpu.engine.sketches import HLLDistinctEngine
+
+    cfg = default_config(jax_batch_size=64, jax_scan_batches=2)
+    rng = random.Random(4)
+    ads = gen.make_ids(10, rng)
+    mapping = {a: f"c{i % 2}" for i, a in enumerate(ads)}
+    eng = HLLDistinctEngine(cfg, mapping)
+    eng.warmup()
+    assert eng.events_processed == 0
