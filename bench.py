@@ -188,21 +188,20 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
     # (a reused topic would replay the previous run's journal from offset
     # 0 and poison both the throughput and the latency stamps).
     topic = f"{cfg.kafka_topic}-paced-{run_id}-{rate}"
-    # One Python generator tops out around ~180k ev/s; shard the load
-    # across producer processes + partitions so the sweep probes the
-    # ENGINE's ceiling, not the generator's (the reference scales load
-    # the same way: kafka.partitions + parallel producers).
-    n_prod = max(1, -(-rate // 140_000))
+    # Shard the load across producer processes + partitions so the sweep
+    # probes the ENGINE's ceiling, not the generator's (the reference
+    # scales load the same way: kafka.partitions + parallel producers).
+    # With the native formatter one producer sustains ~500k ev/s, and on
+    # small hosts every extra process is contention — so split late.
+    n_prod = max(1, -(-rate // 400_000))
     broker.create_topic(topic, n_prod)
-    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
-    reader = (broker.multi_reader(topic) if n_prod > 1
-              else broker.reader(topic))
-    runner = StreamRunner(engine, reader)
 
     # Producers run as their OWN processes (the reference's generator is a
     # separate JVM, stream-bench.sh:229): in-process they contend with the
     # engine for the GIL and the measured "unsustained" rate would be the
-    # producer's starvation, not the engine's limit.
+    # producer's starvation, not the engine's limit.  They launch FIRST so
+    # their interpreter startup (~3 s, longer on a loaded host) overlaps
+    # engine construction instead of eating into the idle-exit budget.
     from streambench_tpu.config import write_local_conf
 
     conf_path = os.path.join(workdir, f"paced-{run_id}-{rate}.yaml")
@@ -222,10 +221,18 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
                 stdout=logf, stderr=subprocess.STDOUT,
                 cwd=os.path.dirname(os.path.abspath(__file__)))))
 
+    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+    reader = (broker.multi_reader(topic) if n_prod > 1
+              else broker.reader(topic))
+    runner = StreamRunner(engine, reader)
+
     sent = {}
     behind = {"n": 0, "max_ms": 0.0}
     t0 = time.monotonic()
-    runner.run(duration_s=duration_s + 5.0, idle_timeout_s=5.0)
+    # idle_timeout covers producer hiccups only; 15 s tolerates a slow
+    # producer start on a loaded single-core host without masking a real
+    # mid-run stall (the run is bounded by duration_s regardless).
+    runner.run(duration_s=duration_s + 5.0, idle_timeout_s=15.0)
     # Reap EVERY producer before judging any of them — raising on the
     # first bad one would orphan the rest, which then keep emitting into
     # the next sweep rung's measurement window.
@@ -247,13 +254,22 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
             with open(prod_log, "r", errors="replace") as f:
                 failures.append(
                     f"rc={proc.returncode}: {f.read()[-400:]}")
-            continue
+    formatters: set[str] = set()
+    for prod_log, proc in procs:
         with open(prod_log, "r", errors="replace") as f:
             for line in f:
                 if line.startswith("emitted "):
                     sent["n"] = sent.get("n", 0) + int(line.split()[1])
                 elif line.startswith("Falling behind"):
                     behind["n"] += 1
+                    behind["max_ms"] = max(
+                        behind["max_ms"], float(line.split()[-1][:-2]))
+                elif line.startswith("formatter: "):
+                    formatters.add(line.split()[-1])
+    # ONE degraded (pure-Python, ~60x slower) producer is enough to
+    # poison a rung's latencies — report the slowest path seen.
+    formatter = ("python" if "python" in formatters
+                 else ("native" if formatters else None))
     if failures:
         raise RuntimeError(
             f"{len(failures)} paced producer(s) failed: {failures[0]}")
@@ -267,10 +283,13 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
         "processed": runner.stats.events,
         "wall_s": round(wall, 1), "windows": len(lats),
         "generator_behind_events": behind["n"],
+        "generator_behind_max_ms": behind["max_ms"],
+        "generator_formatter": formatter,
     }
     log(f"paced phase: rate={rate}/s sent={sent.get('n')} "
         f"processed={runner.stats.events} wall={wall:.1f}s "
-        f"unique_windows={len(lats)} behind={behind['n']}")
+        f"unique_windows={len(lats)} behind={behind['n']} "
+        f"behind_max={behind['max_ms']:.0f}ms formatter={formatter}")
     if not lats:
         log("paced phase: no windows written — latency unavailable")
         return out
@@ -367,7 +386,25 @@ def main() -> int:
                          jax_scan_batches=scan_batches,
                          jax_batch_size=batch_size)
 
-    with tempfile.TemporaryDirectory() as wd:
+    # RAM-backed workdir when available: the file broker is the in-process
+    # Kafka analog, and on a disk-backed /tmp the paced producers' write()
+    # calls can block for SECONDS under dirty-page writeback throttling
+    # (observed as multi-second producer stalls right after the 500 MB
+    # catchup journal was written) — which would be charged to the engine
+    # as window latency.  Only if tmpfs can hold the run: ~250 B/event x
+    # (journal + topic copy) + the paced rungs' topics, with headroom.
+    tmp_base = None
+    need_bytes = n_events * 250 * 2 + 10 * (1 << 30)
+    try:
+        sv = os.statvfs("/dev/shm")
+        if sv.f_bavail * sv.f_frsize >= need_bytes:
+            tmp_base = "/dev/shm"
+        else:
+            log("tmpfs too small for the dataset; workdir stays on disk "
+                "(paced latencies may include writeback stalls)")
+    except OSError:
+        pass
+    with tempfile.TemporaryDirectory(dir=tmp_base) as wd:
         r = as_redis(FakeRedisStore())
         broker = FileBroker(os.path.join(wd, "broker"))
         t0 = time.monotonic()

@@ -367,6 +367,7 @@ def run_paced(sink: JournalWriter, throughput: int,
     # block straight into the journal (no per-event Python objects) —
     # essential when producer and engine share one core.
     blob_ok = hasattr(sink, "append_bytes")
+    native_checked = False
     start_ns = time.time_ns()
     sent = 0
     try:
@@ -380,6 +381,11 @@ def run_paced(sink: JournalWriter, throughput: int,
                 int((now_ns - start_ns) / period_ns) + 1,
                 max_events if max_events is not None else 1 << 62,
             )
+            # Cap one iteration's emission at 1 s of schedule: after a
+            # long scheduler stall the backlog must drain in chunks so the
+            # duration/SIGTERM checks keep running (an uncapped burst once
+            # held a producer 17 s past its deadline inside one emit).
+            due = min(due, sent + throughput)
             if due > sent:
                 behind_ms = (now_ns - (start_ns + sent * period_ns)) / 1e6
                 if behind_ms > 100 and on_behind:
@@ -391,6 +397,14 @@ def run_paced(sink: JournalWriter, throughput: int,
                     sink.append_bytes(blob)
                 else:
                     sink.append_many(src.events_at(ts.tolist()))
+                if not native_checked:
+                    # One-shot path report: a silently degraded (pure
+                    # Python, ~60x slower) producer is indistinguishable
+                    # from an engine problem in the sweep's numbers.
+                    native_checked = True
+                    print(f"formatter: "
+                          f"{'native' if blob is not None else 'python'}",
+                          flush=True)
                 # Make the batch visible to tailing consumers immediately:
                 # producer buffering must not pollute end-to-end latency.
                 sink.flush()
@@ -401,6 +415,9 @@ def run_paced(sink: JournalWriter, throughput: int,
         # STOP_LOAD's SIGTERM (stream-bench.sh:231) raised mid-loop: stop
         # cleanly so the caller still reports/flushes the true count.
         pass
+    final_behind = (time.time_ns() - (start_ns + sent * period_ns)) / 1e6
+    if on_behind is not None and final_behind > 100:
+        on_behind(final_behind)
     sink.flush()
     return sent
 

@@ -563,6 +563,14 @@ class AdAnalyticsEngine:
                 self._pending[(c, t)] += n
         self._pending_np.clear()
 
+    def pending_counts(self) -> dict[tuple[int, int], int]:
+        """Materialized-but-unflushed deltas as one dict view —
+        ``(campaign_idx, abs_window_ts) -> count`` — folding the numpy
+        drain triples in.  The supported inspection surface for tests
+        and diagnostics (``_pending`` alone misses parked arrays)."""
+        self._fold_pending_arrays()
+        return dict(self._pending)
+
     def flush(self, time_updated: int | None = None) -> int:
         """Drain device + write all pending deltas to Redis.
 

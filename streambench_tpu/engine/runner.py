@@ -172,9 +172,11 @@ class StreamRunner:
                 data = self.reader.poll_block(budget)
                 got = data.count(b"\n") if data else 0
                 # records can be longer than the estimate, so judge
-                # backlog by BYTES: a read that nearly filled its budget
-                # means more data is waiting
-                full_read = len(data) >= budget - est_bytes
+                # backlog by BYTES: a NON-EMPTY read that nearly filled
+                # its budget means more data is waiting (an empty read
+                # must never count as full, or a tiny budget at room==1
+                # would busy-spin on an idle stream)
+                full_read = got > 0 and len(data) >= budget - est_bytes
                 if got:
                     pending.append(data)
             else:
@@ -206,8 +208,14 @@ class StreamRunner:
                          (now - pending_since) * 1000 >= self.buffer_timeout_ms)
             if pending_n >= target or (pending and batch_old):
                 dispatch()
-            elif not got:
-                time.sleep(0.001)  # nothing due and nothing new: yield
+            elif not full_read:
+                # Nothing due and no backlog (the read didn't fill its
+                # budget): yield.  Without this the loop busy-spins once
+                # the stream is fast enough that every poll returns a few
+                # KB — 100% of a core burned on re-polls, starving
+                # co-located producers (latency cost is bounded by
+                # buffer_timeout regardless).
+                time.sleep(0.001)
 
             if (now - last_flush) * 1000 >= self.flush_interval_ms:
                 if self._checkpoint_due(now) and pending:

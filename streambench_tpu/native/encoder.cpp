@@ -98,6 +98,10 @@ struct Encoder {
   int64_t divisor_ms = 10000;
   int64_t lateness_ms = 60000;
   int32_t unknown_ad = 0;
+  // Adaptive value-length hints for the skeleton fast path (ids are
+  // fixed-width UUIDs in practice; learned from the first line so other
+  // id shapes still get the one-probe hit path).
+  size_t hint_user = 36, hint_page = 36, hint_ad = 36;
 };
 
 // token positions when splitting the generator's line on '"':
@@ -138,13 +142,112 @@ inline int32_t event_type_code(const Tok& t) {
 
 namespace {
 
+// --- skeleton fast path -------------------------------------------------
+// The generator renders one fixed skeleton (gen.cpp / EventSource.event_at):
+//   {"user_id": "U", "page_id": "P", ..., "event_time": "T", ...
+// so instead of tokenizing on quotes we memcmp the literal skeleton and
+// probe each value's closing quote at its learned length (one branch per
+// value instead of a memchr).  Any mismatch falls back to the quote-token
+// parser below, which tolerates arbitrary spacing.
+
+inline bool skel(const char*& p, const char* end, const char* lit,
+                 size_t n) {
+  if (static_cast<size_t>(end - p) < n || std::memcmp(p, lit, n) != 0)
+    return false;
+  p += n;
+  return true;
+}
+
+inline bool skel_value(const char*& p, const char* end, size_t& hint,
+                       Tok& out) {
+  if (hint > 0 && p + hint < end && p[hint] == '"') {
+    out.p = p;
+    out.len = hint;
+    p += hint + 1;
+    return true;
+  }
+  const char* q = static_cast<const char*>(
+      std::memchr(p, '"', static_cast<size_t>(end - p)));
+  if (q == nullptr) return false;
+  out.p = p;
+  out.len = static_cast<size_t>(q - p);
+  hint = out.len;
+  p = q + 1;
+  return true;
+}
+
+// Returns 1 on success (row i filled, status 1); 0 = not this layout
+// (caller tries the tolerant parser; nothing written).
+inline int parse_skeleton(Encoder* enc, const char* p, const char* end,
+                          int64_t i, int32_t* ad_idx, int32_t* etype,
+                          int32_t* etime, int32_t* user_idx,
+                          int32_t* page_idx, int32_t* ad_type,
+                          uint8_t* status) {
+  Tok user, page, ad, at, et;
+  if (!skel(p, end, "{\"user_id\": \"", 13) ||
+      !skel_value(p, end, enc->hint_user, user) ||
+      !skel(p, end, ", \"page_id\": \"", 14) ||
+      !skel_value(p, end, enc->hint_page, page) ||
+      !skel(p, end, ", \"ad_id\": \"", 12) ||
+      !skel_value(p, end, enc->hint_ad, ad))
+    return 0;
+  if (!skel(p, end, ", \"ad_type\": \"", 14)) return 0;
+  {  // ad_type: one of 5 known strings; probe their lengths directly
+    const char* q = static_cast<const char*>(
+        std::memchr(p, '"', static_cast<size_t>(end - p)));
+    if (q == nullptr) return 0;
+    at.p = p;
+    at.len = static_cast<size_t>(q - p);
+    p = q + 1;
+  }
+  if (!skel(p, end, ", \"event_type\": \"", 17)) return 0;
+  {
+    const char* q = static_cast<const char*>(
+        std::memchr(p, '"', static_cast<size_t>(end - p)));
+    if (q == nullptr) return 0;
+    et.p = p;
+    et.len = static_cast<size_t>(q - p);
+    p = q + 1;
+  }
+  if (!skel(p, end, ", \"event_time\": \"", 17)) return 0;
+  int64_t t = 0;
+  size_t nd = 0;
+  while (p + nd < end && nd <= 15) {
+    char c = p[nd];
+    if (c == '"') break;
+    if (c < '0' || c > '9') return 0;
+    t = t * 10 + (c - '0');
+    ++nd;
+  }
+  if (nd == 0 || p + nd >= end || p[nd] != '"') return 0;
+
+  if (enc->base_time_ms == kBaseUnset) {
+    enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
+  }
+  auto ad_it = enc->ad_index.find(std::string_view(ad.p, ad.len));
+  ad_idx[i] = ad_it == enc->ad_index.end() ? enc->unknown_ad
+                                           : ad_it->second;
+  etype[i] = event_type_code(et);
+  etime[i] = static_cast<int32_t>(t - enc->base_time_ms);
+  if (enc->intern_ids) {
+    user_idx[i] = enc->users.intern(user.p, user.len);
+    page_idx[i] = enc->pages.intern(page.p, page.len);
+  } else {
+    user_idx[i] = 0;
+    page_idx[i] = 0;
+  }
+  ad_type[i] = ad_type_code(at);
+  status[i] = 1;
+  return 1;
+}
+
 // Parse one wire-format line [p, end) into row i of the column buffers.
 // status[i]: 1 = parsed, 2 = layout mismatch (python fallback), 0 = bad.
 // Returns 1 on success, 0 otherwise.
-inline int parse_one(Encoder* enc, const char* p, const char* end,
-                     int64_t i, int32_t* ad_idx, int32_t* etype,
-                     int32_t* etime, int32_t* user_idx, int32_t* page_idx,
-                     int32_t* ad_type, uint8_t* status) {
+inline int parse_tokens(Encoder* enc, const char* p, const char* end,
+                        int64_t i, int32_t* ad_idx, int32_t* etype,
+                        int32_t* etime, int32_t* user_idx, int32_t* page_idx,
+                        int32_t* ad_type, uint8_t* status) {
   // split on '"' into the first 24 tokens (memchr: SIMD-accelerated)
   Tok toks[24];
   int nt = 0;
@@ -199,6 +302,17 @@ inline int parse_one(Encoder* enc, const char* p, const char* end,
   ad_type[i] = ad_type_code(toks[15]);
   status[i] = 1;
   return 1;
+}
+
+inline int parse_one(Encoder* enc, const char* p, const char* end,
+                     int64_t i, int32_t* ad_idx, int32_t* etype,
+                     int32_t* etime, int32_t* user_idx, int32_t* page_idx,
+                     int32_t* ad_type, uint8_t* status) {
+  if (parse_skeleton(enc, p, end, i, ad_idx, etype, etime, user_idx,
+                     page_idx, ad_type, status))
+    return 1;
+  return parse_tokens(enc, p, end, i, ad_idx, etype, etime, user_idx,
+                      page_idx, ad_type, status);
 }
 
 }  // namespace

@@ -33,10 +33,12 @@ def make_lines(n, seed=0, start=1_700_000_000_000, spacing_ms=10):
 
 
 def drained_pending(eng):
-    """Drain + materialize WITHOUT flushing (flush clears _pending)."""
+    """Drain + materialize WITHOUT flushing (flush clears the pending
+    buffers); pending_counts folds the numpy drain triples into the
+    dict view."""
     eng._drain_device()
     eng._materialize_drains()
-    return dict(eng._pending)
+    return eng.pending_counts()
 
 
 def run_engine(lines, mapping, campaigns, *, chunked, slots=16,
