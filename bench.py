@@ -362,7 +362,6 @@ def main() -> int:
     # measured regime uniform) and K batches folded per dispatch.
     window_slots = int(os.environ.get("STREAMBENCH_BENCH_WINDOW_SLOTS",
                                       "2048"))
-    scan_batches = int(os.environ.get("STREAMBENCH_BENCH_SCAN_BATCHES", "8"))
     batch_size = int(os.environ.get("STREAMBENCH_BENCH_BATCH", "8192"))
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -370,6 +369,14 @@ def main() -> int:
 
     platform = resolve_platform()
     pin_jax_platform(platform)
+
+    # Deeper scan on accelerators: each dispatch crosses the (possibly
+    # tunneled) runtime once, so fold more batches per call where that
+    # round trip is the expensive part; on CPU the extra stacking buys
+    # nothing.
+    scan_default = "8" if platform == "cpu" else "16"
+    scan_batches = int(os.environ.get("STREAMBENCH_BENCH_SCAN_BATCHES",
+                                      scan_default))
 
     import jax
 

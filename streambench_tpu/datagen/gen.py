@@ -367,7 +367,7 @@ def run_paced(sink: JournalWriter, throughput: int,
     # block straight into the journal (no per-event Python objects) —
     # essential when producer and engine share one core.
     blob_ok = hasattr(sink, "append_bytes")
-    native_checked = False
+    last_path = None
     start_ns = time.time_ns()
     sent = 0
     try:
@@ -397,14 +397,14 @@ def run_paced(sink: JournalWriter, throughput: int,
                     sink.append_bytes(blob)
                 else:
                     sink.append_many(src.events_at(ts.tolist()))
-                if not native_checked:
-                    # One-shot path report: a silently degraded (pure
-                    # Python, ~60x slower) producer is indistinguishable
-                    # from an engine problem in the sweep's numbers.
-                    native_checked = True
-                    print(f"formatter: "
-                          f"{'native' if blob is not None else 'python'}",
-                          flush=True)
+                path_now = "native" if blob is not None else "python"
+                if path_now != last_path:
+                    # Report every path CHANGE, not just the first batch:
+                    # a mid-run fallback to the ~60x slower Python
+                    # formatter would otherwise be indistinguishable from
+                    # an engine problem in the sweep's numbers.
+                    last_path = path_now
+                    print(f"formatter: {path_now}", flush=True)
                 # Make the batch visible to tailing consumers immediately:
                 # producer buffering must not pollute end-to-end latency.
                 sink.flush()

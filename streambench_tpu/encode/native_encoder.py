@@ -256,6 +256,10 @@ class NativeEventEncoder(EventEncoder):
                 self._enc,
                 t - (t % self.divisor_ms) - self.lateness_ms)
         base = self._lib.sb_encoder_base_time(self._enc)
+        if not (-2**31 <= t - base < 2**31):
+            # rebased time must fit the int32 column; an absurd timestamp
+            # (clock garbage, fuzzed input) is a bad line, not a crash
+            return None
         ad = str(ev.get("ad_id", "")).encode()
         u = str(ev.get("user_id", "")).encode()
         p = str(ev.get("page_id", "")).encode()

@@ -191,28 +191,19 @@ inline int parse_skeleton(Encoder* enc, const char* p, const char* end,
       !skel(p, end, ", \"ad_id\": \"", 12) ||
       !skel_value(p, end, enc->hint_ad, ad))
     return 0;
-  if (!skel(p, end, ", \"ad_type\": \"", 14)) return 0;
-  {  // ad_type: one of 5 known strings; probe their lengths directly
-    const char* q = static_cast<const char*>(
-        std::memchr(p, '"', static_cast<size_t>(end - p)));
-    if (q == nullptr) return 0;
-    at.p = p;
-    at.len = static_cast<size_t>(q - p);
-    p = q + 1;
-  }
-  if (!skel(p, end, ", \"event_type\": \"", 17)) return 0;
-  {
-    const char* q = static_cast<const char*>(
-        std::memchr(p, '"', static_cast<size_t>(end - p)));
-    if (q == nullptr) return 0;
-    et.p = p;
-    et.len = static_cast<size_t>(q - p);
-    p = q + 1;
-  }
+  // type values vary per event, so no stable length hint: the throwaway
+  // hint makes skel_value a plain closing-quote memchr
+  size_t no_hint = 0;
+  if (!skel(p, end, ", \"ad_type\": \"", 14) ||
+      !skel_value(p, end, (no_hint = 0), at))
+    return 0;
+  if (!skel(p, end, ", \"event_type\": \"", 17) ||
+      !skel_value(p, end, (no_hint = 0), et))
+    return 0;
   if (!skel(p, end, ", \"event_time\": \"", 17)) return 0;
   int64_t t = 0;
   size_t nd = 0;
-  while (p + nd < end && nd <= 15) {
+  while (p + nd < end && nd < 15) {  // same 15-digit cap as parse_tokens
     char c = p[nd];
     if (c == '"') break;
     if (c < '0' || c > '9') return 0;
@@ -220,6 +211,11 @@ inline int parse_skeleton(Encoder* enc, const char* p, const char* end,
     ++nd;
   }
   if (nd == 0 || p + nd >= end || p[nd] != '"') return 0;
+  p += nd + 1;
+  // Tail check: a truncated record must fall through to the tolerant
+  // parser (whose 24-token requirement rejects it to the Python
+  // fallback), not be silently accepted as a valid event.
+  if (!skel(p, end, ", \"ip_address\"", 14)) return 0;
 
   if (enc->base_time_ms == kBaseUnset) {
     enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
