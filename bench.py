@@ -317,7 +317,7 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
     on a precomputed guess.  A rate counts as sustained when the engine
     consumed everything sent and p99 unique-window latency is within
     the SLA."""
-    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.fakeredis import make_store
     from streambench_tpu.io.redis_schema import as_redis
 
     results = []
@@ -325,7 +325,7 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
     rate = start_rate
     for run_id in range(max_runs):
         res = _paced_latency_phase(cfg, mapping, broker,
-                                   as_redis(FakeRedisStore()), workdir,
+                                   as_redis(make_store()), workdir,
                                    rate, duration_s, run_id=run_id)
         results.append(res)
         p99 = res.get("p99_ms")
@@ -383,7 +383,7 @@ def main() -> int:
     from streambench_tpu.config import default_config
     from streambench_tpu.datagen import gen
     from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
-    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.fakeredis import make_store
     from streambench_tpu.io.journal import FileBroker
     from streambench_tpu.io.redis_schema import as_redis
 
@@ -412,7 +412,7 @@ def main() -> int:
     except OSError:
         pass
     with tempfile.TemporaryDirectory(dir=tmp_base) as wd:
-        r = as_redis(FakeRedisStore())
+        r = as_redis(make_store())
         broker = FileBroker(os.path.join(wd, "broker"))
         t0 = time.monotonic()
         gen.do_setup(r, cfg, broker=broker, events_num=n_events,

@@ -29,7 +29,7 @@ from streambench_tpu.config import ConfigError, find_and_read_config_file
 from streambench_tpu.datagen import gen
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine
 from streambench_tpu.engine.runner import StreamRunner
-from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.fakeredis import make_store
 from streambench_tpu.io.kafka import make_broker
 from streambench_tpu.io.redis_schema import as_redis
 from streambench_tpu.io.resp import RespClient
@@ -94,7 +94,7 @@ def main(argv: list[str] | None = None) -> int:
 
     mapping, campaigns = load_mapping(cfg, args.workdir)
     if cfg.redis_host == ":inprocess:":
-        redis = as_redis(FakeRedisStore())
+        redis = as_redis(make_store())
     else:
         redis = RespClient(cfg.redis_host, cfg.redis_port)
 

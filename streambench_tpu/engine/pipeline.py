@@ -58,6 +58,30 @@ def default_method(num_campaigns: int | None = None) -> str:
     return "matmul"
 
 
+class _ArrayRows:
+    """A flush batch as numpy columns — (campaign_idx, abs_window_ts,
+    count) — plus the campaign-name table needed to write or recover
+    them.  ``table`` is ``(names_blob, names_off, native_store)``."""
+
+    __slots__ = ("ci", "ts", "cnt", "table", "campaigns")
+
+    def __init__(self, ci, ts, cnt, table, campaigns):
+        self.ci, self.ts, self.cnt = ci, ts, cnt
+        self.table = table
+        self.campaigns = campaigns
+
+    def __len__(self) -> int:
+        return int(self.ci.shape[0])
+
+    def to_rows(self) -> list:
+        """Expand to (campaign, ts, count) rows (failure/reclaim path
+        only — the success path never leaves numpy)."""
+        names = self.campaigns
+        return [(names[c], int(t), int(n))
+                for c, t, n in zip(self.ci.tolist(), self.ts.tolist(),
+                                   self.cnt.tolist())]
+
+
 class _RedisWriter:
     """Background window-writeback thread.
 
@@ -96,16 +120,27 @@ class _RedisWriter:
             try:
                 if item is None:
                     return
-                rows, stamp = item
+                payload, stamp = item
                 stamp = now_ms() if stamp is None else stamp
+                arrays = not isinstance(payload, list)
                 try:
                     with self._tracer.span("redis_flush"):
-                        write_windows_pipelined(self._redis, rows,
-                                                time_updated=stamp,
-                                                absolute=self._absolute,
-                                                cache=self._uuid_cache)
+                        if arrays:
+                            # (ci, ts, cnt) numpy triple against the
+                            # native store: campaign table passed once,
+                            # zero per-row Python work
+                            blob, off, store = payload.table
+                            store.write_windows_arrays(
+                                blob, off, payload.ci, payload.ts,
+                                payload.cnt, str(stamp), self._absolute)
+                        else:
+                            write_windows_pipelined(
+                                self._redis, payload, time_updated=stamp,
+                                absolute=self._absolute,
+                                cache=self._uuid_cache)
                 except BaseException as e:  # retained for reclaim/retry
                     import sys
+                    rows = (payload.to_rows() if arrays else payload)
                     print(f"redis writer: write of {len(rows)} rows "
                           f"failed ({e!r}); retained for retry",
                           file=sys.stderr, flush=True)
@@ -114,7 +149,7 @@ class _RedisWriter:
                         self._error = e
                 else:
                     # latency bookkeeping only for rows that actually landed
-                    self._on_written(rows, stamp)
+                    self._on_written(payload, stamp)
             finally:
                 self._q.task_done()
 
@@ -213,6 +248,9 @@ class AdAnalyticsEngine:
         # triples straight from drains, the hot path)
         self._pending: dict[tuple[int, int], int] = defaultdict(int)
         self._pending_np: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        # campaign-name table for the native store's index-form bulk
+        # writeback; False = not yet resolved (resolution needs redis)
+        self._camp_table = False
         self.events_processed = 0
         self.windows_written = 0
         self.started_ms = now_ms()
@@ -588,31 +626,74 @@ class AdAnalyticsEngine:
         rows = [(campaigns[c], ts, n)
                 for (c, ts), n in self._pending.items()]
         self._pending.clear()
-        # Array triples append in drain order; duplicates across drains
-        # are fine (HINCRBY accumulates; for absolute engines the later,
-        # fresher row wins because write order is preserved).
-        for ci, ts_a, cnt in self._pending_np:
-            rows.extend(zip((campaigns[c] for c in ci.tolist()),
-                            ts_a.tolist(), cnt.tolist()))
+        # Drain triples stay numpy end-to-end when the sink is the native
+        # store; otherwise they expand to rows here.  Duplicates across
+        # drains are fine (HINCRBY accumulates; for absolute engines the
+        # later, fresher value wins because write order is preserved —
+        # rows, i.e. stale reclaims, are always submitted first).
+        arrays = None
+        table = self._native_table()
+        if table is not None and self._pending_np:
+            tri = self._pending_np
+            ci = (tri[0][0] if len(tri) == 1
+                  else np.concatenate([t[0] for t in tri]))
+            ts_a = (tri[0][1] if len(tri) == 1
+                    else np.concatenate([t[1] for t in tri]))
+            cnt = (tri[0][2] if len(tri) == 1
+                   else np.concatenate([t[2] for t in tri]))
+            arrays = _ArrayRows(ci.astype(np.int32), ts_a, cnt, table,
+                                campaigns)
+        else:
+            for ci, ts_a, cnt in self._pending_np:
+                rows.extend(zip((campaigns[c] for c in ci.tolist()),
+                                ts_a.tolist(), cnt.tolist()))
         self._pending_np.clear()
+        total = len(rows) + (len(arrays) if arrays is not None else 0)
         if self.redis is not None:
             if self._writer is None:
                 self._writer = _RedisWriter(
                     self.redis, self.absolute_counts, self.tracer,
                     self._note_written)
-            self._writer.submit(rows, time_updated)
+            if rows:
+                self._writer.submit(rows, time_updated)
+            if arrays is not None:
+                self._writer.submit(arrays, time_updated)
         else:
-            self._note_written(rows,
-                              now_ms() if time_updated is None
-                              else time_updated)
-        return len(rows)
+            stamp = now_ms() if time_updated is None else time_updated
+            if rows:
+                self._note_written(rows, stamp)
+            if arrays is not None:
+                self._note_written(arrays, stamp)
+        return total
 
-    def _note_written(self, rows, stamp: int) -> None:
+    def _native_table(self):
+        """(names_blob, names_off, native_store) when the sink is the
+        in-process native store, else None; built once."""
+        if self._camp_table is False:
+            tbl = None
+            store = getattr(self.redis, "_store", None)
+            if store is not None and hasattr(store,
+                                             "write_windows_arrays"):
+                names = [c.encode() for c in self.encoder.campaigns]
+                off = np.zeros(len(names) + 1, np.int64)
+                np.cumsum([len(b) for b in names], out=off[1:])
+                tbl = (b"".join(names), off, store)
+            self._camp_table = tbl
+        return self._camp_table
+
+    def _note_written(self, payload, stamp: int) -> None:
         """Latency + write-count bookkeeping at actual write time (writer
         thread) — counting at submit time would double-count rows that
         fail, get reclaimed, and are retried."""
-        self.windows_written += len(rows)
-        for camp, ts, _ in rows:
+        if isinstance(payload, _ArrayRows):
+            self.windows_written += len(payload)
+            for t in np.unique(payload.ts).tolist():
+                self.window_latency[int(t)] = stamp - int(t)
+            self.latency_tracker.record_bulk(
+                payload.ci, payload.ts, stamp, payload.campaigns)
+            return
+        self.windows_written += len(payload)
+        for camp, ts, _ in payload:
             self.window_latency[ts] = stamp - ts
             self.latency_tracker.record(camp, ts, stamp)
 

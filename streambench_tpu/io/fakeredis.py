@@ -19,6 +19,7 @@ return a RESP error, like real Redis.
 
 from __future__ import annotations
 
+import ctypes
 import socketserver
 import threading
 from typing import Any
@@ -183,6 +184,230 @@ class FakeRedisStore:
             raise RespError(f"ERR wrong number of arguments: {e}")
 
 
+def _parse_resp(buf: bytes, pos: int = 0):
+    """Parse ONE RESP2 reply from ``buf[pos:]`` -> (value, next_pos).
+
+    Deliberately NOT ``resp._Reader``: the in-process store needs str
+    values (``_Reader`` yields bulk strings as bytes, matching the socket
+    client's contract) and errors as VALUES so pipeline callers can keep
+    them in-list instead of aborting (``RespClient.pipeline_execute``
+    semantics); a byte-for-byte reuse would need a transform layer larger
+    than this parser.  Covers the same RESP2 shapes _Reader does,
+    including nil bulk ($-1) and null array (*-1).
+    """
+    kind = buf[pos:pos + 1]
+    end = buf.index(b"\r\n", pos)
+    head = buf[pos + 1:end]
+    pos = end + 2
+    if kind == b"+":
+        return head.decode(), pos
+    if kind == b"-":
+        return RespError(head.decode()), pos
+    if kind == b":":
+        return int(head), pos
+    if kind == b"$":
+        n = int(head)
+        if n < 0:
+            return None, pos
+        val = buf[pos:pos + n].decode("utf-8")
+        return val, pos + n + 2
+    if kind == b"*":
+        n = int(head)
+        if n < 0:
+            return None, pos
+        out = []
+        for _ in range(n):
+            v, pos = _parse_resp(buf, pos)
+            out.append(v)
+        return out, pos
+    raise ValueError(f"bad RESP reply at {pos}: {buf[pos:pos+16]!r}")
+
+
+class NativeRedisStore(FakeRedisStore):
+    """The same store, implemented in C (native/store.cpp).
+
+    Same command surface and RESP reply shapes as the Python
+    implementation (differential-tested), plus ``write_windows_bulk`` —
+    the canonical window writeback executed natively at ~100 ns/row,
+    which removes the largest remaining host cost in the catchup
+    pipeline.  Subclasses ``FakeRedisStore`` so every isinstance check,
+    adapter, and the RESP TCP server work unchanged; the Python dict
+    state of the base class is simply never used.
+    """
+
+    def __init__(self, lib) -> None:
+        # deliberately NOT calling super().__init__: state lives in C
+        self._lib = lib
+        self._h = lib.sbr_new()
+        self._buf = ctypes.create_string_buffer(1 << 16)
+        # The reply buffer is shared across calls; the TCP server runs
+        # one handler thread per client, so command execution + reply
+        # extraction must be atomic (the C store has its own mutex, but
+        # that doesn't protect this Python-side buffer).
+        self._cmd_lock = threading.Lock()
+
+    def __del__(self):  # pragma: no cover - teardown order
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.sbr_free(h)
+            self._h = None
+
+    def _cmd(self, *args):
+        argv = (ctypes.c_char_p * len(args))()
+        lens = (ctypes.c_int64 * len(args))()
+        keep = []  # keep encoded bytes alive for the call
+        for i, a in enumerate(args):
+            b = (a if isinstance(a, bytes)
+                 else str(a).encode("utf-8"))
+            keep.append(b)
+            argv[i] = b
+            lens[i] = len(b)
+        with self._cmd_lock:
+            while True:
+                n = self._lib.sbr_cmd(self._h, len(args), argv, lens,
+                                      self._buf, len(self._buf))
+                if n >= 0:
+                    break
+                # reply larger than the buffer: grow and re-issue (safe:
+                # only read-only commands have unbounded replies).  Loop,
+                # not a single retry — another thread's write can grow
+                # the same structure between the two calls.
+                self._buf = ctypes.create_string_buffer(-n + 256)
+            reply = self._buf.raw[:n]
+        val, _ = _parse_resp(reply)
+        if isinstance(val, RespError):
+            raise val
+        return val
+
+    # ---- command surface (mirrors the Python impl) ----
+    def ping(self):
+        return self._cmd("PING")
+
+    def flushall(self):
+        return self._cmd("FLUSHALL")
+
+    def set(self, key, value):
+        return self._cmd("SET", key, value)
+
+    def get(self, key):
+        return self._cmd("GET", key)
+
+    def sadd(self, key, *members):
+        return self._cmd("SADD", key, *members)
+
+    def smembers(self, key):
+        return self._cmd("SMEMBERS", key)
+
+    def hset(self, key, field, value, *more):
+        return self._cmd("HSET", key, field, value, *more)
+
+    def hget(self, key, field):
+        return self._cmd("HGET", key, field)
+
+    def hdel(self, key, *fields):
+        return self._cmd("HDEL", key, *fields)
+
+    def hgetall(self, key):
+        return self._cmd("HGETALL", key)
+
+    def hincrby(self, key, field, amount):
+        return self._cmd("HINCRBY", key, field, amount)
+
+    def lpush(self, key, *values):
+        return self._cmd("LPUSH", key, *values)
+
+    def llen(self, key):
+        return self._cmd("LLEN", key)
+
+    def lrange(self, key, start, stop):
+        return self._cmd("LRANGE", key, start, stop)
+
+    def dispatch(self, args: list[Any]) -> Any:
+        if not args:
+            raise RespError("ERR empty command")
+        return self._cmd(*args)
+
+    # ---- native bulk writeback (redis_schema.write_windows_pipelined) --
+    def write_windows_bulk(self, rows, stamp: str, absolute: bool) -> int:
+        """Canonical-schema writeback of ``(campaign, wts, count)`` rows
+        in one native call; observable state identical to issuing the
+        HGET/HSET/LPUSH/HINCRBY sequence per row."""
+        n = len(rows)
+        if n == 0:
+            return 0
+        camp_off = (ctypes.c_int64 * (n + 1))()
+        ts_off = (ctypes.c_int64 * (n + 1))()
+        counts = (ctypes.c_int64 * n)()
+        camps = []
+        tss = []
+        co = to = 0
+        for i, (c, w, cnt) in enumerate(rows):
+            cb = c.encode()
+            wb = w.encode() if isinstance(w, str) else str(w).encode()
+            camps.append(cb)
+            tss.append(wb)
+            camp_off[i] = co
+            ts_off[i] = to
+            co += len(cb)
+            to += len(wb)
+            counts[i] = cnt
+        camp_off[n] = co
+        ts_off[n] = to
+        sb = stamp.encode()
+        rc = self._lib.sbr_write_windows(
+            self._h, n, b"".join(camps), camp_off, b"".join(tss), ts_off,
+            counts, sb, len(sb), 1 if absolute else 0)
+        if rc < 0:
+            raise RespError("WRONGTYPE Operation against a key holding "
+                            "the wrong kind of value")
+        return int(rc)
+
+    def write_windows_arrays(self, names_blob: bytes, names_off,
+                             ci, ts, counts, stamp: str,
+                             absolute: bool) -> int:
+        """Index-form bulk writeback: campaign table once (blob +
+        int64 offsets, len C+1), rows as numpy int32 ``ci`` / int64
+        ``ts``/``counts`` arrays — the engine flush path, zero per-row
+        Python work."""
+        import ctypes as _c
+
+        import numpy as _np
+
+        n = int(ci.shape[0])
+        if n == 0:
+            return 0
+        ci = _np.ascontiguousarray(ci, _np.int32)
+        ts = _np.ascontiguousarray(ts, _np.int64)
+        counts = _np.ascontiguousarray(counts, _np.int64)
+        sb = stamp.encode()
+        rc = self._lib.sbr_write_windows_idx(
+            self._h, n, names_blob,
+            names_off.ctypes.data_as(_c.POINTER(_c.c_int64)),
+            int(names_off.shape[0]) - 1,
+            ci.ctypes.data_as(_c.POINTER(_c.c_int32)),
+            ts.ctypes.data_as(_c.POINTER(_c.c_int64)),
+            counts.ctypes.data_as(_c.POINTER(_c.c_int64)),
+            sb, len(sb), 1 if absolute else 0)
+        if rc == -2:
+            raise ValueError("campaign index out of range")
+        if rc < 0:
+            raise RespError("WRONGTYPE Operation against a key holding "
+                            "the wrong kind of value")
+        return int(rc)
+
+
+def make_store() -> FakeRedisStore:
+    """The native C store when the library is available, else the
+    pure-Python one — same observable behavior either way."""
+    from streambench_tpu import native
+
+    lib = native.load()
+    if lib is not None:
+        return NativeRedisStore(lib)
+    return FakeRedisStore()
+
+
 def _encode_reply(v: Any) -> bytes:
     if v is None:
         return b"$-1\r\n"
@@ -230,7 +455,7 @@ class FakeRedisServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  store: FakeRedisStore | None = None):
-        self.store = store if store is not None else FakeRedisStore()
+        self.store = store if store is not None else make_store()
         self._server = _Server((host, port), _Handler)
         self._server.store = self.store  # type: ignore[attr-defined]
         self.host, self.port = self._server.server_address[:2]

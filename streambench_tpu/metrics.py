@@ -36,9 +36,29 @@ class LatencyTracker:
         self.ignore_first = ignore_first
         # bucket(ms, window start) -> key -> last update time (ms)
         self._updates: dict[int, dict[str, int]] = defaultdict(dict)
+        # bulk batches (key_idx array, bucket array, stamp, names) parked
+        # until a report asks for them: the per-pair dict updates are too
+        # slow for catchup flush sizes (10^5 rows) on the hot path
+        self._bulk: list = []
 
     def record(self, key: str, bucket_ms: int, update_time_ms: int) -> None:
+        self._merge_bulk()  # keep single/bulk recording order coherent
         self._updates[bucket_ms][key] = update_time_ms
+
+    def record_bulk(self, key_idx, buckets, update_time_ms: int,
+                    names: list[str]) -> None:
+        """Record a whole flush batch as arrays; merged lazily at report
+        time (last update per (bucket, key) wins, append order = time
+        order, same as repeated ``record`` calls)."""
+        self._bulk.append((key_idx, buckets, int(update_time_ms), names))
+
+    def _merge_bulk(self) -> None:
+        if not self._bulk:
+            return
+        bulk, self._bulk = self._bulk, []
+        for key_idx, buckets, stamp, names in bulk:
+            for c, b in zip(key_idx.tolist(), buckets.tolist()):
+                self._updates[b][names[c]] = stamp
 
     def final_latencies(self) -> list[int]:
         """Sorted ``update − bucket − window_len`` over complete buckets.
@@ -48,6 +68,7 @@ class LatencyTracker:
         the reference's trimming (``ProcessTimeAwareStore.java:129-140``).
         Returns [] when too few buckets survive the trim.
         """
+        self._merge_bulk()
         buckets = sorted(self._updates)
         if len(buckets) <= self.ignore_first + 1:
             return []

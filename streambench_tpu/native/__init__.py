@@ -72,6 +72,22 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sb_format_events_cap.restype = c_i64
     lib.sb_format_events_cap.argtypes = [
         c_i32, c_i32, c_i32, i32p, c_i32, i32p, c_i32]
+    i64p = ctypes.POINTER(c_i64)
+    lib.sbr_new.restype = c_p
+    lib.sbr_new.argtypes = []
+    lib.sbr_free.argtypes = [c_p]
+    lib.sbr_cmd.restype = c_i64
+    lib.sbr_cmd.argtypes = [
+        c_p, c_i32, ctypes.POINTER(ctypes.c_char_p), i64p,
+        ctypes.c_char_p, c_i64]
+    lib.sbr_write_windows.restype = c_i64
+    lib.sbr_write_windows.argtypes = [
+        c_p, c_i64, ctypes.c_char_p, i64p, ctypes.c_char_p, i64p,
+        i64p, ctypes.c_char_p, c_i64, c_i32]
+    lib.sbr_write_windows_idx.restype = c_i64
+    lib.sbr_write_windows_idx.argtypes = [
+        c_p, c_i64, ctypes.c_char_p, i64p, c_i64, i32p, i64p, i64p,
+        ctypes.c_char_p, c_i64, c_i32]
     return lib
 
 
@@ -85,7 +101,8 @@ def load(rebuild: bool = False) -> ctypes.CDLL | None:
             return _lib
         _tried = True
         srcs = [os.path.join(_HERE, "encoder.cpp"),
-                os.path.join(_HERE, "gen.cpp")]
+                os.path.join(_HERE, "gen.cpp"),
+                os.path.join(_HERE, "store.cpp")]
         try:
             if rebuild or not os.path.exists(_SO) or any(
                     os.path.getmtime(_SO) < os.path.getmtime(s)

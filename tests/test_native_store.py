@@ -1,0 +1,164 @@
+"""Native C store (native/store.cpp) vs the Python reference store.
+
+The two implementations must be observably identical: same replies for
+the same command sequences (differential test), same canonical window
+schema from the bulk writeback as from the per-command path, and the
+same behavior under the RESP TCP server and the stats readers.
+"""
+
+import random
+
+import pytest
+
+from streambench_tpu import native
+from streambench_tpu.io.fakeredis import (
+    FakeRedisStore,
+    FakeRedisServer,
+    NativeRedisStore,
+    make_store,
+)
+from streambench_tpu.io.resp import RespClient, RespError
+from streambench_tpu.io.redis_schema import (
+    as_redis,
+    read_seen_counts,
+    read_window_latencies,
+    seed_campaigns,
+    write_windows_pipelined,
+)
+
+pytestmark = pytest.mark.skipif(native.load() is None,
+                                reason="native library unavailable")
+
+
+def native_store() -> NativeRedisStore:
+    return NativeRedisStore(native.load())
+
+
+COMMANDS = [
+    ("PING",),
+    ("SET", "k1", "v1"),
+    ("GET", "k1"),
+    ("GET", "missing"),
+    ("SADD", "s", "a", "b", "a"),
+    ("SADD", "s", "b", "c"),
+    ("SMEMBERS", "s"),
+    ("SMEMBERS", "missing"),
+    ("HSET", "h", "f1", "v1"),
+    ("HSET", "h", "f1", "v2", "f2", "x"),
+    ("HGET", "h", "f1"),
+    ("HGET", "h", "nope"),
+    ("HGET", "missing", "f"),
+    ("HGETALL", "h"),
+    ("HINCRBY", "h", "ctr", "5"),
+    ("HINCRBY", "h", "ctr", "-2"),
+    ("HINCRBY", "h", "f1", "1"),          # non-integer -> error
+    ("HDEL", "h", "f2", "nope"),
+    ("LPUSH", "l", "x"),
+    ("LPUSH", "l", "y", "z"),
+    ("LLEN", "l"),
+    ("LRANGE", "l", "0", "-1"),
+    ("LRANGE", "l", "1", "1"),
+    ("LRANGE", "l", "-2", "-1"),
+    ("LRANGE", "l", "5", "9"),
+    ("LRANGE", "missing", "0", "-1"),
+    ("GET", "h"),                          # WRONGTYPE
+    ("LPUSH", "k1", "v"),                  # WRONGTYPE
+    ("BOGUS", "x"),                        # unknown command
+    ("FLUSHALL",),
+    ("GET", "k1"),
+]
+
+
+def run_seq(store, seq):
+    out = []
+    for cmd in seq:
+        try:
+            v = store.dispatch(list(cmd))
+            # hgetall order is implementation-defined: canonicalize
+            if cmd[0] == "HGETALL":
+                v = dict(zip(v[0::2], v[1::2]))
+            out.append(("ok", v))
+        except RespError as e:
+            out.append(("err", str(e).split()[0]))  # compare error class
+    return out
+
+
+def test_differential_command_sequences():
+    assert run_seq(native_store(), COMMANDS) == run_seq(
+        FakeRedisStore(), COMMANDS)
+
+
+def test_differential_random_sequences():
+    rng = random.Random(7)
+    keys = ["a", "b", "c"]
+    seq = []
+    for _ in range(400):
+        k = rng.choice(keys)
+        seq.append(rng.choice([
+            ("SET", k, str(rng.randrange(100))),
+            ("GET", k),
+            ("HSET", "h" + k, "f" + str(rng.randrange(3)),
+             str(rng.randrange(10))),
+            ("HGET", "h" + k, "f" + str(rng.randrange(3))),
+            ("HINCRBY", "h" + k, "ctr", str(rng.randrange(-5, 6))),
+            ("LPUSH", "l" + k, str(rng.randrange(10))),
+            ("LRANGE", "l" + k, "0", "-1"),
+            ("SADD", "s", k),
+            ("SMEMBERS", "s"),
+            ("HGETALL", "h" + k),
+        ]))
+    assert run_seq(native_store(), seq) == run_seq(FakeRedisStore(), seq)
+
+
+def test_bulk_writeback_matches_python_store():
+    """write_windows_pipelined through the native bulk entry must leave
+    the same observable schema as through the Python store."""
+    camps = [f"c{i:02d}" for i in range(10)]
+    rows = [(camps[i % 10], 1_000_000 + (i // 10) * 10_000, 1 + i % 3)
+            for i in range(500)]
+    stores = {}
+    for name, store in (("native", native_store()),
+                        ("python", FakeRedisStore())):
+        r = as_redis(store)
+        seed_campaigns(r, camps)
+        write_windows_pipelined(r, rows, time_updated=777)
+        write_windows_pipelined(r, rows, time_updated=888)
+        stores[name] = (read_seen_counts(r), read_window_latencies(r))
+    assert stores["native"][0] == stores["python"][0]
+    assert stores["native"][1] == stores["python"][1]
+
+
+def test_bulk_absolute_mode():
+    r = as_redis(native_store())
+    seed_campaigns(r, ["c"])
+    write_windows_pipelined(r, [("c", 10_000, 5)], time_updated=1,
+                            absolute=True)
+    write_windows_pipelined(r, [("c", 10_000, 3)], time_updated=2,
+                            absolute=True)
+    assert read_seen_counts(r)["c"][10_000] == 3  # replace, not +=
+
+
+def test_native_store_behind_resp_server():
+    with FakeRedisServer(store=native_store()) as srv:
+        c = RespClient(srv.host, srv.port)
+        assert c.execute("PING") == "PONG"
+        c.execute("SET", "x", "1")
+        assert c.execute("GET", "x") == "1"
+        c.execute("HSET", "h", "f", "v")
+        assert c.execute("HGETALL", "h") == ["f", "v"]
+        replies = c.pipeline_execute([("SADD", "s", "m")] * 3)
+        assert replies == [1, 0, 0]
+        c.close()
+
+
+def test_make_store_prefers_native():
+    assert isinstance(make_store(), NativeRedisStore)
+
+
+def test_large_reply_grows_buffer():
+    s = native_store()
+    for i in range(5000):
+        s.lpush("big", f"value-{i:08d}")
+    vals = s.lrange("big", 0, -1)
+    assert len(vals) == 5000
+    assert vals[0] == "value-00004999"  # LPUSH order: last push first
