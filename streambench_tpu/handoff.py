@@ -118,7 +118,7 @@ def _main(argv: list[str] | None = None) -> int:
     from streambench_tpu.config import ConfigError, load_config_or_default
     from streambench_tpu.datagen import gen
     from streambench_tpu.encode.native_encoder import make_encoder
-    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.fakeredis import make_store
     from streambench_tpu.io.redis_schema import as_redis
     from streambench_tpu.io.resp import RespClient
 
@@ -156,7 +156,7 @@ def _main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.inprocessRedis:
-        r = as_redis(FakeRedisStore())
+        r = as_redis(make_store())
     else:
         r = RespClient(cfg.redis_host, cfg.redis_port)
     dump_handoff(r, table, samples)

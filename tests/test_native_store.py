@@ -162,3 +162,36 @@ def test_large_reply_grows_buffer():
     vals = s.lrange("big", 0, -1)
     assert len(vals) == 5000
     assert vals[0] == "value-00004999"  # LPUSH order: last push first
+
+
+def test_concurrent_clients_reply_isolation():
+    """The shared reply buffer must never leak one thread's reply into
+    another (regression test for the _cmd lock): hammer the store from
+    several threads with distinguishable values and verify every reply."""
+    import threading
+
+    s = native_store()
+    errors = []
+
+    def worker(tid: int) -> None:
+        try:
+            for i in range(300):
+                key = f"k-{tid}-{i % 7}"
+                val = f"v-{tid}-{i}"
+                s.set(key, val)
+                got = s.get(key)
+                # interleaved writers only touch their own keys, so the
+                # readback must be a value this thread wrote
+                assert got.startswith(f"v-{tid}-"), (got, tid)
+                s.lpush(f"l-{tid}", val)
+                tail = s.lrange(f"l-{tid}", 0, 0)
+                assert tail and tail[0].startswith(f"v-{tid}-")
+        except BaseException as e:  # noqa: BLE001 - surface on main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:1]
