@@ -80,9 +80,35 @@ struct SvEq {
 template <typename V>
 using SvMap = std::unordered_map<string, V, SvHash, SvEq>;
 
+// Specialized value for window hashes — every row the bulk writeback
+// creates is exactly {seen_count: int, time_updated: ms-string}, and the
+// generic two-node inner map costs ~3x as much to build.  Any write that
+// doesn't fit this shape DEMOTES the entry into the generic `hashes` map
+// (see demote_window), so the observable command surface is identical.
+struct WinVal {
+  int64_t seen;
+  string updated;
+};
+
+inline bool parse_i64(string_view s, int64_t* out) {
+  if (s.empty()) return false;
+  int64_t v = 0;
+  size_t i = 0;
+  bool neg = s[0] == '-';
+  if (neg) i = 1;
+  if (i >= s.size()) return false;
+  for (; i < s.size(); i++) {
+    if (s[i] < '0' || s[i] > '9') return false;
+    v = v * 10 + (s[i] - '0');
+  }
+  *out = neg ? -v : v;
+  return true;
+}
+
 struct Store {
   SvMap<string> strings;
   SvMap<SvMap<string>> hashes;
+  SvMap<WinVal> windows;  // hash-kind, specialized (see WinVal)
   SvMap<std::unordered_set<string, SvHash, SvEq>> sets;
   SvMap<std::deque<string>> lists;
   std::mutex mu;
@@ -96,13 +122,15 @@ struct Store {
   }
 
   // WRONGTYPE guard identical to the Python impl's _check_type.
+  // `windows` is hash-kind: it conflicts with everything except hashes.
   template <typename Owner>
   bool wrongtype(string_view key, const Owner& owner) const {
+    bool owner_is_hash = (const void*)&owner == (const void*)&hashes;
     if ((const void*)&owner != (const void*)&strings &&
         strings.count(key))
       return true;
-    if ((const void*)&owner != (const void*)&hashes && hashes.count(key))
-      return true;
+    if (!owner_is_hash && hashes.count(key)) return true;
+    if (!owner_is_hash && windows.count(key)) return true;
     if ((const void*)&owner != (const void*)&sets && sets.count(key))
       return true;
     if ((const void*)&owner != (const void*)&lists && lists.count(key))
@@ -110,11 +138,69 @@ struct Store {
     return false;
   }
 
+  // Move a specialized window entry into the generic hash map (an
+  // off-schema write is about to land); returns the generic hash.
+  SvMap<string>& demote_window(string_view key) {
+    auto wit = windows.find(key);
+    auto& h = hashes[string(key)];
+    if (wit != windows.end()) {
+      char tmp[24];
+      int n = std::snprintf(tmp, sizeof tmp, "%lld",
+                            (long long)wit->second.seen);
+      h.emplace("seen_count", string(tmp, (size_t)n));
+      h.emplace("time_updated", std::move(wit->second.updated));
+      windows.erase(wit);
+    }
+    return h;
+  }
+
   string fresh_id() {
     char buf[40];
     std::snprintf(buf, sizeof buf, "%s-%010llx", id_prefix,
                   (unsigned long long)id_counter++);
     return string(buf);
+  }
+
+  // One window row's value update, shared by both bulk writers: the
+  // specialized WinVal path unless the uuid already lives as a generic
+  // hash (created through the per-command path).
+  void bump_window(const string& wuuid, int64_t count,
+                   const string& stamp_s, bool absolute) {
+    auto ghit = hashes.find(string_view(wuuid));
+    if (ghit != hashes.end()) {
+      auto& wh = ghit->second;
+      char tmp[24];
+      int tmp_len;
+      auto sit = wh.find(string_view("seen_count"));
+      if (absolute) {
+        tmp_len = std::snprintf(tmp, sizeof tmp, "%lld", (long long)count);
+      } else {
+        int64_t cur = 0;
+        if (sit != wh.end()) parse_i64(sit->second, &cur);
+        cur += count;
+        tmp_len = std::snprintf(tmp, sizeof tmp, "%lld", (long long)cur);
+      }
+      if (sit == wh.end())
+        wh.emplace("seen_count", string(tmp, (size_t)tmp_len));
+      else
+        sit->second.assign(tmp, (size_t)tmp_len);
+      auto uit = wh.find(string_view("time_updated"));
+      if (uit == wh.end())
+        wh.emplace("time_updated", stamp_s);
+      else
+        uit->second = stamp_s;
+      return;
+    }
+    auto wvit = windows.find(string_view(wuuid));
+    if (wvit == windows.end()) {
+      windows.emplace(wuuid, WinVal{count, stamp_s});
+    } else {
+      if (absolute)
+        wvit->second.seen = count;
+      else
+        wvit->second.seen += count;
+      wvit->second.updated = stamp_s;
+    }
   }
 };
 
@@ -132,20 +218,6 @@ inline bool ieq(string_view a, const char* b) {
   return true;
 }
 
-inline bool parse_i64(string_view s, int64_t* out) {
-  if (s.empty()) return false;
-  int64_t v = 0;
-  size_t i = 0;
-  bool neg = s[0] == '-';
-  if (neg) i = 1;
-  if (i >= s.size()) return false;
-  for (; i < s.size(); i++) {
-    if (s[i] < '0' || s[i] > '9') return false;
-    v = v * 10 + (s[i] - '0');
-  }
-  *out = neg ? -v : v;
-  return true;
-}
 
 void run_cmd(Store& st, int32_t argc, string_view* a, Reply& r) {
   if (argc < 1) {
@@ -158,6 +230,7 @@ void run_cmd(Store& st, int32_t argc, string_view* a, Reply& r) {
   } else if (ieq(name, "FLUSHALL")) {
     st.strings.clear();
     st.hashes.clear();
+    st.windows.clear();
     st.sets.clear();
     st.lists.clear();
     r.simple("OK");
@@ -201,7 +274,9 @@ void run_cmd(Store& st, int32_t argc, string_view* a, Reply& r) {
       return r.error("ERR wrong number of arguments for 'hset'");
     string key(a[1]);
     if (st.wrongtype(key, st.hashes)) return r.error(kWrongType);
-    auto& h = st.hashes[key];
+    // generic writes to a specialized window entry demote it first
+    auto& h = st.windows.count(a[1]) ? st.demote_window(a[1])
+                                     : st.hashes[key];
     int64_t added = 0;
     for (int32_t i = 2; i + 1 < argc; i += 2) {
       string f(a[i]);
@@ -213,6 +288,18 @@ void run_cmd(Store& st, int32_t argc, string_view* a, Reply& r) {
     if (argc != 3) return r.error("ERR wrong number of arguments for 'hget'");
     string key(a[1]);
     if (st.wrongtype(key, st.hashes)) return r.error(kWrongType);
+    auto wv = st.windows.find(a[1]);
+    if (wv != st.windows.end()) {
+      if (a[2] == string_view("seen_count")) {
+        char tmp[24];
+        int tl = std::snprintf(tmp, sizeof tmp, "%lld",
+                               (long long)wv->second.seen);
+        return r.bulk(string_view(tmp, (size_t)tl));
+      }
+      if (a[2] == string_view("time_updated"))
+        return r.bulk(wv->second.updated);
+      return r.nil();
+    }
     auto it = st.hashes.find(key);
     if (it == st.hashes.end()) return r.nil();
     auto f = it->second.find(string(a[2]));
@@ -222,6 +309,15 @@ void run_cmd(Store& st, int32_t argc, string_view* a, Reply& r) {
     if (argc < 3) return r.error("ERR wrong number of arguments for 'hdel'");
     string key(a[1]);
     if (st.wrongtype(key, st.hashes)) return r.error(kWrongType);
+    if (st.windows.count(a[1])) {
+      bool touches_schema = false;
+      for (int32_t i = 2; i < argc; i++)
+        if (a[i] == string_view("seen_count") ||
+            a[i] == string_view("time_updated"))
+          touches_schema = true;
+      // deleting only absent fields must not cost the specialization
+      if (touches_schema) st.demote_window(a[1]);
+    }
     auto it = st.hashes.find(key);
     int64_t removed = 0;
     if (it != st.hashes.end()) {
@@ -234,6 +330,18 @@ void run_cmd(Store& st, int32_t argc, string_view* a, Reply& r) {
       return r.error("ERR wrong number of arguments for 'hgetall'");
     string key(a[1]);
     if (st.wrongtype(key, st.hashes)) return r.error(kWrongType);
+    auto wv = st.windows.find(a[1]);
+    if (wv != st.windows.end()) {
+      char tmp[24];
+      int tl = std::snprintf(tmp, sizeof tmp, "%lld",
+                             (long long)wv->second.seen);
+      r.array_header(4);
+      r.bulk("seen_count");
+      r.bulk(string_view(tmp, (size_t)tl));
+      r.bulk("time_updated");
+      r.bulk(wv->second.updated);
+      return;
+    }
     auto it = st.hashes.find(key);
     if (it == st.hashes.end()) return r.array_header(0);
     r.array_header((int64_t)it->second.size() * 2);
@@ -249,6 +357,25 @@ void run_cmd(Store& st, int32_t argc, string_view* a, Reply& r) {
     int64_t amount;
     if (!parse_i64(a[3], &amount))
       return r.error("ERR value is not an integer or out of range");
+    auto wv = st.windows.find(a[1]);
+    if (wv != st.windows.end()) {
+      if (a[2] == string_view("seen_count")) {
+        wv->second.seen += amount;
+        return r.integer(wv->second.seen);
+      }
+      if (a[2] == string_view("time_updated")) {
+        int64_t cur;
+        if (!parse_i64(wv->second.updated, &cur))
+          return r.error("ERR hash value is not an integer");
+        cur += amount;
+        char tmp[24];
+        int tl = std::snprintf(tmp, sizeof tmp, "%lld", (long long)cur);
+        wv->second.updated.assign(tmp, (size_t)tl);
+        return r.integer(cur);
+      }
+      // off-schema field: fall back to a generic hash
+      st.demote_window(a[1]);
+    }
     auto& h = st.hashes[key];
     string f(a[2]);
     int64_t cur = 0;
@@ -339,6 +466,9 @@ int64_t sbr_write_windows(void* store, int64_t n, const char* camp_blob,
                 (size_t)(camp_off[i + 1] - camp_off[i]));
     string wts(ts_blob + ts_off[i], (size_t)(ts_off[i + 1] - ts_off[i]));
     if (st->wrongtype(camp, st->hashes)) return -1;
+    // a campaign key sitting in `windows` (possible only if a caller
+    // reuses a window uuid as a campaign name) must merge, not shadow
+    if (st->windows.count(string_view(camp))) st->demote_window(camp);
     auto& ch = st->hashes[camp];
     auto wit = ch.find(wts);
     string wuuid;
@@ -357,21 +487,7 @@ int64_t sbr_write_windows(void* store, int64_t n, const char* camp_blob,
     } else {
       wuuid = wit->second;
     }
-    auto& wh = st->hashes[wuuid];
-    if (absolute) {
-      char tmp[24];
-      std::snprintf(tmp, sizeof tmp, "%lld", (long long)counts[i]);
-      wh["seen_count"] = tmp;
-    } else {
-      int64_t cur = 0;
-      auto cit = wh.find("seen_count");
-      if (cit != wh.end()) parse_i64(cit->second, &cur);
-      cur += counts[i];
-      char tmp[24];
-      std::snprintf(tmp, sizeof tmp, "%lld", (long long)cur);
-      wh["seen_count"] = tmp;
-    }
-    wh["time_updated"] = stamp_s;
+    st->bump_window(wuuid, counts[i], stamp_s, absolute != 0);
   }
   return n;
 }
@@ -399,8 +515,6 @@ int64_t sbr_write_windows_idx(void* store, int64_t n,
   int32_t last_ci = -1;
   SvMap<string>* ch = nullptr;
   constexpr string_view kWindows = "windows";
-  constexpr string_view kSeen = "seen_count";
-  constexpr string_view kUpdated = "time_updated";
   for (int64_t i = 0; i < n; i++) {
     int32_t c = ci[i];
     if (c < 0 || c >= n_names) return -2;
@@ -408,6 +522,7 @@ int64_t sbr_write_windows_idx(void* store, int64_t n,
       string_view camp(names_blob + names_off[c],
                        (size_t)(names_off[c + 1] - names_off[c]));
       if (st->wrongtype(camp, st->hashes)) return -1;
+      if (st->windows.count(camp)) st->demote_window(camp);
       auto hit = st->hashes.find(camp);
       if (hit == st->hashes.end())
         hit = st->hashes.emplace(string(camp), SvMap<string>()).first;
@@ -432,31 +547,7 @@ int64_t sbr_write_windows_idx(void* store, int64_t n,
     } else {
       wuuid = &wit->second;
     }
-    auto whit = st->hashes.find(string_view(*wuuid));
-    if (whit == st->hashes.end())
-      whit = st->hashes.emplace(*wuuid, SvMap<string>()).first;
-    auto& wh = whit->second;
-    char tmp[24];
-    int tmp_len;
-    auto sit = wh.find(kSeen);
-    if (absolute) {
-      tmp_len =
-          std::snprintf(tmp, sizeof tmp, "%lld", (long long)counts[i]);
-    } else {
-      int64_t cur = 0;
-      if (sit != wh.end()) parse_i64(sit->second, &cur);
-      cur += counts[i];
-      tmp_len = std::snprintf(tmp, sizeof tmp, "%lld", (long long)cur);
-    }
-    if (sit == wh.end())
-      wh.emplace(string(kSeen), string(tmp, (size_t)tmp_len));
-    else
-      sit->second.assign(tmp, (size_t)tmp_len);
-    auto uit = wh.find(kUpdated);
-    if (uit == wh.end())
-      wh.emplace(string(kUpdated), stamp_s);
-    else
-      uit->second = stamp_s;
+    st->bump_window(*wuuid, counts[i], stamp_s, absolute != 0);
   }
   return n;
 }

@@ -195,3 +195,43 @@ def test_concurrent_clients_reply_isolation():
     for t in threads:
         t.join()
     assert not errors, errors[:1]
+
+
+def test_window_entries_respond_to_generic_commands():
+    """Window rows created by the bulk path must behave exactly like
+    generic hashes under HGET/HGETALL/HINCRBY/HSET/HDEL (the native
+    store specializes them internally and demotes on off-schema
+    writes)."""
+    s = native_store()
+    r = as_redis(s)
+    seed_campaigns(r, ["c"])
+    write_windows_pipelined(r, [("c", 20_000, 7)], time_updated=999)
+    wuuid = s.hget("c", "20000")
+    assert wuuid
+    assert s.hget(wuuid, "seen_count") == "7"
+    assert s.hget(wuuid, "time_updated") == "999"
+    assert s.hget(wuuid, "other") is None
+    flat = s.hgetall(wuuid)
+    assert dict(zip(flat[0::2], flat[1::2])) == {
+        "seen_count": "7", "time_updated": "999"}
+    assert s.hincrby(wuuid, "seen_count", 3) == 10
+    assert s.hincrby(wuuid, "time_updated", 1) == 1000
+    # off-schema write demotes; all fields must survive
+    s.hset(wuuid, "note", "x")
+    flat = s.hgetall(wuuid)
+    d = dict(zip(flat[0::2], flat[1::2]))
+    assert d == {"seen_count": "10", "time_updated": "1000", "note": "x"}
+    # bulk update of a demoted window keeps working (generic branch)
+    write_windows_pipelined(r, [("c", 20_000, 5)], time_updated=1234)
+    assert s.hget(wuuid, "seen_count") == "15"
+    assert s.hget(wuuid, "time_updated") == "1234"
+    # WRONGTYPE: a specialized window key is hash-kind
+    write_windows_pipelined(r, [("c", 30_000, 1)], time_updated=1)
+    w2 = s.hget("c", "30000")
+    with pytest.raises(RespError):
+        s.get(w2)
+    with pytest.raises(RespError):
+        s.lpush(w2, "x")
+    assert s.hdel(w2, "seen_count") == 1
+    assert s.hget(w2, "seen_count") is None
+    assert s.hget(w2, "time_updated") == "1"
