@@ -190,6 +190,13 @@ class EventSource:
                 ads="".join(self.ads).encode(), alen=len(self.ads[0]),
                 at="".join(AD_TYPES).encode(), at_lens=at_lens,
                 et="".join(EVENT_TYPES).encode(), et_lens=et_lens,
+                # pointers cached once: data_as costs ~2 us/call, paid
+                # per paced tick otherwise (arrays are kept alive by the
+                # at_lens/et_lens entries above)
+                at_lens_p=at_lens.ctypes.data_as(
+                    _ctypes.POINTER(_ctypes.c_int32)),
+                et_lens_p=et_lens.ctypes.data_as(
+                    _ctypes.POINTER(_ctypes.c_int32)),
                 per_event=int(per_event),
                 state=_ctypes.c_uint64(self.rng.getrandbits(64)),
                 # persistent output buffer: create_string_buffer would
@@ -203,6 +210,14 @@ class EventSource:
         """Render events as ONE newline-terminated byte block via the
         native formatter; None when the native library is unavailable
         (callers fall back to ``events_at``)."""
+        mv = self.events_blob_view(ts_ms)
+        return None if mv is None else bytes(mv)
+
+    def events_blob_view(self, ts_ms) -> "memoryview | None":
+        """Zero-copy variant of ``events_blob_at``: a memoryview over the
+        source's internal buffer, valid until the NEXT call.  The paced
+        producer writes it straight to the journal — the bytes() copy
+        was a measurable share of producer CPU at high rates."""
         ctx = self._native_ctx()
         if not ctx:
             return None
@@ -210,24 +225,23 @@ class EventSource:
               else _np.fromiter(ts_ms, dtype=_np.int64))
         ts = _np.ascontiguousarray(ts, dtype=_np.int64)
         if ts.size == 0:
-            return b""
+            return memoryview(b"")
         cap = int(ts.size) * ctx["per_event"]
         if ctx["buf"].size < cap:
             ctx["buf"] = _np.empty(cap, _np.uint8)
         out = ctx["buf"]
-        i32p = _ctypes.POINTER(_ctypes.c_int32)
         n = ctx["lib"].sb_format_events(
             ctx["users"], ctx["ulen"], len(self.user_ids),
             ctx["pages"], ctx["plen"], len(self.page_ids),
             ctx["ads"], ctx["alen"], len(self.ads),
-            ctx["at"], ctx["at_lens"].ctypes.data_as(i32p), len(AD_TYPES),
-            ctx["et"], ctx["et_lens"].ctypes.data_as(i32p), len(EVENT_TYPES),
+            ctx["at"], ctx["at_lens_p"], len(AD_TYPES),
+            ctx["et"], ctx["et_lens_p"], len(EVENT_TYPES),
             ts.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int64)), ts.size,
             _ctypes.byref(ctx["state"]), 1 if self.with_skew else 0,
             _ctypes.cast(out.ctypes.data, _ctypes.c_char_p), cap)
         if n < 0:
             return None
-        return out[:n].tobytes()
+        return out.data[:n]
 
 
 # ----------------------------------------------------------------------
@@ -370,15 +384,36 @@ def run_paced(sink: JournalWriter, throughput: int,
     last_path = None
     start_ns = time.time_ns()
     sent = 0
+    # Stall forensics: the longest single emit and the longest gap
+    # between loop iterations (scheduler starvation / oversleep) tell a
+    # failing sweep rung WHERE its producer lag came from.
+    max_emit_ms = 0.0
+    max_gap_ms = 0.0
+    last_loop_ns = start_ns
+    sub_max = {"ts": 0.0, "fmt": 0.0, "write": 0.0, "flush": 0.0}
+    slept = True
     try:
         while True:
             if max_events is not None and sent >= max_events:
                 break
             now_ns = time.time_ns()
+            if not slept:
+                # gap across an intentional sleep is nominal; only a gap
+                # between BUSY iterations indicates starvation/oversleep
+                max_gap_ms = max(max_gap_ms, (now_ns - last_loop_ns) / 1e6)
+            last_loop_ns = now_ns
+            slept = False
             if duration_s is not None and now_ns - start_ns >= duration_s * 1e9:
                 break
+            # Events 0..due-1 are due strictly by schedule (floor, no
+            # emit-ahead): with a "+1" here at least one event is always
+            # due, the sleep branch never runs, and the loop degenerates
+            # into ~8 kHz micro-batches whose per-call overhead IS the
+            # producer's throughput ceiling (observed: ~160k ev/s).  The
+            # floor form emits each event at most one period late and
+            # keeps the intended ~tick_s cadence.
             due = min(
-                int((now_ns - start_ns) / period_ns) + 1,
+                int((now_ns - start_ns) / period_ns),
                 max_events if max_events is not None else 1 << 62,
             )
             # Cap one iteration's emission at 1 s of schedule: after a
@@ -390,13 +425,22 @@ def run_paced(sink: JournalWriter, throughput: int,
                 behind_ms = (now_ns - (start_ns + sent * period_ns)) / 1e6
                 if behind_ms > 100 and on_behind:
                     on_behind(behind_ms)  # "Falling behind by: N ms"
+                t1 = time.time_ns()
                 ts = (start_ns + _np.arange(sent, due, dtype=_np.int64)
                       * period_ns) // 1_000_000
-                blob = src.events_blob_at(ts) if blob_ok else None
+                t2 = time.time_ns()
+                blob = src.events_blob_view(ts) if blob_ok else None
+                t3 = time.time_ns()
                 if blob is not None:
+                    # zero-copy: the view targets the source's buffer,
+                    # consumed fully by this write before the next format
                     sink.append_bytes(blob)
                 else:
                     sink.append_many(src.events_at(ts.tolist()))
+                t4 = time.time_ns()
+                sub_max["ts"] = max(sub_max["ts"], (t2 - t1) / 1e6)
+                sub_max["fmt"] = max(sub_max["fmt"], (t3 - t2) / 1e6)
+                sub_max["write"] = max(sub_max["write"], (t4 - t3) / 1e6)
                 path_now = "native" if blob is not None else "python"
                 if path_now != last_path:
                     # Report every path CHANGE, not just the first batch:
@@ -408,9 +452,22 @@ def run_paced(sink: JournalWriter, throughput: int,
                 # Make the batch visible to tailing consumers immediately:
                 # producer buffering must not pollute end-to-end latency.
                 sink.flush()
+                sub_max["flush"] = max(sub_max["flush"],
+                                       (time.time_ns() - t4) / 1e6)
+                max_emit_ms = max(max_emit_ms,
+                                  (time.time_ns() - now_ns) / 1e6)
                 sent = due
+                # NO rest after an emit: at high rates the next event is
+                # due within microseconds, and on a contended single core
+                # a sleeping producer pays wake latency + unaccounted
+                # emit time every tick — a built-in rate deficit that
+                # spirals (measured: 225k/s collapsed to ~50k/s).  The
+                # hot loop stays cheap because the emit path is
+                # zero-copy C; it parks in the branch below whenever the
+                # schedule truly has nothing due.
             else:
                 time.sleep(tick_s)
+                slept = True
     except SystemExit:
         # STOP_LOAD's SIGTERM (stream-bench.sh:231) raised mid-loop: stop
         # cleanly so the caller still reports/flushes the true count.
@@ -418,6 +475,9 @@ def run_paced(sink: JournalWriter, throughput: int,
     final_behind = (time.time_ns() - (start_ns + sent * period_ns)) / 1e6
     if on_behind is not None and final_behind > 100:
         on_behind(final_behind)
+    print(f"pacing: max_emit={max_emit_ms:.0f}ms max_gap={max_gap_ms:.0f}ms "
+          + " ".join(f"max_{k}={v:.0f}ms" for k, v in sub_max.items()),
+          flush=True)
     sink.flush()
     return sent
 

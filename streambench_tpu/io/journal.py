@@ -37,11 +37,11 @@ class JournalWriter:
         self._sync_every = sync_every
         self._since_sync = 0
 
-    def append(self, line: str | bytes) -> None:
+    def append(self, line: "str | bytes | memoryview") -> None:
         data = line.encode("utf-8") if isinstance(line, str) else line
         with self._lock:
             self._f.write(data)
-            if not data.endswith(b"\n"):
+            if bytes(data[-1:]) != b"\n":
                 self._f.write(b"\n")
             self._since_sync += 1
             if self._sync_every and self._since_sync >= self._sync_every:
@@ -62,12 +62,13 @@ class JournalWriter:
                 self._f.flush()
                 self._since_sync = 0
 
-    def append_bytes(self, data: bytes) -> None:
+    def append_bytes(self, data: "bytes | memoryview") -> None:
         """Append a pre-rendered block of newline-terminated records in one
         write — the zero-copy sink for the native event formatter (the
-        producer-side peer of the engine's block-mode ingest).  A distinct
-        method (not an alias semantics-wise) so sinks without block
-        support fail the caller's ``hasattr`` capability probe."""
+        producer-side peer of the engine's block-mode ingest; memoryviews
+        are written without materializing bytes).  A distinct method so
+        sinks without block support fail the caller's ``hasattr``
+        capability probe."""
         if data:
             self.append(data)
 
