@@ -12,8 +12,10 @@ Deliberate fixes over the fork (capabilities, not bugs, are ported):
 
 - ``load-ids`` returning nil (``core.clj:36-45`` ends with a ``println``) is
   fixed: ids actually load from the id files.
-- pacing is batched per tick instead of one ``Thread/sleep`` per event, so
-  the generator sustains >10^6 events/s; the ">100 ms behind" warning is kept
+- pacing emits due events in batches (one C-formatted block per loop pass,
+  parking in a tick sleep only when nothing is due) instead of one
+  ``Thread/sleep`` per event, so the generator paces hundreds of thousands
+  of events/s on one core; the ">100 ms behind" warning is kept
   (``core.clj:200-202``).
 """
 
