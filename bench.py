@@ -336,6 +336,7 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
     results = []
     best = None
     rate = start_rate
+    retried: set[int] = set()
     for run_id in range(max_runs):
         res = _paced_latency_phase(cfg, mapping, broker,
                                    as_redis(make_store()), workdir,
@@ -345,8 +346,21 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
         sustained = (p99 is not None and p99 <= sla_ms
                      and res["processed"] == res.get("sent"))
         res["sustained"] = sustained
+        # A rung whose PRODUCER fell seconds behind its own schedule is
+        # not a valid engine measurement (the generator is supposed to
+        # be healthy load, like the reference's dedicated-node
+        # generator): mark it and retry the same rate once instead of
+        # letting generator starvation walk the ladder down.
+        starved = (not sustained
+                   and res.get("generator_behind_max_ms", 0) > 5_000)
+        res["invalid_producer"] = starved
         log(f"rate {rate}/s: {'SUSTAINED' if sustained else 'NOT sustained'}"
-            f" (p99={p99} ms, sla={sla_ms} ms)")
+            f" (p99={p99} ms, sla={sla_ms} ms"
+            + (", producer starved - rung invalid" if starved else "")
+            + ")")
+        if starved and rate not in retried:
+            retried.add(rate)
+            continue  # re-run the same rate (still bounded by max_runs)
         if sustained:
             best = max(best or 0, rate)
             rate = int(rate * 1.5)
