@@ -34,7 +34,7 @@ import time
 
 BASELINE_EVENTS_PER_S = 100_000.0
 
-PROBE_TIMEOUT_S = float(os.environ.get("STREAMBENCH_BENCH_PROBE_TIMEOUT", "150"))
+PROBE_TIMEOUT_S = float(os.environ.get("STREAMBENCH_BENCH_PROBE_TIMEOUT", "90"))
 PROBE_ATTEMPTS = int(os.environ.get("STREAMBENCH_BENCH_PROBE_ATTEMPTS", "2"))
 
 
@@ -320,8 +320,8 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
 
 def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
                    duration_s: float, sla_ms: int,
-                   max_runs: int = 3, rate_ceiling: int | None = None
-                   ) -> dict:
+                   max_runs: int = 3, rate_ceiling: int | None = None,
+                   deadline: float | None = None) -> dict:
     """Escalating-rate ladder (the reference's experimental method: find
     the max load the engine sustains at bounded latency,
     ``README.markdown:36-37``).  Starts at ``start_rate`` (the baseline
@@ -338,6 +338,11 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
     rate = start_rate
     retried: set[int] = set()
     for run_id in range(max_runs):
+        if deadline is not None and (
+                time.monotonic() + duration_s + 45 > deadline):
+            log("latency sweep stopped: bench time budget would be "
+                "exceeded (headline must still print)")
+            break
         res = _paced_latency_phase(cfg, mapping, broker,
                                    as_redis(make_store()), workdir,
                                    rate, duration_s, run_id=run_id)
@@ -379,6 +384,10 @@ def main() -> int:
     # under a second of wall time; this keeps the measurement window in
     # whole seconds without stretching generation unreasonably.
     n_events = int(os.environ.get("STREAMBENCH_BENCH_EVENTS", "2000000"))
+    # Hard wall-clock budget: external runners may kill the bench at an
+    # unknown timeout, and a dead headline is worse than a short sweep.
+    budget_s = float(os.environ.get("STREAMBENCH_BENCH_BUDGET_S", "1500"))
+    bench_deadline = time.monotonic() + budget_s
     paced_rate = int(os.environ.get("STREAMBENCH_BENCH_PACED_RATE", "0"))
     paced_dur = float(os.environ.get("STREAMBENCH_BENCH_PACED_SECS", "125"))
     sla_ms = int(os.environ.get("STREAMBENCH_BENCH_SLA_MS", "15000"))
@@ -536,7 +545,8 @@ def main() -> int:
         try:
             sweep = _latency_sweep(cfg, mapping, broker, wd, start_rate,
                                    paced_dur, sla_ms, max_runs=sweep_runs,
-                                   rate_ceiling=int(value))
+                                   rate_ceiling=int(value),
+                                   deadline=bench_deadline)
         except Exception as e:  # diagnostics must never kill the headline
             log(f"paced latency sweep failed (non-fatal): {e!r}")
 
