@@ -61,7 +61,33 @@ ENCODE_WORKERS = int(os.environ.get("ENCODE_WORKERS", "1"))
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
-BROKER_DIR = os.path.join(WORKDIR, "broker")
+
+
+def _broker_dir() -> str:
+    """Journal broker location: RAM-backed when tmpfs has room.
+
+    The journal is the Kafka stand-in, and on a disk-backed workdir a
+    paced producer's write() can block for seconds under dirty-page
+    writeback throttling — billed to the engine as window latency.
+    User-facing outputs (seen.txt, logs, checkpoints) stay in WORKDIR;
+    BROKER_DIR=... or an unwritable/too-small /dev/shm keeps the old
+    disk behavior.
+    """
+    explicit = os.environ.get("BROKER_DIR", "")
+    if explicit:
+        return explicit
+    try:
+        sv = os.statvfs("/dev/shm")
+        if sv.f_bavail * sv.f_frsize >= 4 << 30:
+            return os.path.join("/dev/shm",
+                                f"streambench-broker-{os.getuid()}",
+                                os.path.basename(os.path.abspath(WORKDIR)))
+    except OSError:
+        pass
+    return os.path.join(WORKDIR, "broker")
+
+
+BROKER_DIR = _broker_dir()
 
 
 def log(msg: str) -> None:
