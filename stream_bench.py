@@ -24,7 +24,9 @@ Usage:  python stream_bench.py SETUP START_REDIS ... | JAX_TEST | STOP_ALL
 
 from __future__ import annotations
 
+import hashlib
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -79,9 +81,14 @@ def _broker_dir() -> str:
     try:
         sv = os.statvfs("/dev/shm")
         if sv.f_bavail * sv.f_frsize >= 4 << 30:
-            return os.path.join("/dev/shm",
-                                f"streambench-broker-{os.getuid()}",
-                                os.path.basename(os.path.abspath(WORKDIR)))
+            # Key by full-path hash, not just basename: two checkouts both
+            # running WORKDIR=./bench-run must not share (or clean away)
+            # each other's journal.
+            wd = os.path.abspath(WORKDIR)
+            tag = hashlib.sha1(wd.encode()).hexdigest()[:10]
+            return os.path.join(
+                "/dev/shm", f"streambench-broker-{os.getuid()}",
+                f"{os.path.basename(wd)}-{tag}")
     except OSError:
         pass
     return os.path.join(WORKDIR, "broker")
@@ -195,6 +202,7 @@ def op_setup() -> None:
     """Write localConf.yaml from env vars (stream-bench.sh:123-138) and
     pre-build the native encoder (the only thing to 'compile')."""
     os.makedirs(WORKDIR, exist_ok=True)
+    _clean_broker_dir()  # start from a fresh journal, don't pile on tmpfs
     sys.path.insert(0, REPO_ROOT)
     from streambench_tpu.config import write_local_conf
     write_local_conf(CONF_FILE, {
@@ -367,9 +375,28 @@ def op_jax_test_suite() -> None:
         log(f"=== JAX_TEST [{engine}] done ===")
 
 
+def _clean_broker_dir() -> None:
+    """Remove this workdir's journal from tmpfs.
+
+    A RAM-backed broker dir is not reclaimed by reboot-free hosts on its
+    own, so successive runs would pin hundreds of MB of /dev/shm until
+    reboot.  Only the tmpfs location is cleaned — a disk-backed
+    WORKDIR/broker keeps the old reuse-per-workdir behavior — and only
+    while no producer/engine holds it open.
+    """
+    if os.environ.get("BROKER_DIR"):
+        return  # user-pinned location: never delete their journal
+    if not BROKER_DIR.startswith("/dev/shm/"):
+        return
+    if any(running_pid(n) is not None for n in ("load", "engine")):
+        return
+    shutil.rmtree(BROKER_DIR, ignore_errors=True)
+
+
 def op_stop_all() -> None:
     for name in ("load", "engine", "redis"):
         stop_if_needed(name)
+    _clean_broker_dir()
 
 
 OPS: dict[str, object] = {

@@ -209,10 +209,14 @@ def write_windows_pipelined(r: RedisLike,
         )
         for j, i in enumerate(need):
             c, w, _ = rows[i]
-            if probes[j]:
+            # Probe replies can be RespError (e.g. WRONGTYPE on a mistyped
+            # campaign key) which is truthy; caching one would permanently
+            # aim every later flush at a key derived from str(error).
+            if isinstance(probes[j], str):
                 win_cache[(c, w)] = probes[j]
-            if probes[len(need) + j] and c not in list_cache:
-                list_cache[c] = probes[len(need) + j]
+            lp = probes[len(need) + j]
+            if isinstance(lp, str) and c not in list_cache:
+                list_cache[c] = lp
 
     # Assign UUIDs for missing structures; campaigns and even whole rows may
     # repeat within one flush, so the cache doubles as the local view of
@@ -223,6 +227,12 @@ def write_windows_pipelined(r: RedisLike,
     # hash the campaign never references (permanently missing windows).
     new_win: dict[tuple[str, str], str] = {}
     new_list: dict[str, str] = {}
+    # mut index of the HSET that registers each fresh id: an id whose
+    # registration errored (e.g. WRONGTYPE campaign key) must NOT enter the
+    # cache, else every later flush would cache-hit an orphan hash the
+    # campaign never references.
+    win_reg: dict[tuple[str, str], int] = {}
+    list_reg: dict[str, int] = {}
     muts: list[tuple] = []
     for campaign, wts, count in rows:
         wuuid = win_cache.get((campaign, wts)) or new_win.get(
@@ -230,11 +240,13 @@ def write_windows_pipelined(r: RedisLike,
         if wuuid is None:
             wuuid = _fresh_id()
             new_win[(campaign, wts)] = wuuid
+            win_reg[(campaign, wts)] = len(muts)
             muts.append(("HSET", campaign, wts, wuuid))
             luuid = list_cache.get(campaign) or new_list.get(campaign)
             if luuid is None:
                 luuid = _fresh_id()
                 new_list[campaign] = luuid
+                list_reg[campaign] = len(muts)
                 muts.append(("HSET", campaign, "windows", luuid))
             muts.append(("LPUSH", luuid, wts))
         if absolute:
@@ -243,7 +255,13 @@ def write_windows_pipelined(r: RedisLike,
         else:
             muts.append(("HINCRBY", wuuid, "seen_count", str(count)))
             muts.append(("HSET", wuuid, "time_updated", stamp))
-    r.pipeline_execute(muts)
+    res = r.pipeline_execute(muts)
+    for key, i in win_reg.items():
+        if isinstance(res[i], RespError):
+            del new_win[key]
+    for campaign, i in list_reg.items():
+        if isinstance(res[i], RespError):
+            del new_list[campaign]
     win_cache.update(new_win)
     list_cache.update(new_list)
     return len(rows)
@@ -259,22 +277,47 @@ def _bulk_write_windows(store: FakeRedisStore, rows, stamp: str,
     with store._lock:
         hashes = store._hashes
         lists = store._lists
+        holders = (store._strings, store._hashes, store._sets, store._lists)
+
+        def clashes(key: str, owner: dict) -> bool:
+            return any(key in d for d in holders if d is not owner)
+
         for campaign, wts, count in rows:
             wuuid = win_cache.get((campaign, wts))
+            fresh_wuuid = False
             if wuuid is None:
                 probe = hashes.get(campaign)
+                if probe is None and clashes(campaign, hashes):
+                    # Mirror the per-command pipeline: that path would
+                    # WRONGTYPE every command of this row in-list and
+                    # carry on with the rest of the batch — so skip the
+                    # row, never shadow the key and never raise (a raise
+                    # here would double-apply rows 0..k-1 when the
+                    # flusher retries the retained batch).
+                    continue
                 wuuid = probe.get(wts) if probe else None
                 if wuuid is None:
                     wuuid = _fresh_id()
+                    fresh_wuuid = True
                     ch = hashes.setdefault(campaign, {})
                     ch[wts] = wuuid
                     luuid = list_cache.get(campaign) or ch.get("windows")
                     if luuid is None:
                         luuid = _fresh_id()
                         ch["windows"] = luuid
-                    list_cache[campaign] = luuid
-                    lists.setdefault(luuid, []).insert(0, wts)
+                        list_cache[campaign] = luuid
+                        lists.setdefault(luuid, []).insert(0, wts)
+                    elif luuid in lists or not clashes(luuid, lists):
+                        list_cache[campaign] = luuid
+                        lists.setdefault(luuid, []).insert(0, wts)
+                    # else: stored list id points at a non-list key — the
+                    # per-command LPUSH would error in-list while the
+                    # window hash still gets bumped; mirror that.
                 win_cache[(campaign, wts)] = wuuid
+            if not fresh_wuuid and wuuid not in hashes \
+                    and clashes(wuuid, hashes):
+                continue  # cached id now a non-hash key: per-command
+                # HINCRBY/HSET would error in-list; skip the row
             wh = hashes.setdefault(wuuid, {})
             if absolute:
                 wh["seen_count"] = str(count)

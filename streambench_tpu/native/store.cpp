@@ -18,6 +18,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -435,15 +436,34 @@ void sbr_free(void* s) { delete static_cast<Store*>(s); }
 // the command with a larger buffer — safe because every WRITE command
 // has a small fixed-size reply (+OK / :N), so only read-only commands
 // (SMEMBERS / HGETALL / LRANGE / GET / HGET) can ever overflow.
+//
+// That safety is enforced structurally, not assumed: a mutating command
+// is refused (without executing) unless the buffer already has at least
+// kMinMutatingCap bytes, so the overflow->re-issue path can only ever
+// re-run read-only commands.  Any future write command whose reply could
+// exceed kMinMutatingCap must raise the constant, and the invariant
+// check below makes a violation loud instead of a silent double-apply.
+constexpr int64_t kMinMutatingCap = 4096;
+
+inline bool is_mutating(string_view name) {
+  return ieq(name, "SET") || ieq(name, "SADD") || ieq(name, "HSET") ||
+         ieq(name, "HDEL") || ieq(name, "HINCRBY") ||
+         ieq(name, "LPUSH") || ieq(name, "FLUSHALL");
+}
+
 int64_t sbr_cmd(void* store, int32_t argc, const char** argv,
                 const int64_t* lens, char* out, int64_t out_cap) {
   auto* st = static_cast<Store*>(store);
   std::vector<string_view> a((size_t)argc);
   for (int32_t i = 0; i < argc; i++)
     a[(size_t)i] = string_view(argv[i], (size_t)lens[i]);
+  bool mutating = argc > 0 && is_mutating(a[0]);
+  if (mutating && out_cap < kMinMutatingCap)
+    return -kMinMutatingCap;  // refused BEFORE executing; retry is safe
   Reply r{out, out_cap};
   std::lock_guard<std::mutex> g(st->mu);
   run_cmd(*st, argc, a.data(), r);
+  if (mutating && r.len > kMinMutatingCap) std::abort();  // invariant broken
   return r.len <= out_cap ? r.len : -r.len;
 }
 
@@ -452,7 +472,11 @@ int64_t sbr_cmd(void* store, int32_t argc, const char** argv,
 //   campaign hash probe -> create window/list ids on miss -> LPUSH ts ->
 //   HINCRBY seen_count (or HSET when absolute) -> HSET time_updated.
 // Blobs are concatenated strings described by offset arrays (n+1 each).
-// Returns n, or -1 on a WRONGTYPE conflict (mirrors the RESP error).
+// Returns the number of rows applied.  A WRONGTYPE campaign key skips
+// that row (matching the pipelined RESP path, where every command of the
+// row errors in-list and the rest of the batch proceeds) — aborting
+// mid-batch would make the caller's retained-batch retry double-apply
+// the rows before the conflict.
 int64_t sbr_write_windows(void* store, int64_t n, const char* camp_blob,
                           const int64_t* camp_off, const char* ts_blob,
                           const int64_t* ts_off, const int64_t* counts,
@@ -461,11 +485,12 @@ int64_t sbr_write_windows(void* store, int64_t n, const char* camp_blob,
   auto* st = static_cast<Store*>(store);
   string stamp_s(stamp, (size_t)stamp_len);
   std::lock_guard<std::mutex> g(st->mu);
+  int64_t applied = 0;
   for (int64_t i = 0; i < n; i++) {
     string camp(camp_blob + camp_off[i],
                 (size_t)(camp_off[i + 1] - camp_off[i]));
     string wts(ts_blob + ts_off[i], (size_t)(ts_off[i + 1] - ts_off[i]));
-    if (st->wrongtype(camp, st->hashes)) return -1;
+    if (st->wrongtype(camp, st->hashes)) continue;
     // a campaign key sitting in `windows` (possible only if a caller
     // reuses a window uuid as a campaign name) must merge, not shadow
     if (st->windows.count(string_view(camp))) st->demote_window(camp);
@@ -488,16 +513,18 @@ int64_t sbr_write_windows(void* store, int64_t n, const char* camp_blob,
       wuuid = wit->second;
     }
     st->bump_window(wuuid, counts[i], stamp_s, absolute != 0);
+    applied++;
   }
-  return n;
+  return applied;
 }
 
 // Index-form bulk writeback: campaign NAMES are passed once as a table
 // (blob + offsets) and each row is (campaign_index, window_ts_ms, count)
 // from plain int arrays — no per-row Python string handling anywhere.
 // This is the engine flush path: its pending deltas already live as
-// numpy (index, ts, count) triples.  Returns n, or -1 on WRONGTYPE,
-// -2 on an out-of-range campaign index.
+// numpy (index, ts, count) triples.  Returns rows applied (WRONGTYPE
+// campaign keys skip their rows, like sbr_write_windows), or -2 on an
+// out-of-range campaign index (caller bug, not data state — abort).
 int64_t sbr_write_windows_idx(void* store, int64_t n,
                               const char* names_blob,
                               const int64_t* names_off, int64_t n_names,
@@ -515,20 +542,25 @@ int64_t sbr_write_windows_idx(void* store, int64_t n,
   int32_t last_ci = -1;
   SvMap<string>* ch = nullptr;
   constexpr string_view kWindows = "windows";
+  int64_t applied = 0;
   for (int64_t i = 0; i < n; i++) {
     int32_t c = ci[i];
     if (c < 0 || c >= n_names) return -2;
     if (c != last_ci) {
       string_view camp(names_blob + names_off[c],
                        (size_t)(names_off[c + 1] - names_off[c]));
-      if (st->wrongtype(camp, st->hashes)) return -1;
+      last_ci = c;
+      if (st->wrongtype(camp, st->hashes)) {
+        ch = nullptr;  // skip this campaign's rows (see sbr_write_windows)
+        continue;
+      }
       if (st->windows.count(camp)) st->demote_window(camp);
       auto hit = st->hashes.find(camp);
       if (hit == st->hashes.end())
         hit = st->hashes.emplace(string(camp), SvMap<string>()).first;
       ch = &hit->second;
-      last_ci = c;
     }
+    if (ch == nullptr) continue;
     char wts_buf[24];
     int wts_len =
         std::snprintf(wts_buf, sizeof wts_buf, "%lld", (long long)ts[i]);
@@ -548,8 +580,9 @@ int64_t sbr_write_windows_idx(void* store, int64_t n,
       wuuid = &wit->second;
     }
     st->bump_window(*wuuid, counts[i], stamp_s, absolute != 0);
+    applied++;
   }
-  return n;
+  return applied;
 }
 
 }  // extern "C"

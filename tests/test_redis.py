@@ -119,6 +119,51 @@ def test_pipelined_writeback_matches_single(anyredis):
     assert sorted(r.execute("LRANGE", wl, 0, 10)) == ["10000", "20000"]
 
 
+def test_wrongtype_campaign_skips_rows_without_poisoning(anyredis):
+    """A campaign key that already exists as a string must neither shadow
+    into a dual-type state, nor poison the uuid cache with RespError
+    replies, nor abort the batch (the flusher's retained-batch retry
+    would then double-apply the rows before the conflict)."""
+    r = anyredis
+    schema.seed_campaigns(r, ["good"], flush=True)
+    r.execute("SET", "bad", "i-am-a-string")
+    cache: dict = {}
+    rows = [("good", 10000, 3), ("bad", 10000, 5), ("good", 20000, 2)]
+    schema.write_windows_pipelined(r, rows, time_updated=50000, cache=cache)
+    # healthy rows landed exactly once
+    counts = schema.read_seen_counts(r)
+    assert counts["good"] == {10000: 3, 20000: 2}
+    # the string key survived untouched
+    assert r.execute("GET", "bad") == "i-am-a-string"
+    # cache carries no entry derived from an error reply
+    for (c, _w), u in cache.get("win", {}).items():
+        assert c == "good" and isinstance(u, str) and "WRONGTYPE" not in u
+    for c, u in cache.get("list", {}).items():
+        assert c == "good" and isinstance(u, str) and "WRONGTYPE" not in u
+    # a retry of the same batch accumulates only the healthy rows again
+    schema.write_windows_pipelined(r, rows, time_updated=51000, cache=cache)
+    counts = schema.read_seen_counts(r)
+    assert counts["good"] == {10000: 6, 20000: 4}
+    assert r.execute("GET", "bad") == "i-am-a-string"
+
+
+def test_wrongtype_campaign_skips_rows_native_store():
+    from streambench_tpu import native
+    from streambench_tpu.io.fakeredis import NativeRedisStore
+
+    lib = native.load()
+    if lib is None or not hasattr(lib, "sbr_new"):
+        pytest.skip("native store not built")
+    r = schema.as_redis(NativeRedisStore(lib))
+    schema.seed_campaigns(r, ["good"], flush=True)
+    r.execute("SET", "bad", "i-am-a-string")
+    rows = [("good", 10000, 3), ("bad", 10000, 5), ("good", 20000, 2)]
+    schema.write_windows_pipelined(r, rows, time_updated=50000)
+    counts = schema.read_seen_counts(r)
+    assert counts["good"] == {10000: 3, 20000: 2}
+    assert r.execute("GET", "bad") == "i-am-a-string"
+
+
 def test_latency_hash_roundtrip(anyredis):
     r = anyredis
     idx1 = schema.dump_latency_hash(r, "t1", {100: 5, 200: 8}, 999)
