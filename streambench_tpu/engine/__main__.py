@@ -122,14 +122,19 @@ def main(argv: list[str] | None = None) -> int:
     def make_engine(r) -> AdAnalyticsEngine:
         if args.sharded:
             from streambench_tpu.parallel import (
+                ShardedHLLEngine,
+                ShardedSessionCMSEngine,
                 ShardedWindowEngine,
                 mesh_from_config,
             )
-            if args.engine != "exact":
-                raise SystemExit("--sharded currently implies the exact "
-                                 "engine; drop --engine")
-            return ShardedWindowEngine(cfg, mapping, mesh_from_config(cfg),
-                                       campaigns=campaigns, redis=r)
+            cls = {"exact": ShardedWindowEngine,
+                   "hll": ShardedHLLEngine,
+                   "session": ShardedSessionCMSEngine}.get(args.engine)
+            if cls is None:
+                raise SystemExit(f"--sharded supports exact/hll/session, "
+                                 f"not --engine {args.engine}")
+            return cls(cfg, mapping, mesh_from_config(cfg),
+                       campaigns=campaigns, redis=r)
         if args.engine != "exact":
             from streambench_tpu.engine.sketches import (
                 HLLDistinctEngine,

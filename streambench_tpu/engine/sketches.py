@@ -568,17 +568,21 @@ class SessionCMSEngine(_SketchEngineBase):
                             int(v)))
         return out
 
-    def close(self) -> None:
-        self.state, final = session.flush(
-            self.state, gap_ms=self.gap_ms, lateness_ms=self.lateness,
-            force=True)
-        self._absorb(final)
+    def _write_heavy_hitters(self) -> None:
+        """Top-k estimates -> Redis hash ``<redis.hashtable>_hh``."""
         if self.redis is not None and self.cfg.redis_hashtable:
             table = f"{self.cfg.redis_hashtable}_hh"
             cmds = [("HSET", table, user, str(est))
                     for user, est in self.heavy_hitters()]
             if cmds:
                 self.redis.pipeline_execute(cmds)
+
+    def close(self) -> None:
+        self.state, final = session.flush(
+            self.state, gap_ms=self.gap_ms, lateness_ms=self.lateness,
+            force=True)
+        self._absorb(final)
+        self._write_heavy_hitters()
 
     @property
     def dropped(self) -> int:

@@ -12,6 +12,12 @@ from streambench_tpu.parallel.sharded import (
     sharded_init_state,
     sharded_step,
 )
+from streambench_tpu.parallel.sketches import (
+    ShardedHLLEngine,
+    ShardedSessionCMSEngine,
+    sharded_hll_init,
+    sharded_hll_step,
+)
 
 __all__ = [
     "DistContext",
@@ -22,7 +28,11 @@ __all__ = [
     "init_distributed",
     "mesh_from_config",
     "run_distributed_catchup",
+    "ShardedHLLEngine",
+    "ShardedSessionCMSEngine",
     "ShardedWindowEngine",
+    "sharded_hll_init",
+    "sharded_hll_step",
     "sharded_init_state",
     "sharded_step",
 ]

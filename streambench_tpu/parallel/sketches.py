@@ -1,0 +1,567 @@
+"""Sharded sketch engines: the "sharded sketch-merge allreduce" of
+BASELINE config #5, realized for every sketch family.
+
+The reference's scale-out routes keyed state to its owner (Storm
+``fieldsGrouping("campaign_id")``, ``AdvertisingTopology.java:232-233``)
+and merges parallel partials through a unifier
+(``ApplicationDimensionComputation.java:120``).  The exact-count engine
+already does this with a campaign-sharded ``psum`` (``parallel/sharded.py``);
+this module gives the sketches the same treatment, each with its natural
+merge reduction (SURVEY.md §2 "Reduce/unifier" row):
+
+- **HLL** (``ShardedHLLEngine``): registers ``[C, W, R]`` sharded on the
+  campaign axis.  Register merge is elementwise **max** — but instead of
+  pmax-ing register-sized partials (O(C*W*R) bytes over ICI per step),
+  the O(B) batch columns are ``all_gather``-ed over the data axis and
+  each campaign shard scatter-maxes every event into its own rows.
+  Cross-device traffic per step is four [B] int32 columns, independent
+  of sketch size; the merge happens implicitly because each campaign's
+  registers have exactly one owner.  The only collective reduction is a
+  scalar ``psum`` for the drop counter.
+- **Session + CMS** (``ShardedSessionCMSEngine``): per-user session rows
+  sharded on the *user* axis (the flattened ``data x campaign`` mesh —
+  the per-key-sequential state is keyed by user, not campaign, so the
+  whole mesh becomes one shard axis, the analog of the fork's
+  ``reduce.partitions`` keyed by a different field).  Each shard
+  sessionizes only its users; closed sessions fold into per-shard CMS
+  *deltas* that merge with **psum** — the sketch-merge allreduce — onto
+  a replicated table, and the closed-session rows ``all_gather`` so the
+  device-resident heavy-hitter ring updates identically everywhere.
+
+Both engines are drop-in subclasses: same host loop, Redis writeback,
+checkpoint format (snapshots gather to host arrays; restore re-places
+shardings), and CLI flags as their single-device parents.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from streambench_tpu.config import BenchmarkConfig
+from streambench_tpu.engine.sketches import HLLDistinctEngine, SessionCMSEngine
+from streambench_tpu.io.redis_schema import RedisLike
+from streambench_tpu.ops import cms, hll, session
+from streambench_tpu.ops.windowcount import NEG, assign_windows
+from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
+from streambench_tpu.parallel.sharded import pad_campaigns
+
+try:  # jax >= 0.6 top-level export
+    from jax import shard_map as _shard_map_raw
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+MESH_AXES = (DATA_AXIS, CAMPAIGN_AXIS)
+
+
+def shard_map(body, **kw):
+    """``jax.shard_map`` with the static replication check disabled.
+
+    The sketch folds gather the O(B) batch columns (``all_gather``) and
+    scatter into shard-local state, so every output is value-replicated
+    where its out_spec says — but jax's varying-mesh-axes inference
+    treats ``all_gather`` results as varying over the gathered axis and
+    cannot prove it.  The alternative (pmax/psum laundering) would move
+    O(C*W*R) register bytes over ICI per step, defeating the design; the
+    bit-identity tests against the single-device kernels are the proof
+    the static check cannot give.
+    """
+    try:
+        return _shard_map_raw(body, check_vma=False, **kw)
+    except TypeError:  # pragma: no cover - older jax spelling
+        return _shard_map_raw(body, check_rep=False, **kw)
+
+
+# ----------------------------------------------------------------------
+# Sharded HLL
+# ----------------------------------------------------------------------
+
+def _hll_fold(registers, window_ids, watermark, dropped, join_table,
+              ad_idx, user_idx, event_type, event_time, valid,
+              *, divisor_ms: int, lateness_ms: int, view_type: int):
+    """One batch folded into a campaign shard, written against shard-local
+    views inside ``shard_map``.  Batch columns arrive data-sharded and are
+    gathered here, so every value derived from them is replicated and the
+    ring claim / watermark / drop math needs no further collectives."""
+    Cl, W, R = registers.shape
+    p = R.bit_length() - 1
+
+    gather = functools.partial(jax.lax.all_gather, axis_name=DATA_AXIS,
+                               tiled=True)
+    ad = gather(ad_idx)
+    user = gather(user_idx)
+    et = gather(event_type)
+    tm = gather(event_time)
+    v = gather(valid)
+
+    campaign = join_table[ad]
+    wid = tm // divisor_ms
+    wanted = v & (et == view_type) & (campaign >= 0)
+
+    # Windowing core shared with hll.step: the gathered columns are
+    # replicated, so the single-device claim/watermark logic computes the
+    # same global facts on every device with no further collectives.
+    slot, count_mask, new_ids, new_wm = assign_windows(
+        window_ids, watermark, wid, wanted, v, tm,
+        divisor_ms=divisor_ms, lateness_ms=lateness_ms)
+
+    # Keyed-state routing without moving state: this shard owns campaigns
+    # [c0, c0 + Cl); everything else scatters to the drop slot.
+    c0 = jax.lax.axis_index(CAMPAIGN_AXIS) * Cl
+    local_c = campaign - c0
+    in_shard = count_mask & (local_c >= 0) & (local_c < Cl)
+
+    h = hll.splitmix32(user)
+    j = (h & jnp.uint32(R - 1)).astype(jnp.int32)
+    rank = hll._rank(h, p)
+
+    flat = jnp.where(in_shard, (local_c * W + slot) * R + j, Cl * W * R)
+    new_regs = (registers.reshape(-1)
+                .at[flat].max(rank, mode="drop")
+                .reshape(Cl, W, R))
+
+    counted = jax.lax.psum(jnp.sum(in_shard.astype(jnp.int32)),
+                           CAMPAIGN_AXIS)
+    new_dropped = dropped + jnp.sum(wanted.astype(jnp.int32)) - counted
+    return new_regs, new_ids, new_wm, new_dropped
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hll_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                    view_type: int):
+    def body(registers, window_ids, watermark, dropped, join_table,
+             ad_idx, user_idx, event_type, event_time, valid):
+        return _hll_fold(registers, window_ids, watermark, dropped,
+                         join_table, ad_idx, user_idx, event_type,
+                         event_time, valid, divisor_ms=divisor_ms,
+                         lateness_ms=lateness_ms, view_type=view_type)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P(), P(),
+                  P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS)),
+        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hll_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                    view_type: int):
+    """Scanned sharded HLL: fold ``[K, B]`` stacked batches in one
+    dispatch, collectives inside the scan body (the catchup hot path,
+    peer of ``parallel.sharded._build_scan``)."""
+
+    def body(registers, window_ids, watermark, dropped, join_table,
+             ad_idx, user_idx, event_type, event_time, valid):
+        def one(carry, xs):
+            regs, ids, wm, dr = carry
+            a, u, e, t, v = xs
+            return _hll_fold(regs, ids, wm, dr, join_table, a, u, e, t, v,
+                             divisor_ms=divisor_ms,
+                             lateness_ms=lateness_ms,
+                             view_type=view_type), None
+
+        carry, _ = jax.lax.scan(
+            one, (registers, window_ids, watermark, dropped),
+            (ad_idx, user_idx, event_type, event_time, valid))
+        return carry
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P(), P(),
+                  P(None, DATA_AXIS), P(None, DATA_AXIS),
+                  P(None, DATA_AXIS), P(None, DATA_AXIS),
+                  P(None, DATA_AXIS)),
+        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
+    )
+    return jax.jit(mapped)
+
+
+def sharded_hll_step(mesh: Mesh, state: hll.HLLState, join_table,
+                     ad_idx, user_idx, event_type, event_time, valid,
+                     *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
+                     view_type: int = 0) -> hll.HLLState:
+    """Fold one global micro-batch into campaign-sharded HLL state."""
+    fn = _build_hll_step(mesh, divisor_ms, lateness_ms, view_type)
+    regs, ids, wm, dropped = fn(
+        state.registers, state.window_ids, state.watermark, state.dropped,
+        join_table, ad_idx, user_idx, event_type, event_time, valid)
+    return hll.HLLState(regs, ids, wm, dropped)
+
+
+def sharded_hll_init(num_campaigns: int, window_slots: int, mesh: Mesh,
+                     num_registers: int = 128) -> hll.HLLState:
+    if num_registers & (num_registers - 1):
+        raise ValueError("num_registers must be a power of two")
+    C = pad_campaigns(num_campaigns, mesh)
+    rep = NamedSharding(mesh, P())
+    return hll.HLLState(
+        registers=jax.device_put(
+            jnp.zeros((C, window_slots, num_registers), jnp.int32),
+            NamedSharding(mesh, P(CAMPAIGN_AXIS, None, None))),
+        window_ids=jax.device_put(
+            jnp.full((window_slots,), -1, jnp.int32), rep),
+        watermark=jax.device_put(jnp.int32(0), rep),
+        dropped=jax.device_put(jnp.int32(0), rep),
+    )
+
+
+class ShardedHLLEngine(HLLDistinctEngine):
+    """HLL distinct-user engine with registers sharded on the campaign
+    axis of a ``(data, campaign)`` mesh.
+
+    Config #5's multi-tenant scale (1e6 campaigns) makes replicated
+    registers impossible — ``[1e6, W, R]`` int32 is GBs; one campaign
+    shard per device is how it fits, exactly as the exact-count engine
+    shards its ``[C, W]`` counts.  The flush path
+    (``hll.flush``: estimate + zero closed slots) is elementwise over the
+    campaign axis, so it runs on the sharded registers under plain jit
+    with XLA propagating the sharding — no gather until the host reads
+    the [C, W] estimates of closed windows.
+    """
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 mesh: Mesh, campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None, registers: int = 128,
+                 input_format: str = "json"):
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, registers=registers,
+                         input_format=input_format)
+        self.mesh = mesh
+        n_data = mesh.shape[DATA_AXIS]
+        if self.batch_size % n_data:
+            raise ValueError(
+                f"batch size {self.batch_size} not divisible by data-axis "
+                f"size {n_data}")
+        self.state = sharded_hll_init(
+            self.encoder.num_campaigns, self.W, mesh,
+            num_registers=registers)
+        self.join_table = jax.device_put(
+            jnp.asarray(self.encoder.join_table),
+            NamedSharding(mesh, P()))
+
+    def _device_step(self, batch) -> None:
+        self.state = sharded_hll_step(
+            self.mesh, self.state, self.join_table,
+            batch.ad_idx, batch.user_idx, batch.event_type,
+            batch.event_time, batch.valid,
+            divisor_ms=self.divisor, lateness_ms=self.lateness)
+
+    def _device_scan(self, ad_idx, user_idx, event_type, event_time,
+                     valid) -> None:
+        fn = _build_hll_scan(self.mesh, self.divisor, self.lateness, 0)
+        regs, ids, wm, dropped = fn(
+            self.state.registers, self.state.window_ids,
+            self.state.watermark, self.state.dropped, self.join_table,
+            ad_idx, user_idx, event_type, event_time, valid)
+        self.state = hll.HLLState(regs, ids, wm, dropped)
+
+    def restore(self, snap) -> None:
+        super().restore(snap)
+        # Re-place host-restored state with mesh shardings (accepting a
+        # snapshot from an unsharded HLL engine by padding campaigns).
+        C = pad_campaigns(self.encoder.num_campaigns, self.mesh)
+        regs = np.asarray(self.state.registers)
+        if regs.shape[0] < C:
+            regs = np.pad(regs, ((0, C - regs.shape[0]), (0, 0), (0, 0)))
+        rep = NamedSharding(self.mesh, P())
+        self.state = hll.HLLState(
+            registers=jax.device_put(
+                jnp.asarray(regs),
+                NamedSharding(self.mesh, P(CAMPAIGN_AXIS, None, None))),
+            window_ids=jax.device_put(
+                jnp.asarray(self.state.window_ids), rep),
+            watermark=jax.device_put(
+                jnp.int32(self.state.watermark), rep),
+            dropped=jax.device_put(jnp.int32(self.state.dropped), rep),
+        )
+
+
+# ----------------------------------------------------------------------
+# Sharded session windows + CMS heavy hitters
+# ----------------------------------------------------------------------
+
+def _shard_index():
+    """Linearized shard id over the flattened (data, campaign) mesh."""
+    nc = jax.lax.axis_size(CAMPAIGN_AXIS)
+    return jax.lax.axis_index(DATA_AXIS) * nc + jax.lax.axis_index(
+        CAMPAIGN_AXIS)
+
+
+def _globalize(closed: session.ClosedSessions, u0) -> session.ClosedSessions:
+    return closed._replace(
+        user=jnp.where(closed.valid, closed.user + u0, -1))
+
+
+def _gather_closed(closed: session.ClosedSessions) -> session.ClosedSessions:
+    g = functools.partial(jax.lax.all_gather, axis_name=MESH_AXES,
+                          tiled=True)
+    return session.ClosedSessions(
+        user=g(closed.user), start=g(closed.start), end=g(closed.end),
+        clicks=g(closed.clicks), valid=g(closed.valid))
+
+
+def _cms_delta_psum(shape, closed: session.ClosedSessions):
+    """Per-shard CMS delta from closed sessions, psum-merged over the
+    whole mesh — the sketch-merge allreduce (counter add is linear, so
+    summing per-shard deltas == folding every closed session into one
+    table)."""
+    zero = cms.CMSState(table=jnp.zeros(shape, jnp.int32),
+                        total=jnp.int32(0))
+    local = cms.update(zero, closed.user, closed.clicks, closed.valid)
+    return (jax.lax.psum(local.table, MESH_AXES),
+            jax.lax.psum(local.total, MESH_AXES))
+
+
+def _session_fold(last_time, sess_start, clicks, watermark, dropped,
+                  cms_table, cms_total, tk_keys, tk_ests, closed_n,
+                  clicks_n, user_idx, event_type, event_time, valid,
+                  *, gap_ms: int, lateness_ms: int, user_capacity: int):
+    """One batch folded into a user shard + the replicated CMS/ring.
+
+    Batch columns are replicated (every shard sees every event and masks
+    to its users — the keyed shuffle without moving state).  Mirrors
+    ``SessionCMSEngine._device_step``'s absorb order exactly: CMS delta
+    and ring update for in-batch closures first, then for carried
+    closures, so estimates in the ring match the single-device engine
+    bit for bit.
+    """
+    Ul = last_time.shape[0]
+    u0 = _shard_index() * Ul
+    lu = user_idx - u0
+    in_shard = (lu >= 0) & (lu < Ul)
+    v = valid & in_shard
+
+    local = session.SessionState(last_time, sess_start, clicks,
+                                 watermark, jnp.int32(0))
+    st, closed_in, closed_carry = session.step(
+        local, jnp.where(v, lu, -1), event_type, event_time, v,
+        gap_ms=gap_ms, lateness_ms=lateness_ms)
+
+    # Watermark / drop accounting are GLOBAL facts recomputed from the
+    # replicated batch (the local step only saw this shard's events):
+    # an event is dropped iff late vs the batch-start watermark or its
+    # user id is outside the global capacity.
+    batch_max = jnp.max(jnp.where(valid, event_time, NEG))
+    new_wm = jnp.maximum(watermark, batch_max)
+    min_t = watermark - lateness_ms
+    ok = (valid & (event_time >= min_t) & (user_idx >= 0)
+          & (user_idx < user_capacity))
+    new_dropped = dropped + jnp.sum(valid.astype(jnp.int32)) \
+        - jnp.sum(ok.astype(jnp.int32))
+
+    cms_state = cms.CMSState(cms_table, cms_total)
+    topk = cms.TopKState(tk_keys, tk_ests)
+    for closed in (_globalize(closed_in, u0), _globalize(closed_carry, u0)):
+        dt, dn = _cms_delta_psum(cms_table.shape, closed)
+        cms_state = cms.CMSState(cms_state.table + dt,
+                                 cms_state.total + dn)
+        gathered = _gather_closed(closed)
+        topk = cms.update_topk(cms_state, topk, gathered.user,
+                               gathered.valid)
+        closed_n = closed_n + jax.lax.psum(
+            jnp.sum(closed.valid.astype(jnp.int32)), MESH_AXES)
+        clicks_n = clicks_n + jax.lax.psum(
+            jnp.sum(jnp.where(closed.valid, closed.clicks, 0)), MESH_AXES)
+
+    return (st.last_time, st.sess_start, st.clicks, new_wm, new_dropped,
+            cms_state.table, cms_state.total, topk.keys, topk.ests,
+            closed_n, clicks_n)
+
+
+_SESS_STATE_SPECS = (P(MESH_AXES), P(MESH_AXES), P(MESH_AXES), P(), P(),
+                     P(), P(), P(), P(), P(), P())
+
+
+@functools.lru_cache(maxsize=None)
+def _build_session_step(mesh: Mesh, gap_ms: int, lateness_ms: int,
+                        user_capacity: int):
+    def body(*args):
+        return _session_fold(*args, gap_ms=gap_ms,
+                             lateness_ms=lateness_ms,
+                             user_capacity=user_capacity)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=_SESS_STATE_SPECS + (P(), P(), P(), P()),
+        out_specs=_SESS_STATE_SPECS,
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_session_scan(mesh: Mesh, gap_ms: int, lateness_ms: int,
+                        user_capacity: int):
+    """Scanned sharded session+CMS: the whole config-#4 pipeline over
+    ``[K, B]`` stacked batches in one dispatch, collectives inside the
+    scan body (peer of ``engine.sketches._session_cms_scan``)."""
+
+    def body(lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl,
+             user_idx, event_type, event_time, valid):
+        def one(carry, xs):
+            u, e, t, v = xs
+            return _session_fold(*carry, u, e, t, v, gap_ms=gap_ms,
+                                 lateness_ms=lateness_ms,
+                                 user_capacity=user_capacity), None
+
+        carry, _ = jax.lax.scan(
+            one, (lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl),
+            (user_idx, event_type, event_time, valid))
+        return carry
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=_SESS_STATE_SPECS + (P(None, None), P(None, None),
+                                      P(None, None), P(None, None)),
+        out_specs=_SESS_STATE_SPECS,
+    )
+    return jax.jit(mapped)
+
+
+def _session_flush_fold(last_time, sess_start, clicks, watermark, dropped,
+                        cms_table, cms_total, tk_keys, tk_ests, closed_n,
+                        clicks_n, *, gap_ms: int, lateness_ms: int,
+                        force: bool):
+    Ul = last_time.shape[0]
+    u0 = _shard_index() * Ul
+    local = session.SessionState(last_time, sess_start, clicks,
+                                 watermark, dropped)
+    st, expired = session.flush(local, gap_ms=gap_ms,
+                                lateness_ms=lateness_ms, force=force)
+    cms_state = cms.CMSState(cms_table, cms_total)
+    topk = cms.TopKState(tk_keys, tk_ests)
+    closed = _globalize(expired, u0)
+    dt, dn = _cms_delta_psum(cms_table.shape, closed)
+    cms_state = cms.CMSState(cms_state.table + dt, cms_state.total + dn)
+    gathered = _gather_closed(closed)
+    topk = cms.update_topk(cms_state, topk, gathered.user, gathered.valid)
+    closed_n = closed_n + jax.lax.psum(
+        jnp.sum(closed.valid.astype(jnp.int32)), MESH_AXES)
+    clicks_n = clicks_n + jax.lax.psum(
+        jnp.sum(jnp.where(closed.valid, closed.clicks, 0)), MESH_AXES)
+    return (st.last_time, st.sess_start, st.clicks, st.watermark,
+            st.dropped, cms_state.table, cms_state.total, topk.keys,
+            topk.ests, closed_n, clicks_n)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_session_flush(mesh: Mesh, gap_ms: int, lateness_ms: int,
+                         force: bool):
+    def body(*args):
+        return _session_flush_fold(*args, gap_ms=gap_ms,
+                                   lateness_ms=lateness_ms, force=force)
+
+    mapped = shard_map(body, mesh=mesh, in_specs=_SESS_STATE_SPECS,
+                       out_specs=_SESS_STATE_SPECS)
+    return jax.jit(mapped)
+
+
+class ShardedSessionCMSEngine(SessionCMSEngine):
+    """Session + CMS engine with per-user state sharded over the whole
+    mesh (user axis = flattened ``data x campaign``).
+
+    Sessionization is per-key-sequential, so its state shards by USER —
+    the reference's analog is the keyed shuffle into per-partition
+    processors with a different key field
+    (``AdvertisingTopologyNative.java:118-119``).  Each shard sessionizes
+    the replicated batch masked to its own users; closed sessions merge
+    into the replicated CMS via per-shard delta + ``psum`` (the
+    sketch-merge allreduce) and into the replicated candidate ring via
+    ``all_gather``.  Bit-identical to the single-device engine (tested).
+    """
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 mesh: Mesh, campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 gap_ms: int = 30_000, user_capacity: int = 1 << 16,
+                 cms_depth: int = 4, cms_width: int = 2048,
+                 top_k: int = 16, candidate_capacity: int | None = None,
+                 input_format: str = "json"):
+        n_shards = mesh.devices.size
+        if user_capacity % n_shards:
+            # Raise rather than silently pad: a padded capacity would
+            # accept user ids the single-device engine drops (breaking
+            # bit-identity) and change the checkpoint geometry.
+            raise ValueError(
+                f"user_capacity {user_capacity} not divisible by mesh "
+                f"size {n_shards}")
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, gap_ms=gap_ms,
+                         user_capacity=user_capacity, cms_depth=cms_depth,
+                         cms_width=cms_width, top_k=top_k,
+                         candidate_capacity=candidate_capacity,
+                         input_format=input_format)
+        self.mesh = mesh
+        self._place()
+
+    def _place(self) -> None:
+        """(Re-)apply mesh shardings to session/CMS/ring state."""
+        mesh = self.mesh
+        user = NamedSharding(mesh, P(MESH_AXES))
+        rep = NamedSharding(mesh, P())
+        self.state = session.SessionState(
+            last_time=jax.device_put(self.state.last_time, user),
+            sess_start=jax.device_put(self.state.sess_start, user),
+            clicks=jax.device_put(self.state.clicks, user),
+            watermark=jax.device_put(self.state.watermark, rep),
+            dropped=jax.device_put(self.state.dropped, rep))
+        self.cms = cms.CMSState(
+            table=jax.device_put(self.cms.table, rep),
+            total=jax.device_put(self.cms.total, rep))
+        self.topk = cms.TopKState(
+            keys=jax.device_put(self.topk.keys, rep),
+            ests=jax.device_put(self.topk.ests, rep))
+        self._closed_dev = jax.device_put(self._closed_dev, rep)
+        self._clicks_dev = jax.device_put(self._clicks_dev, rep)
+
+    def _carry(self):
+        return (self.state.last_time, self.state.sess_start,
+                self.state.clicks, self.state.watermark,
+                self.state.dropped, self.cms.table, self.cms.total,
+                self.topk.keys, self.topk.ests, self._closed_dev,
+                self._clicks_dev)
+
+    def _uncarry(self, out) -> None:
+        (lt, ss, ck, wm, dr, table, total, tkk, tke,
+         self._closed_dev, self._clicks_dev) = out
+        self.state = session.SessionState(lt, ss, ck, wm, dr)
+        self.cms = cms.CMSState(table, total)
+        self.topk = cms.TopKState(tkk, tke)
+
+    def _device_step(self, batch) -> None:
+        fn = _build_session_step(self.mesh, self.gap_ms, self.lateness,
+                                 self.user_capacity)
+        self._uncarry(fn(*self._carry(), jnp.asarray(batch.user_idx),
+                         jnp.asarray(batch.event_type),
+                         jnp.asarray(batch.event_time),
+                         jnp.asarray(batch.valid)))
+
+    def _device_scan(self, user_idx, event_type, event_time, valid) -> None:
+        fn = _build_session_scan(self.mesh, self.gap_ms, self.lateness,
+                                 self.user_capacity)
+        self._uncarry(fn(*self._carry(), user_idx, event_type, event_time,
+                         valid))
+
+    def _sharded_flush(self, force: bool) -> None:
+        fn = _build_session_flush(self.mesh, self.gap_ms, self.lateness,
+                                  force)
+        self._uncarry(fn(*self._carry()))
+
+    def _drain_device(self) -> None:
+        self._sharded_flush(force=False)
+        self._span_start = None
+
+    def close(self) -> None:
+        self._sharded_flush(force=True)
+        self._write_heavy_hitters()
+
+    def restore(self, snap) -> None:
+        super().restore(snap)
+        self._place()

@@ -1,0 +1,273 @@
+"""Sharded sketch engines vs their single-device kernels: bit-exact.
+
+The virtual 8-device CPU mesh stands in for real multi-chip hardware
+(SURVEY.md §4.3 embedded-cluster discipline).  VERDICT r3 missing #1:
+"campaign-shard HLL registers with pmax merge, CMS with psum merge, and a
+user-axis-sharded session/CMS path ... prove bit-identity to the
+single-device kernels on the 8-CPU mesh".
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine import StreamRunner
+from streambench_tpu.engine.sketches import HLLDistinctEngine, SessionCMSEngine
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.ops import cms, hll, session
+from streambench_tpu.parallel import (
+    ShardedHLLEngine,
+    ShardedSessionCMSEngine,
+    build_mesh,
+    sharded_hll_init,
+    sharded_hll_step,
+)
+from streambench_tpu.parallel.sketches import (
+    _build_hll_scan,
+    _build_session_scan,
+    _build_session_step,
+)
+
+
+def rand_batches(rng, n_batches, B, n_ads, n_users, span_ms=200_000):
+    out = []
+    t = 70_000
+    for _ in range(n_batches):
+        ad = rng.integers(0, n_ads, B).astype(np.int32)
+        user = rng.integers(0, n_users, B).astype(np.int32)
+        et = rng.integers(0, 3, B).astype(np.int32)
+        tm = (t + np.sort(rng.integers(0, span_ms // n_batches, B))
+              ).astype(np.int32)
+        valid = rng.random(B) < 0.95
+        t += span_ms // n_batches
+        out.append((ad, user, et, tm, valid))
+    return out
+
+
+MESHES = [(8, 1), (4, 2), (2, 4), (1, 8), (2, 2)]
+
+
+@pytest.mark.parametrize("dshape", MESHES)
+def test_sharded_hll_step_matches_single_device(dshape):
+    nd, nc = dshape
+    mesh = build_mesh(data=nd, campaign=nc,
+                      devices=jax.devices()[: nd * nc])
+    rng = np.random.default_rng(11)
+    C, W, B, R = 96, 16, 64, 32  # C divisible by every nc in MESHES
+    n_ads = C * 3
+    join = np.concatenate(
+        [rng.integers(0, C, n_ads).astype(np.int32), [-1]])
+
+    ref = hll.init_state(C, W, num_registers=R)
+    sh = sharded_hll_init(C, W, mesh, num_registers=R)
+    jt = jnp.asarray(join)
+    for ad, user, et, tm, valid in rand_batches(rng, 6, B, n_ads, 500):
+        ref = hll.step(ref, jt, ad, user, et, tm, valid)
+        sh = sharded_hll_step(mesh, sh, jt, ad, user, et, tm, valid)
+
+    assert np.array_equal(np.asarray(ref.registers),
+                          np.asarray(sh.registers))
+    assert np.array_equal(np.asarray(ref.window_ids),
+                          np.asarray(sh.window_ids))
+    assert int(ref.watermark) == int(sh.watermark)
+    assert int(ref.dropped) == int(sh.dropped)
+
+
+def test_sharded_hll_scan_matches_step_sequence():
+    mesh = build_mesh(data=4, campaign=2)
+    rng = np.random.default_rng(3)
+    C, W, B, R, K = 32, 8, 32, 16, 5
+    n_ads = C * 2
+    join = np.concatenate(
+        [rng.integers(0, C, n_ads).astype(np.int32), [-1]])
+    jt = jnp.asarray(join)
+    batches = rand_batches(rng, K, B, n_ads, 200)
+
+    seq = sharded_hll_init(C, W, mesh, num_registers=R)
+    for ad, user, et, tm, valid in batches:
+        seq = sharded_hll_step(mesh, seq, jt, ad, user, et, tm, valid)
+
+    sc = sharded_hll_init(C, W, mesh, num_registers=R)
+    fn = _build_hll_scan(mesh, 10_000, 60_000, 0)
+    cols = [np.stack(c) for c in zip(*batches)]
+    regs, ids, wm, dropped = fn(sc.registers, sc.window_ids, sc.watermark,
+                                sc.dropped, jt, *cols)
+
+    assert np.array_equal(np.asarray(seq.registers), np.asarray(regs))
+    assert np.array_equal(np.asarray(seq.window_ids), np.asarray(ids))
+    assert int(seq.watermark) == int(wm)
+    assert int(seq.dropped) == int(dropped)
+
+
+def test_sharded_hll_registers_actually_sharded():
+    mesh = build_mesh(data=1, campaign=8)
+    st = sharded_hll_init(100, 16, mesh, num_registers=32)
+    # 100 campaigns pad to 104 (= 8 x 13); each shard holds 13 campaigns.
+    assert st.registers.shape == (104, 16, 32)
+    shapes = {s.data.shape for s in st.registers.addressable_shards}
+    assert shapes == {(13, 16, 32)}
+
+
+def _session_mesh_setup(dshape, U=64, B=48, n_batches=6, n_users=80,
+                        seed=21):
+    nd, nc = dshape
+    mesh = build_mesh(data=nd, campaign=nc,
+                      devices=jax.devices()[: nd * nc])
+    rng = np.random.default_rng(seed)
+    batches = []
+    t = 70_000
+    for _ in range(n_batches):
+        # n_users > U exercises the capacity-overflow drop accounting
+        user = rng.integers(0, n_users, B).astype(np.int32)
+        et = rng.integers(0, 3, B).astype(np.int32)
+        tm = (t + np.sort(rng.integers(0, 40_000, B))).astype(np.int32)
+        valid = rng.random(B) < 0.9
+        t += 40_000
+        batches.append((user, et, tm, valid))
+    return mesh, batches
+
+
+def _ring_dict(topk):
+    keys = np.asarray(topk.keys)
+    ests = np.asarray(topk.ests)
+    return {int(k): int(e) for k, e in zip(keys, ests) if k >= 0}
+
+
+@pytest.mark.parametrize("dshape", MESHES)
+def test_sharded_session_cms_matches_single_device(dshape):
+    mesh, batches = _session_mesh_setup(dshape)
+    U, M = 64, 256  # ring capacity > distinct users: no tie-broken evictions
+    gap, late = 15_000, 20_000
+
+    ref = session.init_state(U)
+    ref_cms = cms.init_state(depth=4, width=256)
+    ref_tk = cms.init_topk(M)
+
+    def absorb(cm, tk, closed):
+        cm = cms.update(cm, closed.user, closed.clicks, closed.valid)
+        tk = cms.update_topk(cm, tk, closed.user, closed.valid)
+        return cm, tk
+
+    ref_closed = 0
+    for user, et, tm, valid in batches:
+        ref, in_b, carry = session.step(ref, user, et, tm, valid,
+                                        gap_ms=gap, lateness_ms=late)
+        ref_cms, ref_tk = absorb(ref_cms, ref_tk, in_b)
+        ref_cms, ref_tk = absorb(ref_cms, ref_tk, carry)
+        ref_closed += int(np.asarray(in_b.valid).sum())
+        ref_closed += int(np.asarray(carry.valid).sum())
+
+    fn = _build_session_step(mesh, gap, late, U)
+    lt = jnp.full((U,), -1, jnp.int32)
+    ss = jnp.zeros((U,), jnp.int32)
+    ck = jnp.zeros((U,), jnp.int32)
+    carry_t = (lt, ss, ck, jnp.int32(0), jnp.int32(0),
+               jnp.zeros((4, 256), jnp.int32), jnp.int32(0),
+               jnp.full((M,), -1, jnp.int32), jnp.full((M,), -1, jnp.int32),
+               jnp.int32(0), jnp.int32(0))
+    for user, et, tm, valid in batches:
+        carry_t = fn(*carry_t, user, et, tm, valid)
+    (lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl) = carry_t
+
+    assert np.array_equal(np.asarray(ref.last_time), np.asarray(lt))
+    # sess_start/clicks only meaningful where a session is open
+    open_ = np.asarray(ref.last_time) >= 0
+    assert np.array_equal(np.asarray(ref.sess_start)[open_],
+                          np.asarray(ss)[open_])
+    assert np.array_equal(np.asarray(ref.clicks)[open_],
+                          np.asarray(ck)[open_])
+    assert int(ref.watermark) == int(wm)
+    assert int(ref.dropped) == int(dr)
+    assert np.array_equal(np.asarray(ref_cms.table), np.asarray(table))
+    assert int(ref_cms.total) == int(total)
+    assert _ring_dict(ref_tk) == _ring_dict(cms.TopKState(tkk, tke))
+    assert ref_closed == int(cn)
+
+
+def test_sharded_session_scan_matches_step_sequence():
+    mesh, batches = _session_mesh_setup((4, 2), seed=9)
+    U, M = 64, 256
+    gap, late = 15_000, 20_000
+
+    step_fn = _build_session_step(mesh, gap, late, U)
+    scan_fn = _build_session_scan(mesh, gap, late, U)
+
+    init = (jnp.full((U,), -1, jnp.int32), jnp.zeros((U,), jnp.int32),
+            jnp.zeros((U,), jnp.int32), jnp.int32(0), jnp.int32(0),
+            jnp.zeros((4, 256), jnp.int32), jnp.int32(0),
+            jnp.full((M,), -1, jnp.int32), jnp.full((M,), -1, jnp.int32),
+            jnp.int32(0), jnp.int32(0))
+
+    seq = init
+    for user, et, tm, valid in batches:
+        seq = step_fn(*seq, user, et, tm, valid)
+
+    cols = [np.stack(c) for c in zip(*batches)]
+    sc = scan_fn(*init, *cols)
+
+    for a, b in zip(seq, sc):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (a, b)
+
+
+def test_sharded_hll_engine_end_to_end(tmp_path):
+    """ShardedHLLEngine through the real runner: estimates equal the
+    single-device HLL engine's on the same journal."""
+    cfg = default_config(jax_batch_size=256, jax_window_slots=16)
+    broker = FileBroker(str(tmp_path / "broker"))
+    r1 = as_redis(FakeRedisStore())
+    gen.do_setup(r1, cfg, broker=broker, events_num=8_000,
+                 rng=random.Random(5), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+
+    mesh = build_mesh(data=4, campaign=2)
+    eng = ShardedHLLEngine(cfg, mapping, mesh, redis=r1)
+    stats = StreamRunner(eng, broker.reader(cfg.kafka_topic)).run_catchup()
+    eng.close()
+    assert stats.events == 8_000
+    assert eng.dropped == 0
+
+    r2 = as_redis(FakeRedisStore())
+    from streambench_tpu.io.redis_schema import seed_campaigns
+    seed_campaigns(r2, gen.load_ids(str(tmp_path))[0])
+    ref = HLLDistinctEngine(cfg, mapping, redis=r2)
+    StreamRunner(ref, broker.reader(cfg.kafka_topic)).run_catchup()
+    ref.close()
+
+    from streambench_tpu.io.redis_schema import read_seen_counts
+    assert read_seen_counts(r1) == read_seen_counts(r2)
+
+
+def test_sharded_session_engine_end_to_end(tmp_path):
+    """ShardedSessionCMSEngine through the real runner: heavy hitters and
+    counters equal the single-device engine's on the same journal."""
+    cfg = default_config(jax_batch_size=256)
+    broker = FileBroker(str(tmp_path / "broker"))
+    r1 = as_redis(FakeRedisStore())
+    gen.do_setup(r1, cfg, broker=broker, events_num=8_000,
+                 rng=random.Random(6), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+
+    mesh = build_mesh(data=2, campaign=4)
+    eng = ShardedSessionCMSEngine(cfg, mapping, mesh, redis=r1,
+                                  user_capacity=1 << 10)
+    stats = StreamRunner(eng, broker.reader(cfg.kafka_topic)).run_catchup()
+    eng.close()
+    assert stats.events == 8_000
+
+    ref = SessionCMSEngine(cfg, mapping, redis=as_redis(FakeRedisStore()),
+                           user_capacity=1 << 10)
+    StreamRunner(ref, broker.reader(cfg.kafka_topic)).run_catchup()
+    ref.close()
+
+    assert eng.sessions_closed == ref.sessions_closed
+    assert eng.session_clicks == ref.session_clicks
+    assert sorted(eng.heavy_hitters()) == sorted(ref.heavy_hitters())
