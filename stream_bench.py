@@ -202,7 +202,11 @@ def op_setup() -> None:
     """Write localConf.yaml from env vars (stream-bench.sh:123-138) and
     pre-build the native encoder (the only thing to 'compile')."""
     os.makedirs(WORKDIR, exist_ok=True)
-    _clean_broker_dir()  # start from a fresh journal, don't pile on tmpfs
+    # Start from a fresh journal (don't pile on tmpfs) — EXCEPT on a
+    # checkpoint-resume run: the snapshot's byte offsets index THIS
+    # journal, and wiping it would make resume read nothing or garbage.
+    if not CHECKPOINT_DIR:
+        _clean_broker_dir()
     sys.path.insert(0, REPO_ROOT)
     from streambench_tpu.config import write_local_conf
     write_local_conf(CONF_FILE, {
@@ -240,8 +244,11 @@ def op_start_redis() -> None:
                                  "--port", str(REDIS_PORT)))
     _wait_redis()
     # seed campaigns, like `lein run -n` right after redis start
-    # (stream-bench.sh:182-186)
-    rc = _run_tool(_datagen("-n"), "seed")
+    # (stream-bench.sh:182-186).  A checkpoint-resume run must NOT
+    # regenerate ids: snapshots and journaled events are keyed to the
+    # existing campaign/ad ids, so seed from the workdir files.
+    seed_args = ["-n", "--reuse-ids"] if CHECKPOINT_DIR else ["-n"]
+    rc = _run_tool(_datagen(*seed_args), "seed")
     if rc != 0:
         raise SystemExit(f"redis seeding failed (rc={rc})")
 
@@ -321,7 +328,12 @@ def op_stop_jax_processing() -> None:
 
 
 def op_jax_test() -> None:
-    """Composite run, same sequence as FLINK_TEST (stream-bench.sh:301-315)."""
+    """Composite run, same sequence as FLINK_TEST (stream-bench.sh:301-315).
+    ``MICROBATCH=1`` routes to the micro-batch composite, so
+    ``ENGINE=hll MICROBATCH=1 CHECKPOINT_DIR=... JAX_TEST`` composes."""
+    if MICROBATCH:
+        op_jax_microbatch_test()
+        return
     op_setup()
     op_start_redis()
     op_start_jax_processing()
@@ -338,9 +350,13 @@ def op_jax_microbatch() -> None:
     foreground catchup over the journaled topic (the fork replays its
     events file the same way, ``AdvertisingTopologyNative.java:97-99``),
     dumping the fork-format latency hash to Redis."""
-    rc = _run_tool(_py("streambench_tpu.engine", "--confPath", CONF_FILE,
-                       "--workdir", WORKDIR, "--brokerDir", BROKER_DIR,
-                       "--microbatch"), "microbatch")
+    args = ["--confPath", CONF_FILE, "--workdir", WORKDIR,
+            "--brokerDir", BROKER_DIR, "--microbatch"]
+    if ENGINE != "exact":
+        args += ["--engine", ENGINE]
+    if CHECKPOINT_DIR:
+        args += ["--checkpointDir", CHECKPOINT_DIR]
+    rc = _run_tool(_py("streambench_tpu.engine", *args), "microbatch")
     if rc != 0:
         raise SystemExit(f"microbatch run failed (rc={rc})")
 

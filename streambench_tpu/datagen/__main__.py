@@ -31,6 +31,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Check catchup-mode data was processed correctly")
     p.add_argument("-n", "--new", action="store_true",
                    help="Set up redis for a new real-time simulation")
+    p.add_argument("--reuse-ids", action="store_true",
+                   help="with -n: seed from the workdir's existing "
+                        "campaign/ad id files instead of regenerating "
+                        "(checkpoint resume: snapshots and journaled "
+                        "events are keyed to those ids)")
     p.add_argument("-r", "--run", action="store_true",
                    help="Emit events to the broker at a fixed frequency")
     p.add_argument("-t", "--throughput", type=int, default=0,
@@ -99,10 +104,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"CORRECT={correct} DIFFER={differ} MISSING={missing}")
         return 0 if differ == 0 and missing == 0 else 1
     elif args.new:
-        gen.do_new_setup(redis(), num_campaigns=cfg.jax_num_campaigns,
-                         ads_per_campaign=cfg.jax_ads_per_campaign,
-                         workdir=args.workdir)
-        print("Writing campaigns data to Redis.")
+        if args.reuse_ids and gen.do_reseed(redis(),
+                                            workdir=args.workdir):
+            print("Writing campaigns data to Redis (existing ids).")
+        else:
+            gen.do_new_setup(redis(), num_campaigns=cfg.jax_num_campaigns,
+                             ads_per_campaign=cfg.jax_ads_per_campaign,
+                             workdir=args.workdir)
+            print("Writing campaigns data to Redis.")
     elif args.run:
         if args.throughput <= 0:
             print("-r requires -t THROUGHPUT > 0")

@@ -265,6 +265,24 @@ def do_new_setup(r: RedisLike, num_campaigns: int = 100,
     return campaigns
 
 
+def do_reseed(r: RedisLike, workdir: str = ".") -> list[str] | None:
+    """Re-seed Redis from the EXISTING workdir id files — the
+    checkpoint-resume path.  A resumed engine's snapshot (window state,
+    sketch rows) and the journaled events are keyed to these exact ids;
+    regenerating them (``do_new_setup``) would silently unkey both: every
+    replayed event's ad would join to campaign -1 and the resumed run
+    would fold empty windows.  Returns None when no id files exist (the
+    caller falls back to a fresh ``do_new_setup``)."""
+    ids = load_ids(workdir)
+    if ids is None:
+        return None
+    campaigns, ads = ids
+    seed_campaigns(r, campaigns)
+    mapping = write_ad_mapping_file(campaigns, ads, workdir)
+    seed_ad_mapping(r, mapping)
+    return campaigns
+
+
 def do_setup(r: RedisLike | None, cfg: BenchmarkConfig,
              broker: FileBroker | None = None,
              events_num: int | None = None,

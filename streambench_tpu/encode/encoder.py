@@ -28,9 +28,18 @@ int32 (TPU-friendly; JAX x64 stays off): 2^31 ms of relative room ~= 24 days.
 from __future__ import annotations
 
 import json
+import zlib
 from dataclasses import dataclass
 
 import numpy as np
+
+
+def _id_hash32(b: bytes) -> int:
+    """Stateless 32-bit id hash (crc32, as a signed int32 bit pattern).
+    Must stay bit-identical to ``crc32b`` in native/encoder.cpp — the
+    differential tests pin this."""
+    c = zlib.crc32(b)
+    return c - (1 << 32) if c & 0x80000000 else c
 
 AD_TYPES = ("banner", "modal", "sponsored-search", "mail", "mobile")
 EVENT_TYPES = ("view", "click", "purchase")
@@ -90,6 +99,17 @@ class EventEncoder:
         cost after tokenization, and the columns then carry zeros."""
         self.intern_ids = bool(on)
 
+    def set_hash_ids(self, on: bool) -> None:
+        """STATELESS id columns: user/page_idx = crc32 of the id bytes
+        instead of intern indices.  For kernels that only need a
+        well-mixed identity (HLL cardinality — which splitmix-hashes the
+        column anyway, so a 32-bit string hash loses nothing), this makes
+        the columns consistent across independent encoders (parallel
+        encode pools, micro-batch partitions) and across process
+        restarts, with no intern table to snapshot.  Kernels that index
+        arrays by the column (session rows) must keep interning."""
+        self.hash_ids = bool(on)
+
     def __init__(self, ad_to_campaign: dict[str, str],
                  campaigns: list[str] | None = None,
                  divisor_ms: int = 10_000, lateness_ms: int = 60_000):
@@ -115,6 +135,7 @@ class EventEncoder:
         self.user_index: dict[bytes, int] = {}
         self.page_index: dict[bytes, int] = {}
         self.intern_ids = True
+        self.hash_ids = False
         self.base_time_ms: int | None = None
         self.fallback_lines = 0
         self.bad_lines = 0
@@ -247,7 +268,10 @@ class EventEncoder:
             ad_idx[i] = self._ad_lookup(ad)
             etype[i] = EVENT_TYPE_INDEX_B.get(et, -1)
             etime[i] = t - self.base_time_ms
-            if self.intern_ids:
+            if self.hash_ids:
+                user_idx[i] = _id_hash32(u)
+                page_idx[i] = _id_hash32(p)
+            elif self.intern_ids:
                 user_idx[i] = self._intern(self.user_index, u)
                 page_idx[i] = self._intern(self.page_index, p)
             ad_type[i] = AD_TYPE_INDEX_B.get(at, -1)
@@ -292,7 +316,10 @@ class EventEncoder:
             ad_idx[n] = self._ad_lookup(ad)
             etype[n] = EVENT_TYPE_INDEX_B.get(et, -1)
             etime[n] = ti - self.base_time_ms
-            if self.intern_ids:
+            if self.hash_ids:
+                user_idx[n] = _id_hash32(u)
+                page_idx[n] = _id_hash32(p)
+            elif self.intern_ids:
                 user_idx[n] = self._intern(self.user_index, u)
                 page_idx[n] = self._intern(self.page_index, p)
             ad_type[n] = AD_TYPE_INDEX_B.get(at, -1)

@@ -59,6 +59,10 @@ class NativeEventEncoder(EventEncoder):
         super().set_intern_ids(on)
         self._lib.sb_encoder_set_intern_ids(self._enc, 1 if on else 0)
 
+    def set_hash_ids(self, on: bool) -> None:
+        super().set_hash_ids(on)  # python encode_tbl fallback shares it
+        self._lib.sb_encoder_set_hash_ids(self._enc, 1 if on else 0)
+
     def set_base_time(self, base_time_ms: int | None) -> None:
         super().set_base_time(base_time_ms)
         self._lib.sb_encoder_set_base_time(

@@ -98,19 +98,23 @@ def main(argv: list[str] | None = None) -> int:
     else:
         redis = RespClient(cfg.redis_host, cfg.redis_port)
 
-    if args.microbatch and (args.checkpointDir or args.engine != "exact"):
-        raise SystemExit("--microbatch is its own execution mode: drop "
-                         "--checkpointDir/--engine")
     if args.microbatch:
+        if args.engine in ("sliding", "session"):
+            raise SystemExit(
+                f"--microbatch has no count-window form of --engine "
+                f"{args.engine} (sliding needs a time axis, session a gap "
+                f"axis); supported: exact, hll")
         from streambench_tpu.engine.microbatch import run_microbatch
 
         broker = make_broker(cfg.kafka_bootstrap_servers,
                              args.brokerDir
                              or os.path.join(args.workdir, "broker"))
-        merged, results = run_microbatch(cfg, broker, mapping,
-                                         campaigns=campaigns, redis=redis)
+        merged, results = run_microbatch(
+            cfg, broker, mapping, campaigns=campaigns, redis=redis,
+            engine=args.engine, checkpoint_dir=args.checkpointDir)
         lats = sorted(lat for r in results for lat in r.latency.values())
         print(json.dumps({
+            "engine": args.engine,
             "windows": len(merged),
             "events": sum(r.events for r in results),
             "partitions": len(results),

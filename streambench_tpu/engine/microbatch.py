@@ -37,6 +37,10 @@ consume their own broker partition — real data parallelism.
 from __future__ import annotations
 
 import functools
+import glob
+import hashlib
+import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -49,6 +53,7 @@ from streambench_tpu.config import BenchmarkConfig
 from streambench_tpu.encode.native_encoder import make_encoder
 from streambench_tpu.io.journal import FileBroker
 from streambench_tpu.io.redis_schema import RedisLike, dump_latency_hash
+from streambench_tpu.ops import hll
 from streambench_tpu.utils.ids import now_ms
 
 
@@ -64,15 +69,27 @@ class LocalWindowBarrier:
     fork's "last HINCRBY arrival" owner plays.
     """
 
-    def __init__(self, n_partitions: int, timeout_s: float = 60.0):
+    def __init__(self, n_partitions: int, timeout_s: float = 60.0,
+                 on_window=None):
         self._stamps: dict[int, int] = {}
         self._timeout = timeout_s
         self.ended = False  # abort() was an end-of-stream, not a timeout
+        self._on_window = on_window
+        # Resume support: a run restored from a window-k checkpoint keeps
+        # window ordinals, but this barrier's generations restart at 0 —
+        # base_window rebases stamp keys so arrive(k + g) finds them.
+        self.base_window = 0
         self._barrier = threading.Barrier(n_partitions, action=self._stamp)
 
     def _stamp(self) -> None:
         # generations are sequential: all partitions are at window k here
-        self._stamps[len(self._stamps)] = now_ms()
+        k = self.base_window + len(self._stamps)
+        self._stamps[k] = now_ms()
+        if self._on_window is not None:
+            # Every partition is parked in wait(): windows 0..k-1 are
+            # fully folded and no result dict can mutate concurrently —
+            # the one quiescent point in the run (used for checkpoints).
+            self._on_window(k)
 
     def arrive(self, window_idx: int) -> int:
         try:
@@ -211,6 +228,74 @@ def window_campaign_counts(join_table, ad_idx, event_type, valid,
     return jnp.zeros((num_campaigns,), jnp.int32).at[idx].add(1, mode="drop")
 
 
+@functools.partial(jax.jit, static_argnames=("num_campaigns",
+                                             "num_registers", "view_type"))
+def window_campaign_hll(join_table, ad_idx, user_idx, event_type, valid,
+                        *, num_campaigns: int, num_registers: int,
+                        view_type: int = 0):
+    """One micro-batch window -> per-campaign HLL registers ``[C, R]``.
+
+    The sketch variant of ``window_campaign_counts`` (BASELINE config #2
+    under the fork's count-window mode): the scatter-add becomes a
+    scatter-max of splitmix ranks.  Partition partials merge by
+    elementwise max — the pmax-shaped unifier — and estimates are taken
+    from the merged registers per window.
+    """
+    C, R = num_campaigns, num_registers
+    p = R.bit_length() - 1
+    campaign = join_table[ad_idx]
+    mask = valid & (event_type == view_type) & (campaign >= 0)
+    h = hll.splitmix32(user_idx)
+    j = (h & jnp.uint32(R - 1)).astype(jnp.int32)
+    rank = hll._rank(h, p)
+    flat = jnp.where(mask, campaign * R + j, C * R)
+    return (jnp.zeros((C * R,), jnp.int32)
+            .at[flat].max(rank, mode="drop").reshape(C, R))
+
+
+class _EngineFamily:
+    """Per-window fold + cross-partition merge for one engine family."""
+
+    def __init__(self, name: str, fold, merge, finalize):
+        self.name = name
+        self.fold = fold          # (encoder_batch) -> np.ndarray
+        self.merge = merge        # (partial, partial) -> partial
+        self.finalize = finalize  # merged partial -> [C] int counts
+
+
+def _make_family(name: str, encoder, join_table_dev,
+                 registers: int = 128) -> _EngineFamily:
+    C = encoder.num_campaigns
+    if name == "exact":
+        return _EngineFamily(
+            "exact",
+            fold=lambda b: np.asarray(window_campaign_counts(
+                join_table_dev, b.ad_idx, b.event_type, b.valid,
+                num_campaigns=C)),
+            merge=lambda a, b: a + b,
+            finalize=lambda m: m)
+    if name == "hll":
+        if registers & (registers - 1):
+            raise ValueError("num_registers must be a power of two")
+        # Stateless id hashing: per-partition encoders would otherwise
+        # intern the same user to different indices, and the register
+        # merge across partitions would count one user several times.
+        encoder.set_hash_ids(True)
+        return _EngineFamily(
+            "hll",
+            fold=lambda b: np.asarray(window_campaign_hll(
+                join_table_dev, b.ad_idx, b.user_idx, b.event_type,
+                b.valid, num_campaigns=C, num_registers=registers)),
+            merge=np.maximum,
+            finalize=lambda m: np.asarray(
+                jnp.round(hll.estimate(jnp.asarray(m)))).astype(np.int64))
+    raise ValueError(
+        f"micro-batch mode supports engine families 'exact' and 'hll'; "
+        f"'{name}' has no count-window form (sliding windows need a time "
+        f"axis and session windows a gap axis — the fork's mode is "
+        f"count-based, AdvertisingTopologyNative.java:200-201)")
+
+
 # ----------------------------------------------------------------------
 # per-partition mapper + multi-partition driver
 # ----------------------------------------------------------------------
@@ -231,6 +316,9 @@ class PartitionResult:
     stamps: dict[int, int] = field(default_factory=dict)
     # window start stamp -> last observed latency (now - start), fork style
     latency: dict[int, int] = field(default_factory=dict)
+    # window index -> broker byte offset after the window's last line
+    # (the checkpoint unit: resume re-opens the reader here)
+    offsets: dict[int, int] = field(default_factory=dict)
 
     @property
     def running_time_ms(self) -> int:
@@ -242,7 +330,8 @@ class MicroBatchMapper:
     fold the window on device, record latency."""
 
     def __init__(self, cfg: BenchmarkConfig, encoder, join_table_dev,
-                 barrier, partition: int, input_format: str = "json"):
+                 barrier, partition: int, input_format: str = "json",
+                 family: _EngineFamily | None = None):
         if cfg.window_size % cfg.map_partitions:
             raise ValueError(
                 f"window.size {cfg.window_size} not divisible by "
@@ -251,6 +340,8 @@ class MicroBatchMapper:
         self.encoder = encoder
         self.join_table_dev = join_table_dev
         self.barrier = barrier
+        self.family = family or _make_family("exact", encoder,
+                                             join_table_dev)
         # "json" for generator journals; "tbl" for the fork's pipe-separated
         # events files (AdvertisingTopologyNative.java:210: "u|p|ad|...")
         self._encode = (encoder.encode if input_format == "json"
@@ -258,22 +349,23 @@ class MicroBatchMapper:
         self.result = PartitionResult(partition)
         self._buf: list[bytes] = []
         self._window_idx = 0
+        self._bytes = 0  # broker bytes consumed (lines + newlines)
 
     def feed(self, lines: list[bytes]) -> None:
         for line in lines:
             self._buf.append(line)
+            self._bytes += len(line) + 1
             if len(self._buf) == self.partition_size:
                 self._close_window()
 
     def _close_window(self) -> None:
         start = self.barrier.arrive(self._window_idx)
         batch = self._encode(self._buf, self.partition_size)
-        counts = np.asarray(window_campaign_counts(
-            self.join_table_dev, batch.ad_idx, batch.event_type,
-            batch.valid, num_campaigns=self.encoder.num_campaigns))
+        counts = self.family.fold(batch)
         r = self.result
         r.counts[self._window_idx] = counts
         r.stamps[self._window_idx] = start
+        r.offsets[self._window_idx] = self._bytes
         done = now_ms()
         r.latency[start] = done - start
         if not r.started_ms:
@@ -291,22 +383,136 @@ class MicroBatchMapper:
         return len(self._buf)
 
 
+class MicroBatchCheckpointer:
+    """Window-boundary snapshots of a micro-batch run, as INCREMENTAL
+    chunks.
+
+    Chunk ``mb-<k>.npz`` holds, per partition, only the windows since
+    the previous chunk (``[k_from, k)``): their stacked partials and
+    stamps, plus the cumulative small state (latency map, counters, and
+    the broker byte offset after window ``k-1``'s last line).  Chunking
+    keeps each save O(windows since last save) — a full-history rewrite
+    would grow O(k) per save inside the window barrier's action, whose
+    waiters carry a 60 s timeout, and would bill ever-growing fsync
+    pauses to measured windows.  Snapshots are written inside the
+    barrier action (the one quiescent point: all partitions parked,
+    windows ``0..k-1`` final), so they need no locking; single-process
+    (``LocalWindowBarrier``) runs only.  ``load`` replays the chunk
+    chain (contiguity checked) and seeds the run to continue at the
+    last chunk's ``k``.
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self._saved_upto = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _files(self) -> list[str]:
+        return sorted(glob.glob(os.path.join(self.dir, "mb-*.npz")))
+
+    def save(self, k: int, mappers, meta: dict) -> None:
+        k0 = self._saved_upto
+        if k <= k0:
+            return  # resumed run re-arrives at an already-saved window
+        arrays: dict[str, np.ndarray] = {}
+        per_part = []
+        for m in mappers:
+            r = m.result
+            arrays[f"counts_{r.partition}"] = np.stack(
+                [r.counts[w] for w in range(k0, k)])
+            per_part.append({
+                "partition": r.partition,
+                "stamps": [r.stamps[w] for w in range(k0, k)],
+                "latency": sorted(r.latency.items()),
+                "offset": r.offsets[k - 1],
+                "events": r.events, "windows": r.windows,
+                "started_ms": r.started_ms, "finished_ms": r.finished_ms,
+            })
+        path = os.path.join(self.dir, f"mb-{k:08d}.npz")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, meta=np.frombuffer(json.dumps(
+                {"k_from": k0, "k": k, "parts": per_part, **meta}
+            ).encode(), np.uint8), **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._saved_upto = k
+
+    def load(self) -> tuple[int, dict, dict[int, np.ndarray]] | None:
+        files = self._files()
+        if not files:
+            return None
+        chunks: dict[int, list[np.ndarray]] = {}
+        stamps: dict[int, list[int]] = {}
+        expect = 0
+        meta = None
+        for path in files:
+            with np.load(path) as z:
+                meta = json.loads(z["meta"].tobytes().decode())
+                if meta["k_from"] != expect:
+                    raise ValueError(
+                        f"checkpoint chain broken at {path}: chunk starts "
+                        f"at window {meta['k_from']}, expected {expect} "
+                        f"(missing/deleted chunk file?)")
+                expect = meta["k"]
+                for p in meta["parts"]:
+                    chunks.setdefault(p["partition"], []).append(
+                        z[f"counts_{p['partition']}"])
+                    stamps.setdefault(p["partition"], []).extend(
+                        p["stamps"])
+        for p in meta["parts"]:
+            p["stamps"] = stamps[p["partition"]]
+        counts = {part: np.concatenate(cs) for part, cs in chunks.items()}
+        self._saved_upto = meta["k"]
+        return meta["k"], meta, counts
+
+    def seed(self, mappers, meta: dict,
+             counts: dict[int, np.ndarray]) -> None:
+        """Restore mapper state from a loaded snapshot (before threads
+        start).  Readers must then be opened at each result's
+        ``offsets[k-1]``."""
+        k = meta["k"]
+        for m, pm in zip(mappers, meta["parts"]):
+            r = m.result
+            assert r.partition == pm["partition"]
+            for w in range(k):
+                r.counts[w] = counts[r.partition][w]
+                r.stamps[w] = pm["stamps"][w]
+            r.latency.update({int(s): int(l) for s, l in pm["latency"]})
+            r.offsets[k - 1] = pm["offset"]
+            r.events = pm["events"]
+            r.windows = pm["windows"]
+            r.started_ms = pm["started_ms"]
+            r.finished_ms = pm["finished_ms"]
+            m._window_idx = k
+            m._bytes = pm["offset"]
+
+
 def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
                    ad_to_campaign: dict[str, str],
                    campaigns: list[str] | None = None,
                    redis: RedisLike | None = None,
                    barrier=None,
                    max_windows: int | None = None,
-                   input_format: str = "json"
+                   input_format: str = "json",
+                   engine: str = "exact",
+                   registers: int = 128,
+                   checkpoint_dir: str | None = None,
+                   checkpoint_every: int = 16,
                    ) -> tuple[dict[int, np.ndarray], list[PartitionResult]]:
     """Drive ``map.partitions`` mapper threads over the broker topic.
 
     Returns ``(merged, results)``: merged per-campaign counts keyed by
-    window ordinal (partition partials summed — the unifier /
-    ``reduce.partitions`` role, the host analog of the psum merge) and
-    the per-partition results.
+    window ordinal (partition partials summed for exact counts, register
+    pmax + estimate for ``engine="hll"`` — the unifier /
+    ``reduce.partitions`` role, the host analog of the psum/pmax merge)
+    and the per-partition results.
     When ``redis`` is given, each partition dumps its latency map in the
     fork's hash format at close.
+    ``checkpoint_dir`` enables window-boundary snapshots every
+    ``checkpoint_every`` windows and resume-from-newest on entry
+    (single-process runs only).
     """
     P = cfg.map_partitions
     have = set(broker.partitions(cfg.kafka_topic))
@@ -316,7 +522,44 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
             f"map.partitions={P} but broker topic '{cfg.kafka_topic}' has "
             f"no partition(s) {missing} (found {sorted(have)}); generate "
             f"the dataset with a matching partition count")
-    barrier = barrier or LocalWindowBarrier(P)
+    ckpt = MicroBatchCheckpointer(checkpoint_dir) if checkpoint_dir else None
+    if ckpt is not None and barrier is not None:
+        raise ValueError(
+            "micro-batch checkpointing requires the in-process barrier "
+            "(snapshots are taken in its action, where all partitions are "
+            "quiescent); it does not compose with a custom/Redis barrier")
+    if ckpt is not None:
+        # The id digest binds the snapshot to the campaign/ad universe its
+        # count columns are keyed to: resuming against regenerated ids
+        # (e.g. lost workdir files + a fresh -n seed) would otherwise
+        # silently merge restored rows with columns for DIFFERENT
+        # campaigns.
+        h = hashlib.sha1()
+        for ad, c in sorted(ad_to_campaign.items()):
+            h.update(f"{ad}>{c};".encode())
+        for c in campaigns or ():
+            h.update(f"#{c}".encode())
+        mb_meta = {"engine": engine, "window_size": cfg.window_size,
+                   "map_partitions": P,
+                   "registers": registers if engine == "hll" else 0,
+                   "ids_digest": h.hexdigest()[:16]}
+        loaded = ckpt.load()
+        if loaded is not None and loaded[1] is not None:
+            got = {key: loaded[1].get(key) for key in mb_meta}
+            if got != mb_meta:
+                raise ValueError(
+                    f"checkpoint geometry {got} != run config {mb_meta}; "
+                    f"restart with the original config or a fresh "
+                    f"checkpoint dir")
+
+        def on_window(k: int) -> None:
+            if k and k % checkpoint_every == 0:
+                ckpt.save(k, mappers, mb_meta)
+
+        barrier = LocalWindowBarrier(P, on_window=on_window)
+    else:
+        loaded = None
+        barrier = barrier or LocalWindowBarrier(P)
     # THE single reset point (see RedisWindowBarrier docstring): clear any
     # prior run's residue before the first partition can arrive.
     barrier.reset()
@@ -331,17 +574,37 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
                              use_native=cfg.jax_use_native_encoder)
                 for _ in range(P)]
     join_table_dev = jnp.asarray(encoders[0].join_table)
+    families = [_make_family(engine, encoders[p], join_table_dev,
+                             registers=registers) for p in range(P)]
     mappers = [MicroBatchMapper(cfg, encoders[p], join_table_dev, barrier, p,
-                                input_format=input_format)
+                                input_format=input_format,
+                                family=families[p])
                for p in range(P)]
+    resume_offsets = [0] * P
+    if ckpt is not None and loaded is not None:
+        k0, meta0, counts0 = loaded
+        ckpt.seed(mappers, meta0, counts0)
+        resume_offsets = [m.result.offsets[k0 - 1] if k0 else 0
+                          for m in mappers]
+        # the barrier's stamp generations restart at 0; rebase them so
+        # arrive(window_idx=k0...) finds its stamps
+        barrier.base_window = k0
     # Warm the kernel before spawning threads: P mappers would otherwise
     # race into the same first jit-compile concurrently (tracing is not
     # reliably thread-safe for an identical fresh signature).
     psize = mappers[0].partition_size
-    window_campaign_counts(
-        join_table_dev, np.zeros(psize, np.int32),
-        np.full(psize, -1, np.int32), np.zeros(psize, bool),
-        num_campaigns=encoders[0].num_campaigns).block_until_ready()
+    C = encoders[0].num_campaigns
+    if engine == "hll":
+        window_campaign_hll(
+            join_table_dev, np.zeros(psize, np.int32),
+            np.zeros(psize, np.int32), np.full(psize, -1, np.int32),
+            np.zeros(psize, bool), num_campaigns=C,
+            num_registers=registers).block_until_ready()
+    else:
+        window_campaign_counts(
+            join_table_dev, np.zeros(psize, np.int32),
+            np.full(psize, -1, np.int32), np.zeros(psize, bool),
+            num_campaigns=C).block_until_ready()
 
     limit = max_windows * psize if max_windows else None
     errors: list[BaseException] = []
@@ -349,6 +612,8 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
     def drive(p: int) -> None:
         try:
             with broker.reader(cfg.kafka_topic, p) as reader:
+                if resume_offsets[p]:
+                    reader.seek(resume_offsets[p])
                 fed = 0
                 while True:
                     want = (min(4096, limit - fed)
@@ -378,13 +643,15 @@ def run_microbatch(cfg: BenchmarkConfig, broker: FileBroker,
     if errors:
         raise errors[0]
 
+    fam = families[0]
     merged: dict[int, np.ndarray] = {}
     for m in mappers:
-        for k, counts in m.result.counts.items():
+        for k, partial in m.result.counts.items():
             if k in merged:
-                merged[k] = merged[k] + counts
+                merged[k] = fam.merge(merged[k], partial)
             else:
-                merged[k] = counts
+                merged[k] = partial
+    merged = {k: fam.finalize(v) for k, v in merged.items()}
 
     if redis is not None and cfg.redis_hashtable:
         for m in mappers:

@@ -212,7 +212,9 @@ class AdAnalyticsEngine:
                              divisor_ms=self.divisor,
                              lateness_ms=self.lateness,
                              use_native=cfg.jax_use_native_encoder)
-            if not self.NEEDS_INTERNED_IDS:
+            if self.HASHED_IDS:
+                e.set_hash_ids(True)
+            elif not self.NEEDS_INTERNED_IDS:
                 e.set_intern_ids(False)
             return e
 
@@ -292,6 +294,11 @@ class AdAnalyticsEngine:
     # When False, the encoder skips interning entirely (two hash probes
     # per row — the biggest per-event encode cost after tokenization).
     NEEDS_INTERNED_IDS = False
+    # Stateless crc32 id columns instead of intern indices (wins over
+    # NEEDS_INTERNED_IDS).  For kernels that only need a well-mixed
+    # identity (HLL): consistent across pool workers and restarts, no
+    # intern table in snapshots, parallel encode stays sound.
+    HASHED_IDS = False
 
     # ------------------------------------------------------------------
     def warmup(self) -> None:

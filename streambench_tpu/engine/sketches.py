@@ -109,8 +109,16 @@ class HLLDistinctEngine(_SketchEngineBase):
         self.state = hll.init_state(self.encoder.num_campaigns, self.W,
                                     num_registers=registers)
 
-    # HLL has a scanned kernel; the intern consistency the sketch base
-    # guards against lives in the SHARED encoder (pool stays off).
+    # HLL consumes user identity only through a hash (the kernel
+    # splitmix-mixes the column anyway), so the encoder emits stateless
+    # crc32 ids: consistent across pool workers and process restarts.
+    # That unwinds both sketch-base restrictions — the parallel encode
+    # pool is sound again, and snapshots need no intern tables (legacy
+    # snapshots with tables still restore; estimates for windows
+    # spanning an OLD intern-keyed snapshot may recount users once).
+    HASHED_IDS = True
+    NEEDS_INTERNED_IDS = False
+    PARALLEL_ENCODE_OK = True
     SCAN_SUPPORTED = True
     SCAN_COLUMNS = ("ad_idx", "user_idx", "event_type", "event_time",
                     "valid")

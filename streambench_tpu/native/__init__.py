@@ -31,6 +31,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sb_encoder_base_time.argtypes = [c_p]
     lib.sb_encoder_set_base_time.argtypes = [c_p, c_i64]
     lib.sb_encoder_set_intern_ids.argtypes = [c_p, ctypes.c_int32]
+    lib.sb_encoder_set_hash_ids.argtypes = [c_p, ctypes.c_int32]
     lib.sb_encoder_n_users.restype = c_i64
     lib.sb_encoder_n_users.argtypes = [c_p]
     lib.sb_encoder_n_pages.restype = c_i64

@@ -86,6 +86,29 @@ struct StringInterner {
 // and conflating it with "unset" silently re-rebased every batch.
 constexpr int64_t kBaseUnset = INT64_MIN;
 
+// Stateless 32-bit id hash: standard CRC-32 (IEEE reflected), chosen to
+// be bit-identical to Python's zlib.crc32 — the differential tests pin
+// the two implementations against each other.
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+static const Crc32Table kCrc;
+
+static inline int32_t crc32b(const char* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    c = kCrc.t[(c ^ (uint8_t)p[i]) & 0xFF] ^ (c >> 8);
+  return (int32_t)(c ^ 0xFFFFFFFFu);
+}
+
 struct Encoder {
   SvMap ad_index;
   StringInterner users;
@@ -94,6 +117,11 @@ struct Encoder {
   // exact-count kernels never read them, and the two hash probes per
   // row are the single largest per-event cost after tokenization.
   bool intern_ids = true;
+  // When true (wins over intern_ids), user/page columns carry crc32 of
+  // the id bytes: stateless, so independent encoders (pool workers,
+  // micro-batch partitions) and restarted processes agree without any
+  // intern-table snapshot.  For hash-consuming kernels (HLL) only.
+  bool hash_ids = false;
   int64_t base_time_ms = kBaseUnset;
   int64_t divisor_ms = 10000;
   int64_t lateness_ms = 60000;
@@ -225,7 +253,10 @@ inline int parse_skeleton(Encoder* enc, const char* p, const char* end,
                                            : ad_it->second;
   etype[i] = event_type_code(et);
   etime[i] = static_cast<int32_t>(t - enc->base_time_ms);
-  if (enc->intern_ids) {
+  if (enc->hash_ids) {
+    user_idx[i] = crc32b(user.p, user.len);
+    page_idx[i] = crc32b(page.p, page.len);
+  } else if (enc->intern_ids) {
     user_idx[i] = enc->users.intern(user.p, user.len);
     page_idx[i] = enc->pages.intern(page.p, page.len);
   } else {
@@ -288,7 +319,10 @@ inline int parse_tokens(Encoder* enc, const char* p, const char* end,
                                            : ad_it->second;
   etype[i] = event_type_code(toks[19]);
   etime[i] = static_cast<int32_t>(t - enc->base_time_ms);
-  if (enc->intern_ids) {
+  if (enc->hash_ids) {
+    user_idx[i] = crc32b(toks[3].p, toks[3].len);
+    page_idx[i] = crc32b(toks[7].p, toks[7].len);
+  } else if (enc->intern_ids) {
     user_idx[i] = enc->users.intern(toks[3].p, toks[3].len);
     page_idx[i] = enc->pages.intern(toks[7].p, toks[7].len);
   } else {
@@ -344,6 +378,12 @@ void sb_encoder_set_base_time(void* enc, int64_t base) {
 // kernels never read those columns; 1 (default) re-enables it.
 void sb_encoder_set_intern_ids(void* enc, int32_t on) {
   static_cast<Encoder*>(enc)->intern_ids = on != 0;
+}
+
+// 1 switches user/page columns to stateless crc32 of the id bytes
+// (consistent across encoders/restarts; for hash-consuming kernels).
+void sb_encoder_set_hash_ids(void* enc, int32_t on) {
+  static_cast<Encoder*>(enc)->hash_ids = on != 0;
 }
 
 int64_t sb_encoder_n_users(void* enc) {

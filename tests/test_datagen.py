@@ -129,3 +129,34 @@ def test_get_stats_files(tmp_path):
     assert stats == [(5, 3000)]
     assert (tmp_path / "seen.txt").read_text() == "5\n"
     assert (tmp_path / "updated.txt").read_text() == "3000\n"
+
+
+def test_reseed_reuses_existing_ids(tmp_path):
+    """Checkpoint-resume seeding: -n --reuse-ids must keep the workdir's
+    campaign/ad ids (snapshots + journaled events are keyed to them);
+    regenerating would unkey every replayed event (found as zero-count
+    resumed windows in the micro-batch CLI flow)."""
+    import random
+
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.redis_schema import as_redis
+
+    r = as_redis(FakeRedisStore())
+    campaigns = gen.do_new_setup(r, rng=random.Random(3),
+                                 workdir=str(tmp_path))
+    mapping1 = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+
+    r2 = as_redis(FakeRedisStore())
+    got = gen.do_reseed(r2, workdir=str(tmp_path))
+    assert got == campaigns
+    mapping2 = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    assert mapping1 == mapping2
+    assert r2.execute("SMEMBERS", "campaigns") == sorted(campaigns)
+    # and the join table landed
+    some_ad = next(iter(mapping1))
+    assert r2.execute("GET", some_ad) == mapping1[some_ad]
+
+    # no id files -> None (caller falls back to a fresh setup)
+    assert gen.do_reseed(r2, workdir=str(tmp_path / "empty")) is None

@@ -126,3 +126,43 @@ def test_negative_base_time_is_stable_across_batches():
         bn = nat.encode(chunk, 4)
         assert bp.base_time_ms == bn.base_time_ms == -20_000
         assert bp.event_time[0] == bn.event_time[0]
+
+
+def test_hash_ids_mode_differential_and_stateless():
+    """hash-id mode: native and python encoders emit IDENTICAL crc32
+    columns (the cross-partition/restart consistency contract), two
+    independent encoders agree, and the columns match zlib.crc32."""
+    import zlib
+
+    import pytest
+
+    from streambench_tpu import native
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    import numpy as np
+
+    from streambench_tpu.encode.encoder import EventEncoder
+    from streambench_tpu.encode.native_encoder import NativeEventEncoder
+
+    mapping = {"adX": "campX"}
+    mk = lambda u, p: (
+        '{"user_id": "%s", "page_id": "%s", "ad_id": "adX", "ad_type":'
+        ' "mail", "event_type": "view", "event_time": "100000",'
+        ' "ip_address": "1.2.3.4"}' % (u, p)).encode()
+    lines = [mk(f"user-{i % 5}", f"page-{i % 3}") for i in range(20)]
+
+    encs = []
+    for cls in (EventEncoder, NativeEventEncoder, NativeEventEncoder):
+        e = cls(mapping)
+        e.set_hash_ids(True)
+        encs.append(e.encode(lines, 32))
+    for b in encs[1:]:
+        assert np.array_equal(encs[0].user_idx, b.user_idx)
+        assert np.array_equal(encs[0].page_idx, b.page_idx)
+
+    def crc_i32(s: bytes) -> int:
+        c = zlib.crc32(s)
+        return c - (1 << 32) if c & 0x80000000 else c
+
+    assert encs[0].user_idx[0] == crc_i32(b"user-0")
+    assert encs[0].page_idx[1] == crc_i32(b"page-1")
