@@ -22,6 +22,7 @@ from streambench_tpu.encode.encoder import (
     EVENT_TYPE_INDEX,
     EncodedBatch,
     EventEncoder,
+    _id_hash32,
 )
 
 
@@ -169,17 +170,22 @@ class NativeEventEncoder(EventEncoder):
                             if self.base_time_ms is not None else 0)
 
     def encode_block(self, data: bytes, batch_size: int,
-                     start: int = 0) -> tuple[EncodedBatch, int]:
+                     start: int = 0,
+                     end: int | None = None) -> tuple[EncodedBatch, int]:
         """Encode up to ``batch_size`` records straight from a raw
         journal block (complete newline-delimited lines), starting at
-        byte ``start``.  Returns ``(batch, consumed_bytes)``.
+        byte ``start`` and never reading past ``end`` (default: the
+        whole block).  Returns ``(batch, consumed_bytes)``.
 
         This is the zero-copy ingest path: no per-line bytes objects,
         no join/offsets round trip — the C scanner finds record
         boundaries (memchr) and parses in the same pass.  An incomplete
-        trailing record is not consumed.
+        trailing record is not consumed.  The ``end`` bound lets several
+        workers scan disjoint regions of ONE shared block without
+        slicing (a slice would copy megabytes per sub-block).
         """
         B = batch_size
+        bound = len(data) if end is None else min(end, len(data))
         ad_idx = np.zeros(B, np.int32)
         etype = np.full(B, -1, np.int32)
         etime = np.zeros(B, np.int32)
@@ -190,7 +196,7 @@ class NativeEventEncoder(EventEncoder):
         rec_off = np.zeros(B + 1, np.int64)
 
         nl = int(self._lib.sb_encode_block(
-            self._enc, data, len(data), start, B,
+            self._enc, data, bound, start, B,
             _i32p(ad_idx), _i32p(etype), _i32p(etime), _i32p(user_idx),
             _i32p(page_idx), _i32p(ad_type),
             status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -232,16 +238,18 @@ class NativeEventEncoder(EventEncoder):
             consumed
 
     def carve_block(self, data: bytes, batch_size: int, start: int = 0,
-                    max_batches: int | None = None
+                    max_batches: int | None = None,
+                    end: int | None = None
                     ) -> tuple[list[EncodedBatch], int]:
         """Encode consecutive batches out of a raw block: returns the
         non-empty batches plus the offset where consumption stopped
         (either end-of-complete-records or the ``max_batches`` cap).
         The shared carve loop for every block-mode call site."""
+        bound = len(data) if end is None else min(end, len(data))
         batches: list[EncodedBatch] = []
         while ((max_batches is None or len(batches) < max_batches)
-               and start < len(data)):
-            b, consumed = self.encode_block(data, batch_size, start)
+               and start < bound):
+            b, consumed = self.encode_block(data, batch_size, start, bound)
             if consumed <= 0:
                 break
             start += consumed
@@ -267,17 +275,24 @@ class NativeEventEncoder(EventEncoder):
         ad = str(ev.get("ad_id", "")).encode()
         u = str(ev.get("user_id", "")).encode()
         p = str(ev.get("page_id", "")).encode()
+        if self.hash_ids:
+            # the fallback must mirror the fast path's id semantics: an
+            # interned index here would be a phantom distinct user to the
+            # HLL kernel (and could collide with other users' hashes)
+            uid, pid = _id_hash32(u), _id_hash32(p)
+        elif self.intern_ids:
+            uid = self._lib.sb_intern_user(self._enc, u, len(u))
+            pid = self._lib.sb_intern_page(self._enc, p, len(p))
+        else:
+            # stray fallback rows must not grow the maps or break the
+            # zeros invariant when interning is off
+            uid = pid = 0
         return (
             self.ad_index.get(ad, self.unknown_ad),
             EVENT_TYPE_INDEX.get(str(ev.get("event_type", "")), -1),
             t - base,
-            # the fallback honors the interning switch exactly like the
-            # fast path: stray fallback rows must not grow the maps or
-            # break the zeros invariant when interning is off
-            self._lib.sb_intern_user(self._enc, u, len(u))
-            if self.intern_ids else 0,
-            self._lib.sb_intern_page(self._enc, p, len(p))
-            if self.intern_ids else 0,
+            uid,
+            pid,
             AD_TYPE_INDEX.get(str(ev.get("ad_type", "")), -1),
         )
 

@@ -26,23 +26,32 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
+from streambench_tpu.encode.encoder import repack_batches
+
 
 class ParallelEncodePool:
     def __init__(self, primary, factory: Callable[[], object],
                  workers: int = 4):
         self.primary = primary
         self._factory = factory
+        self._workers = max(workers, 1)
         self._tls = threading.local()
-        self._pool = ThreadPoolExecutor(max_workers=max(workers, 1),
+        self._pool = ThreadPoolExecutor(max_workers=self._workers,
                                         thread_name_prefix="encode")
 
-    def _job(self, lines: list[bytes], batch_size: int, base: int):
+    def _worker_enc(self, base: int):
+        """Thread-local worker encoder, base-synced to the primary's
+        rebase origin (shared by the line and block jobs — any new
+        worker-setup step belongs HERE so the two paths cannot drift)."""
         enc = getattr(self._tls, "enc", None)
         if enc is None:
             enc = self._tls.enc = self._factory()
         if enc.base_time_ms != base:
             enc.set_base_time(base)
-        return enc.encode(lines, batch_size)
+        return enc
+
+    def _job(self, lines: list[bytes], batch_size: int, base: int):
+        return self._worker_enc(base).encode(lines, batch_size)
 
     def encode_chunks(self, chunks: list[list[bytes]], batch_size: int):
         """Encode each chunk into an ``EncodedBatch``, order-preserving."""
@@ -65,6 +74,51 @@ class ParallelEncodePool:
         for i, fut in futures:
             out[i] = fut.result()
         return out
+
+    def _job_block(self, data: bytes, batch_size: int, base: int,
+                   start: int, end: int):
+        return self._worker_enc(base).carve_block(
+            data, batch_size, start=start, end=end)[0]
+
+    def carve_block_parallel(self, data: bytes, batch_size: int
+                             ) -> tuple[list, int]:
+        """Carve + parse one raw journal block on all workers.
+
+        Record boundaries are found first (a memchr per cut — ~free),
+        then each worker scans its region of the SHARED block via the
+        start/end bounds (no sub-block copies).  Worker tails are
+        partial batches, so the results are repacked into full batches
+        before the device sees them.  Same (batches, consumed) contract
+        as ``carve_block``; an unterminated trailing record is left
+        unconsumed.
+        """
+        n = len(data)
+        start = 0
+        head: list = []
+        if self.primary.base_time_ms is None and n:
+            # First data ever: establish the shared rebase origin by
+            # encoding one batch on the primary before workers spread out.
+            head, start = self.primary.carve_block(data, batch_size,
+                                                   max_batches=1)
+            if self.primary.base_time_ms is None:
+                return head, start  # all-bad head: no base to share yet
+        base = self.primary.base_time_ms
+        # record-aligned cut points over [start, n)
+        cuts = [start]
+        for i in range(1, self._workers):
+            want = start + (n - start) * i // self._workers
+            pos = data.find(b"\n", max(want, cuts[-1]))
+            cuts.append(pos + 1 if pos >= 0 else n)
+        cuts.append(n)
+        futures = [self._pool.submit(self._job_block, data, batch_size,
+                                     base, a, b)
+                   for a, b in zip(cuts, cuts[1:]) if a < b]
+        batches = head
+        for fut in futures:
+            batches += fut.result()
+        # consumption: everything but an unterminated trailing record
+        nl_end = data.rfind(b"\n") + 1
+        return repack_batches(batches, batch_size), max(start, nl_end)
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

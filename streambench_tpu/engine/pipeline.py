@@ -446,14 +446,14 @@ class AdAnalyticsEngine:
     def supports_block_ingest(self) -> bool:
         """True when raw journal blocks can be encoded without per-line
         Python objects (native encoder + JSON wire format).  Sketch
-        engines inherit False via their Python-pinned encoder.  A
-        configured parallel encode pool also disables block mode: block
-        scanning is single-threaded by design (boundaries are found
-        during the parse), and on multi-core hosts the pooled line path
-        outruns it."""
+        engines with a Python-pinned encoder inherit False.  With a
+        parallel encode pool the block is carved at record boundaries
+        first and parsed on all workers (``carve_block_parallel``), so
+        block ingest and multi-core encoding compose — the round-3
+        either/or (pool XOR block mode) left the fastest ingest path
+        single-threaded."""
         return (hasattr(self.encoder, "encode_block")
-                and self._encode == self.encoder.encode
-                and self._encode_pool is None)
+                and self._encode == self.encoder.encode)
 
     def process_block(self, data: bytes) -> int:
         """Ingest one raw journal block (complete newline-delimited
@@ -475,7 +475,11 @@ class AdAnalyticsEngine:
             return self.events_processed - before
         B = self.batch_size
         with self.tracer.span("encode"):
-            batches, start = self.encoder.carve_block(data, B)
+            if self._encode_pool is not None:
+                batches, start = self._encode_pool.carve_block_parallel(
+                    data, B)
+            else:
+                batches, start = self.encoder.carve_block(data, B)
             if start < len(data):
                 # unterminated trailing record (poll_block never produces
                 # one, but direct callers can): parse it as one line so
