@@ -160,6 +160,7 @@ class HLLDistinctEngine(_SketchEngineBase):
 
     def restore(self, snap) -> None:
         self._check_geometry(snap, extra={"num_registers": self.registers})
+        self._flush_cache = None  # post-restore drains must rewrite all
         self.state = hll.HLLState(
             registers=jnp.asarray(snap.extra["hll_registers"]),
             window_ids=jnp.asarray(snap.window_ids),
@@ -174,12 +175,25 @@ class HLLDistinctEngine(_SketchEngineBase):
         est = np.asarray(est)
         wids = np.asarray(wids)
         base = self.encoder.base_time_ms or 0
+        # Re-flush only CHANGED estimates: an open window whose registers
+        # saw no new user since the last drain must not be re-written —
+        # the rewrite would advance its time_updated every second and the
+        # canonical latency metric (final time_updated - window_ts,
+        # core.clj:149) would read as the window's lifetime in the ring
+        # (up to lateness) instead of its writeback latency.
+        cache = getattr(self, "_flush_cache", None)
+        if cache is None or cache[0].shape != est.shape:
+            cache = (np.zeros_like(est), np.full_like(wids, -2))
+        prev_est, prev_wids = cache
+        fresh_slot = wids != prev_wids               # [W]
+        changed = fresh_slot[None, :] | (est != prev_est)
         for s in np.flatnonzero(wids >= 0).tolist():
             abs_ts = base + int(wids[s]) * self.divisor
             col = est[:, s]
-            for c in np.flatnonzero(col > 0).tolist():
+            for c in np.flatnonzero((col > 0) & changed[:, s]).tolist():
                 # absolute estimate: replace, don't accumulate
                 self._pending[(c, abs_ts)] = int(col[c])
+        self._flush_cache = (est, wids)
         # Open windows keep their registers on device, so the unflushed
         # event-time span restarts at the oldest still-open window, not
         # at the next batch (the base engine drains everything and can
