@@ -49,10 +49,11 @@ def _ws_accept(key: str) -> str:
         hashlib.sha1(key.encode() + _WS_GUID).digest()).decode()
 
 
-def ws_encode(payload: bytes, opcode: int = 0x1, mask: bool = False) -> bytes:
-    """One FIN frame.  Servers send unmasked; clients MUST mask
-    (RFC 6455 §5.1)."""
-    head = bytes([0x80 | opcode])
+def ws_encode(payload: bytes, opcode: int = 0x1, mask: bool = False,
+              fin: bool = True) -> bytes:
+    """One frame (FIN by default).  Servers send unmasked; clients MUST
+    mask (RFC 6455 §5.1)."""
+    head = bytes([(0x80 if fin else 0x00) | opcode])
     n = len(payload)
     mbit = 0x80 if mask else 0
     if n < 126:
@@ -70,8 +71,9 @@ def ws_encode(payload: bytes, opcode: int = 0x1, mask: bool = False) -> bytes:
 
 def ws_read_frame(rfile) -> tuple[int, bytes] | None:
     """Read one frame from a BLOCKING file-like -> (opcode, payload);
-    None on clean EOF.  (Client/test path; the server reads frames
-    through ``_SockStream``, whose buffer survives socket timeouts.)"""
+    None on EOF, including mid-frame (the peer is gone either way).
+    (Client/test path; the server reads frames through ``_SockStream``,
+    whose buffer survives socket timeouts.)"""
     h = rfile.read(2)
     if len(h) < 2:
         return None
@@ -79,10 +81,20 @@ def ws_read_frame(rfile) -> tuple[int, bytes] | None:
     masked = bool(h[1] & 0x80)
     n = h[1] & 0x7F
     if n == 126:
-        n = struct.unpack(">H", rfile.read(2))[0]
+        ext = rfile.read(2)
+        if len(ext) < 2:
+            return None
+        n = struct.unpack(">H", ext)[0]
     elif n == 127:
-        n = struct.unpack(">Q", rfile.read(8))[0]
-    mk = rfile.read(4) if masked else None
+        ext = rfile.read(8)
+        if len(ext) < 8:
+            return None
+        n = struct.unpack(">Q", ext)[0]
+    mk = None
+    if masked:
+        mk = rfile.read(4)
+        if len(mk) < 4:
+            return None
     payload = rfile.read(n)
     if len(payload) < n:
         return None
@@ -157,14 +169,15 @@ class _SockStream:
 
 
 def read_ws_frame_stream(stream: _SockStream
-                         ) -> tuple[int, bytes] | None:
+                         ) -> tuple[int, bytes, bool] | None:
     """Server-side frame read over ``_SockStream``: idle timeouts at the
     frame boundary propagate; mid-frame the stream waits for the rest.
-    Returns None on EOF (clean or mid-frame: either way the peer is
-    gone)."""
+    Returns ``(opcode, payload, fin)`` or None on EOF (clean or
+    mid-frame: either way the peer is gone)."""
     h = stream.read_exact(2, idle_raises=True)
     if h is None:
         return None
+    fin = bool(h[0] & 0x80)
     opcode = h[0] & 0x0F
     masked = bool(h[1] & 0x80)
     n = h[1] & 0x7F
@@ -186,7 +199,7 @@ def read_ws_frame_stream(stream: _SockStream
         return None
     if mk:
         payload = bytes(b ^ mk[i % 4] for i, b in enumerate(payload))
-    return opcode, payload
+    return opcode, payload, fin
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -219,6 +232,12 @@ class _Handler(socketserver.StreamRequestHandler):
 
     def _messages(self, stream: _SockStream):
         """Yield decoded JSON messages from either transport."""
+        try:
+            yield from self._messages_inner(stream)
+        except OSError:
+            return  # reset/aborted connection (port scans, dead peers)
+
+    def _messages_inner(self, stream: _SockStream):
         first = stream.readline()  # idle-tolerant: waits for a client
         if not first:
             return
@@ -226,6 +245,8 @@ class _Handler(socketserver.StreamRequestHandler):
             if not self._ws_handshake(stream):
                 return
             self.ws = True
+            fragments = b""  # FIN=0 fragments awaiting continuation
+            fragmented = False
             while True:
                 try:
                     frame = read_ws_frame_stream(stream)
@@ -235,18 +256,29 @@ class _Handler(socketserver.StreamRequestHandler):
                     return
                 if frame is None:
                     return
-                opcode, payload = frame
+                opcode, payload, fin = frame
                 if opcode == 0x8:  # close
                     self.send_raw(ws_encode(payload, opcode=0x8))
                     return
                 if opcode == 0x9:  # ping -> pong
                     self.send_raw(ws_encode(payload, opcode=0xA))
                     continue
-                if opcode in (0x1, 0x2):
-                    try:
-                        yield json.loads(payload)
-                    except json.JSONDecodeError:
+                if opcode in (0x1, 0x2) and not fin:
+                    fragments, fragmented = payload, True
+                    continue
+                if opcode == 0x0:  # continuation
+                    if not fragmented:
+                        continue  # stray continuation: drop
+                    fragments += payload
+                    if not fin:
                         continue
+                    payload, fragments, fragmented = fragments, b"", False
+                elif opcode not in (0x1, 0x2):
+                    continue
+                try:
+                    yield json.loads(payload)
+                except json.JSONDecodeError:
+                    continue
             return
         # JSON-lines transport; `first` is already a message line
         raw = first
@@ -271,6 +303,8 @@ class _Handler(socketserver.StreamRequestHandler):
         my_topics: set[str] = set()
         try:
             for msg in self._messages(_SockStream(self.connection)):
+                if not isinstance(msg, dict):
+                    continue  # '5' / '[1,2]' are valid JSON, not messages
                 topic = str(msg.get("topic", ""))
                 if not topic:
                     continue

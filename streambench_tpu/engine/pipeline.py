@@ -244,7 +244,9 @@ class AdAnalyticsEngine:
         # queued before it — the round-2 bench lost 85% of its wall time
         # exactly there.  Materialization happens at flush()/snapshot()
         # time, when the 1 Hz cadence has let the queue drain naturally.
-        self._undrained: list[tuple[jax.Array, jax.Array]] = []
+        # tagged parked drains: ("dense", deltas, wids) or
+        # ("compact", idx, vals, nnz, dense_handle, wids)
+        self._undrained: list[tuple] = []
         # pending Redis deltas: (campaign_idx, abs_window_ts) -> count
         # (dict = slow path for reclaims/snapshots; _pending_np = numpy
         # triples straight from drains, the hot path)
@@ -561,17 +563,41 @@ class AdAnalyticsEngine:
             method=self.method)
 
     # ------------------------------------------------------------------
+    # Drains compact nonzero cells on device once the dense block gets
+    # big enough that its host transfer dominates (~16 MB of cells); the
+    # cap bounds the compacted transfer at ~2 MB, with a dense fallback
+    # when a drain really has more live cells than that.  Accelerator
+    # backends only: on CPU the "transfer" is a same-memory view, so the
+    # compaction pass (count_nonzero + gather over C*W cells) is pure
+    # added work.
+    COMPACT_DRAIN_MIN_CELLS = 1 << 22
+    COMPACT_DRAIN_CAP = 1 << 18
+
+    def _use_compact_drain(self) -> bool:
+        cells = self.state.counts.shape[0] * self.state.counts.shape[1]
+        return (cells >= self.COMPACT_DRAIN_MIN_CELLS
+                and jax.default_backend() != "cpu")
+
     def _drain_device(self) -> None:
         """Zero the device deltas for ring reuse; materialization deferred.
 
-        Only *dispatches* ``flush_deltas`` — device programs execute in
+        Only *dispatches* the flush program — device programs execute in
         dispatch order, so the ring is reusable immediately; the returned
         arrays are parked in ``_undrained`` and pulled to the host in
         ``_materialize_drains`` (never on the hot path).
         """
-        deltas, wids, self.state = wc.flush_deltas(
-            self.state, divisor_ms=self.divisor, lateness_ms=self.lateness)
-        self._undrained.append((deltas, wids))
+        if self._use_compact_drain():
+            idx, vals, nnz, dense, wids, self.state = \
+                wc.flush_deltas_compact(
+                    self.state, cap=self.COMPACT_DRAIN_CAP,
+                    divisor_ms=self.divisor, lateness_ms=self.lateness)
+            self._undrained.append(("compact", idx, vals, nnz, dense,
+                                    wids))
+        else:
+            deltas, wids, self.state = wc.flush_deltas(
+                self.state, divisor_ms=self.divisor,
+                lateness_ms=self.lateness)
+            self._undrained.append(("dense", deltas, wids))
         self._span_start = None
 
     def _materialize_drains(self) -> None:
@@ -587,21 +613,37 @@ class AdAnalyticsEngine:
         if not self._undrained:
             return
         base = self.encoder.base_time_ms or 0
-        for deltas_d, wids_d in self._undrained:
-            deltas = np.asarray(deltas_d)
-            wids = np.asarray(wids_d)
-            ci, si = np.nonzero(deltas)
+        W = self.W
+        for parked in self._undrained:
+            if parked[0] == "compact":
+                _, idx_d, vals_d, nnz_d, dense_d, wids_d = parked
+                nnz = int(nnz_d)
+                wids = np.asarray(wids_d)
+                if nnz <= self.COMPACT_DRAIN_CAP:
+                    idx = np.asarray(idx_d)[:nnz].astype(np.int64)
+                    vals = np.asarray(vals_d)[:nnz]
+                    ci, si = np.divmod(idx, W)
+                else:  # overflow: read the dense block after all
+                    deltas = np.asarray(dense_d)
+                    ci, si = np.nonzero(deltas)
+                    vals = deltas[ci, si]
+            else:
+                _, deltas_d, wids_d = parked
+                deltas = np.asarray(deltas_d)
+                wids = np.asarray(wids_d)
+                ci, si = np.nonzero(deltas)
+                vals = deltas[ci, si]
             if ci.size == 0:
                 continue
             wid = wids[si]
             keep = wid >= 0
             if not keep.all():
-                ci, si, wid = ci[keep], si[keep], wid[keep]
+                ci, wid, vals = ci[keep], wid[keep], vals[keep]
             if ci.size:
                 self._pending_np.append(
                     (ci.astype(np.int64),
                      base + wid.astype(np.int64) * self.divisor,
-                     deltas[ci, si].astype(np.int64)))
+                     vals.astype(np.int64)))
         self._undrained.clear()
 
     def _fold_pending_arrays(self) -> None:

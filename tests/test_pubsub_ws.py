@@ -165,3 +165,43 @@ def test_split_frame_across_idle_gap_does_not_desync():
         s.close()
     finally:
         srv.close()
+
+
+def test_fragmented_message_and_junk_json_tolerated():
+    """FIN=0 + continuation fragments reassemble into one message
+    (RFC 6455 §5.4); non-object JSON ('5', '[1,2]') is ignored, not a
+    handler crash."""
+    import base64 as b64
+    import os as _os
+    import time
+
+    srv = PubSubServer().start()
+    host, port = srv.address
+    try:
+        s = socket.create_connection((host, port), timeout=10)
+        key = b64.b64encode(_os.urandom(16)).decode()
+        s.sendall((f"GET /pubsub HTTP/1.1\r\nHost: x\r\n"
+                   f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                   f"Sec-WebSocket-Key: {key}\r\n\r\n").encode())
+        f = s.makefile("rb")
+        while True:
+            if f.readline() in (b"\r\n", b"\n", b""):
+                break
+        # junk first: valid JSON, not a message object
+        s.sendall(ws_encode(b"5", mask=True))
+        s.sendall(ws_encode(b"[1,2]", mask=True))
+        # then a subscribe split across text + continuation frames
+        msg = json.dumps({"type": "subscribe", "topic": "frag"}).encode()
+        s.sendall(ws_encode(msg[:7], opcode=0x1, mask=True, fin=False))
+        s.sendall(ws_encode(msg[7:], opcode=0x0, mask=True, fin=True))
+        deadline = time.monotonic() + 5
+        while (srv.subscriber_count("frag") == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.subscriber_count("frag") == 1
+        assert srv.publish("frag", "ok") == 1
+        opcode, payload = ws_read_frame(f)
+        assert json.loads(payload)["data"] == "ok"
+        s.close()
+    finally:
+        srv.close()

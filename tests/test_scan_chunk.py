@@ -298,3 +298,27 @@ def test_repack_batches_preserves_order():
     ref = np.concatenate([b.event_time[:b.n] for b in batches])
     assert np.array_equal(times, ref)
     assert all(b.base_time_ms == batches[0].base_time_ms for b in out)
+
+
+def test_compact_drain_matches_dense(monkeypatch):
+    """Device-compacted drains (large key spaces) must be invisible to
+    correctness, including the cap-overflow dense fallback."""
+    lines, mapping, campaigns = make_lines(4000, seed=23)
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=4)
+
+    dense = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
+    dense.process_chunk(lines)
+    want = drained_pending(dense)
+
+    # force the compact path (it gates itself to accelerator backends)
+    monkeypatch.setattr(AdAnalyticsEngine, "_use_compact_drain",
+                        lambda self: True)
+    compact = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
+    compact.process_chunk(lines)
+    assert drained_pending(compact) == want
+
+    # cap smaller than the live cells: nnz > cap -> dense fallback
+    monkeypatch.setattr(AdAnalyticsEngine, "COMPACT_DRAIN_CAP", 8)
+    overflow = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
+    overflow.process_chunk(lines)
+    assert drained_pending(overflow) == want
