@@ -244,9 +244,15 @@ class AdAnalyticsEngine:
         # queued before it — the round-2 bench lost 85% of its wall time
         # exactly there.  Materialization happens at flush()/snapshot()
         # time, when the 1 Hz cadence has let the queue drain naturally.
-        # tagged parked drains: ("dense", deltas, wids) or
-        # ("compact", idx, vals, nnz, dense_handle, wids)
+        # tagged parked drains: ("dense", deltas, wids),
+        # ("compact", idx, vals, nnz, dense_handle, wids) or
+        # ("rows", rows_np, n, row_block, wids)
         self._undrained: list[tuple] = []
+        # Dirty-campaign tracking (large key spaces only): per-batch
+        # campaign sets accumulated host-side so a drain can gather just
+        # the touched rows instead of walking C x W cells.
+        self._join_np = self.encoder.join_table
+        self._dirty_rows: list[np.ndarray] = []
         # pending Redis deltas: (campaign_idx, abs_window_ts) -> count
         # (dict = slow path for reclaims/snapshots; _pending_np = numpy
         # triples straight from drains, the hot path)
@@ -336,6 +342,24 @@ class AdAnalyticsEngine:
                             for c in self.SCAN_COLUMNS]
                     self._device_scan(*cols)
             self._drain_device()
+            if self._track_dirty_rows():
+                # compile the dirty-rows drain program too (a ~3 s XLA
+                # compile at C=1e6 must not land mid-run); row 0 holds
+                # zeros, so nothing materializes
+                self._dirty_rows.append(np.zeros(1, np.int64))
+                self._drain_device()
+                # ... and the strategies an overflowing drain (touched
+                # set > DIRTY_ROWS_CAP) falls through to — state is all
+                # zero, so these are no-ops semantically
+                if self._use_compact_drain():
+                    *_, self.state = wc.flush_deltas_compact(
+                        self.state, cap=self.COMPACT_DRAIN_CAP,
+                        divisor_ms=self.divisor,
+                        lateness_ms=self.lateness)
+                else:
+                    _, _, self.state = wc.flush_deltas(
+                        self.state, divisor_ms=self.divisor,
+                        lateness_ms=self.lateness)
             self._materialize_drains()
             _jax.block_until_ready(self.state)
         self._span_start = None
@@ -431,6 +455,8 @@ class AdAnalyticsEngine:
             if pad:
                 arrs += [np.zeros_like(arrs[0])] * pad
             cols.append(jnp.asarray(np.stack(arrs)))
+        if self._track_dirty_rows():
+            self._note_batch_campaigns(batches)
         with self.tracer.span("device_scan"):
             self._device_scan(*cols)
         self.events_processed += sum(b.n for b in batches)
@@ -524,6 +550,8 @@ class AdAnalyticsEngine:
                 self._drain_device()
             if self._span_start is None or batch_min < self._span_start:
                 self._span_start = batch_min
+        if self._track_dirty_rows():
+            self._note_batch_campaigns([batch])
         with self.tracer.span("device_step"):
             # async dispatch: the span covers transfer + enqueue, not
             # device completion (that overlaps the next encode — the
@@ -563,20 +591,45 @@ class AdAnalyticsEngine:
             method=self.method)
 
     # ------------------------------------------------------------------
-    # Drains compact nonzero cells on device once the dense block gets
-    # big enough that its host transfer dominates (~16 MB of cells); the
-    # cap bounds the compacted transfer at ~2 MB, with a dense fallback
-    # when a drain really has more live cells than that.  Accelerator
-    # backends only: on CPU the "transfer" is a same-memory view, so the
-    # compaction pass (count_nonzero + gather over C*W cells) is pure
-    # added work.
+    # Drain strategy at large key spaces (cells = C x W past the
+    # threshold).  Preferred: host-tracked dirty campaign rows — the
+    # drain gathers [touched, W] on device, so its cost scales with what
+    # the stream actually wrote since the last drain (measured at
+    # C=1e6, W=64 with 50k dirty cells on CPU: rows ~10 ms vs dense
+    # walk ~680 ms vs on-device nonzero compaction ~3.4 s).  Fallbacks:
+    # on-device compaction (accelerators only — the same measurement
+    # shows XLA's sized-nonzero over the full cell space is SLOWER than
+    # the dense host walk on CPU) when the touched set overflows the
+    # cap, else the dense walk.
     COMPACT_DRAIN_MIN_CELLS = 1 << 22
     COMPACT_DRAIN_CAP = 1 << 18
+    DIRTY_ROWS_CAP = 1 << 17
 
     def _use_compact_drain(self) -> bool:
         cells = self.state.counts.shape[0] * self.state.counts.shape[1]
         return (cells >= self.COMPACT_DRAIN_MIN_CELLS
                 and jax.default_backend() != "cpu")
+
+    def _track_dirty_rows(self) -> bool:
+        counts = getattr(self.state, "counts", None)
+        if counts is None:  # sketch states keep no dense [C, W] block
+            return False
+        return (counts.shape[0] * counts.shape[1]
+                >= self.COMPACT_DRAIN_MIN_CELLS)
+
+    def _note_batch_campaigns(self, batches) -> None:
+        """Record which campaign rows the given encoded batches touch
+        (hot path at large C only; ~100 us per 8k batch).  Over-
+        inclusion is harmless — rows drain as zero — so invalid rows
+        inside [:n] need no masking beyond the join-miss filter."""
+        parts = []
+        for b in batches:
+            c = self._join_np[b.ad_idx[:b.n]]
+            parts.append(c[c >= 0])
+        if parts:
+            self._dirty_rows.append(
+                np.unique(np.concatenate(parts))
+                if len(parts) > 1 else np.unique(parts[0]))
 
     def _drain_device(self) -> None:
         """Zero the device deltas for ring reuse; materialization deferred.
@@ -586,6 +639,54 @@ class AdAnalyticsEngine:
         arrays are parked in ``_undrained`` and pulled to the host in
         ``_materialize_drains`` (never on the hot path).
         """
+        if self._track_dirty_rows():
+            rows = (np.unique(np.concatenate(self._dirty_rows))
+                    if len(self._dirty_rows) > 1
+                    else (self._dirty_rows[0] if self._dirty_rows
+                          else np.empty(0, np.int64)))
+            self._dirty_rows = []
+            if rows.size == 0:
+                # nothing written since the last drain: counts are
+                # already zero, only closed slots need freeing
+                self.state = wc.flush_free_slots(
+                    self.state, divisor_ms=self.divisor,
+                    lateness_ms=self.lateness)
+                self._span_start = None
+                return
+            if rows.size <= self.DIRTY_ROWS_CAP:
+                # ONE fixed scatter/gather size: at C=1e6 each distinct
+                # shape costs a ~3 s XLA compile on a small host, so
+                # bucketing by size would scatter compiles through the
+                # run
+                R = min(self.DIRTY_ROWS_CAP,
+                        self.state.counts.shape[0])
+                padded = np.zeros(R, np.int32)
+                padded[:rows.size] = rows
+                if jax.default_backend() == "cpu":
+                    # counts live in host memory: read the touched rows
+                    # through the zero-copy view (13x faster than XLA's
+                    # row gather), then only the in-place zero runs on
+                    # device.  The fancy-index COPIES before the zero
+                    # program is dispatched, so donation is safe.
+                    view = np.asarray(self.state.counts)
+                    sub_np = view[rows]
+                    del view
+                    wids, self.state = wc.flush_rows_zero(
+                        self.state, jnp.asarray(padded),
+                        divisor_ms=self.divisor,
+                        lateness_ms=self.lateness)
+                    self._undrained.append(("rows_host", rows, sub_np,
+                                            wids))
+                else:
+                    sub, wids, self.state = wc.flush_deltas_rows(
+                        self.state, jnp.asarray(padded),
+                        divisor_ms=self.divisor, lateness_ms=self.lateness)
+                    self._undrained.append(("rows", rows, rows.size, sub,
+                                            wids))
+                self._span_start = None
+                return
+            # touched set overflowed the cap: fall through to the full-
+            # space strategies below
         if self._use_compact_drain():
             idx, vals, nnz, dense, wids, self.state = \
                 wc.flush_deltas_compact(
@@ -615,7 +716,20 @@ class AdAnalyticsEngine:
         base = self.encoder.base_time_ms or 0
         W = self.W
         for parked in self._undrained:
-            if parked[0] == "compact":
+            if parked[0] == "rows":
+                _, rows_np, nrow, sub_d, wids_d = parked
+                sub = np.asarray(sub_d)[:nrow]
+                wids = np.asarray(wids_d)
+                ci_l, si = np.nonzero(sub)
+                vals = sub[ci_l, si]
+                ci = rows_np[ci_l]
+            elif parked[0] == "rows_host":
+                _, rows_np, sub, wids_d = parked
+                wids = np.asarray(wids_d)
+                ci_l, si = np.nonzero(sub)
+                vals = sub[ci_l, si]
+                ci = rows_np[ci_l]
+            elif parked[0] == "compact":
                 _, idx_d, vals_d, nnz_d, dense_d, wids_d = parked
                 nnz = int(nnz_d)
                 wids = np.asarray(wids_d)
@@ -648,10 +762,16 @@ class AdAnalyticsEngine:
 
     def _fold_pending_arrays(self) -> None:
         """Merge ``_pending_np`` array triples into the ``_pending`` dict
-        (snapshot/restore need the dict view; never on the hot path)."""
+        (snapshot/restore need the dict view; never on the hot path).
+        Absolute engines (HLL) REPLACE — list order preserves recency,
+        so the freshest estimate for a cell wins, matching write order."""
         for ci, ts, cnt in self._pending_np:
-            for c, t, n in zip(ci.tolist(), ts.tolist(), cnt.tolist()):
-                self._pending[(c, t)] += n
+            if self.absolute_counts:
+                for c, t, n in zip(ci.tolist(), ts.tolist(), cnt.tolist()):
+                    self._pending[(c, t)] = n
+            else:
+                for c, t, n in zip(ci.tolist(), ts.tolist(), cnt.tolist()):
+                    self._pending[(c, t)] += n
         self._pending_np.clear()
 
     def pending_counts(self) -> dict[tuple[int, int], int]:
@@ -848,6 +968,15 @@ class AdAnalyticsEngine:
         """Re-establish every host-side field from snapshot meta."""
         self.drain_writes()
         self._undrained.clear()
+        self._dirty_rows = []
+        if self._track_dirty_rows() and snap.counts.size:
+            # restored counts may hold undrained cells the tracker never
+            # saw — seed it with their rows so the next drain finds them
+            # (HERE, not in restore(): every engine family's restore
+            # override calls _restore_host, so all of them inherit this)
+            live = np.nonzero(np.asarray(snap.counts).any(axis=1))[0]
+            if live.size:
+                self._dirty_rows.append(live)
         self.encoder.set_base_time(snap.meta["base_time_ms"])
         self._span_start = snap.meta["span_start"]
         self.events_processed = int(snap.meta["events_processed"])

@@ -183,6 +183,14 @@ def step(state: WindowState, join_table: jax.Array,
     return WindowState(counts, window_ids, watermark, dropped)
 
 
+def _still_open(window_ids: jax.Array, watermark: jax.Array,
+                divisor_ms: int, lateness_ms: int) -> jax.Array:
+    """Free ring slots of closed windows (watermark passed end+lateness)
+    — the ONE copy of the close rule every drain variant shares."""
+    closed = (window_ids + 1) * divisor_ms + lateness_ms <= watermark
+    return jnp.where(closed | (window_ids < 0), jnp.int32(-1), window_ids)
+
+
 @functools.partial(jax.jit, static_argnames=("divisor_ms", "lateness_ms"))
 def flush_deltas(state: WindowState, *, divisor_ms: int = 10_000,
                  lateness_ms: int = 60_000
@@ -195,12 +203,10 @@ def flush_deltas(state: WindowState, *, divisor_ms: int = 10_000,
     passes its end plus allowed lateness — the event-time analog of the 10 s
     window falling out of the reference's LRU.
     """
-    closed = (state.window_ids + 1) * divisor_ms + lateness_ms <= state.watermark
-    still_open = jnp.where(closed | (state.window_ids < 0),
-                           jnp.int32(-1), state.window_ids)
     new_state = WindowState(
         counts=jnp.zeros_like(state.counts),
-        window_ids=still_open,
+        window_ids=_still_open(state.window_ids, state.watermark,
+                               divisor_ms, lateness_ms),
         watermark=state.watermark,
         dropped=state.dropped,
     )
@@ -234,6 +240,72 @@ def flush_deltas_compact(state: WindowState, *, cap: int,
         state, divisor_ms=divisor_ms, lateness_ms=lateness_ms)
     return (idx.astype(jnp.int32), vals, nnz, state.counts, wids,
             new_state)
+
+
+@functools.partial(jax.jit, static_argnames=("divisor_ms", "lateness_ms"),
+                   donate_argnums=(0,))
+def flush_deltas_rows(state: WindowState, rows: jax.Array, *,
+                      divisor_ms: int = 10_000, lateness_ms: int = 60_000):
+    """``flush_deltas`` returning only the given campaign rows.
+
+    At large key spaces (config #5: C=1e6) a drain's cost must scale
+    with what was *touched* since the last drain, not with the [C, W]
+    key space — the reference's own 1e6-key analog reports at window
+    close instead of walking the key universe
+    (``ProcessTimeAwareStore.java:115-176``).  The host knows every
+    batch's campaign set at encode time, so it passes the touched rows
+    in; the device gathers just those rows ``[R, W]``.  ``rows`` is
+    padded to a static shape with arbitrary valid indices; the caller
+    slices to its true count.  Only the touched rows are zeroed (in
+    place when the caller donates ``state.counts``) — every other row
+    is already zero, so the full-space memset ``flush_deltas`` pays is
+    skipped too.  Returns ``(row_block [R, W], window_ids, new_state)``.
+    """
+    sub = state.counts[rows]
+    _, wids, new_state = _zero_rows(state, rows, divisor_ms, lateness_ms)
+    return sub, wids, new_state
+
+
+def _zero_rows(state: WindowState, rows: jax.Array,
+               divisor_ms: int, lateness_ms: int):
+    new_state = WindowState(
+        counts=state.counts.at[rows].set(0),
+        window_ids=_still_open(state.window_ids, state.watermark,
+                               divisor_ms, lateness_ms),
+        watermark=state.watermark,
+        dropped=state.dropped,
+    )
+    return None, state.window_ids, new_state
+
+
+@functools.partial(jax.jit, static_argnames=("divisor_ms", "lateness_ms"),
+                   donate_argnums=(0,))
+def flush_free_slots(state: WindowState, *, divisor_ms: int = 10_000,
+                     lateness_ms: int = 60_000) -> WindowState:
+    """Slot-free-only drain: nothing was written since the last drain,
+    so counts are already all-zero — only closed ring slots need
+    freeing.  With the state donated the counts buffer passes through
+    untouched (``flush_deltas`` here would copy AND memset the whole
+    [C, W] block just to say "empty": ~650 ms at C=1e6 on CPU)."""
+    return WindowState(state.counts,
+                       _still_open(state.window_ids, state.watermark,
+                                   divisor_ms, lateness_ms),
+                       state.watermark, state.dropped)
+
+
+@functools.partial(jax.jit, static_argnames=("divisor_ms", "lateness_ms"),
+                   donate_argnums=(0,))
+def flush_rows_zero(state: WindowState, rows: jax.Array, *,
+                    divisor_ms: int = 10_000, lateness_ms: int = 60_000):
+    """The zero-and-free half of ``flush_deltas_rows``, for callers that
+    already copied the touched rows out host-side.  On CPU backends the
+    count block is host memory: ``np.asarray`` is a zero-copy view and a
+    numpy fancy-index reads the touched rows ~13x faster than XLA's row
+    gather (measured 14 ms vs 200 ms for 49k rows at C=1e6), so the
+    only device work left is this in-place scatter-zero.  Returns
+    ``(window_ids, new_state)``."""
+    _, wids, new_state = _zero_rows(state, rows, divisor_ms, lateness_ms)
+    return wids, new_state
 
 
 @functools.partial(
