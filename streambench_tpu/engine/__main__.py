@@ -128,15 +128,17 @@ def main(argv: list[str] | None = None) -> int:
             from streambench_tpu.parallel import (
                 ShardedHLLEngine,
                 ShardedSessionCMSEngine,
+                ShardedSlidingTDigestEngine,
                 ShardedWindowEngine,
                 mesh_from_config,
             )
             cls = {"exact": ShardedWindowEngine,
                    "hll": ShardedHLLEngine,
+                   "sliding": ShardedSlidingTDigestEngine,
                    "session": ShardedSessionCMSEngine}.get(args.engine)
             if cls is None:
-                raise SystemExit(f"--sharded supports exact/hll/session, "
-                                 f"not --engine {args.engine}")
+                raise SystemExit(f"--sharded supports exact/hll/sliding/"
+                                 f"session, not --engine {args.engine}")
             return cls(cfg, mapping, mesh_from_config(cfg),
                        campaigns=campaigns, redis=r)
         if args.engine != "exact":

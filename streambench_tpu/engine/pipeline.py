@@ -716,15 +716,12 @@ class AdAnalyticsEngine:
         base = self.encoder.base_time_ms or 0
         W = self.W
         for parked in self._undrained:
-            if parked[0] == "rows":
-                _, rows_np, nrow, sub_d, wids_d = parked
-                sub = np.asarray(sub_d)[:nrow]
-                wids = np.asarray(wids_d)
-                ci_l, si = np.nonzero(sub)
-                vals = sub[ci_l, si]
-                ci = rows_np[ci_l]
-            elif parked[0] == "rows_host":
-                _, rows_np, sub, wids_d = parked
+            if parked[0] in ("rows", "rows_host"):
+                if parked[0] == "rows":
+                    _, rows_np, nrow, sub_d, wids_d = parked
+                    sub = np.asarray(sub_d)[:nrow]
+                else:
+                    _, rows_np, sub, wids_d = parked
                 wids = np.asarray(wids_d)
                 ci_l, si = np.nonzero(sub)
                 vals = sub[ci_l, si]
@@ -804,6 +801,21 @@ class AdAnalyticsEngine:
         # drains are fine (HINCRBY accumulates; for absolute engines the
         # later, fresher value wins because write order is preserved —
         # rows, i.e. stale reclaims, are always submitted first).
+        if self.absolute_counts and len(self._pending_np) > 1:
+            # Several drains between flushes re-estimate the same
+            # open-window cells; only the FRESHEST absolute value should
+            # be written (the old dict path collapsed these — keep that
+            # write volume without the per-cell dict cost).
+            ci = np.concatenate([t[0] for t in self._pending_np])
+            ts_a = np.concatenate([t[1] for t in self._pending_np])
+            cnt = np.concatenate([t[2] for t in self._pending_np])
+            order = np.lexsort((np.arange(len(ci)), ts_a, ci))
+            ci_s, ts_s = ci[order], ts_a[order]
+            last = np.concatenate(
+                [(ci_s[1:] != ci_s[:-1]) | (ts_s[1:] != ts_s[:-1]),
+                 [True]])
+            keep = np.sort(order[last])  # freshest per cell, stable order
+            self._pending_np = [(ci[keep], ts_a[keep], cnt[keep])]
         arrays = None
         table = self._native_table()
         if table is not None and self._pending_np:

@@ -15,6 +15,7 @@ from streambench_tpu.parallel.sharded import (
 from streambench_tpu.parallel.sketches import (
     ShardedHLLEngine,
     ShardedSessionCMSEngine,
+    ShardedSlidingTDigestEngine,
     sharded_hll_init,
     sharded_hll_step,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "run_distributed_catchup",
     "ShardedHLLEngine",
     "ShardedSessionCMSEngine",
+    "ShardedSlidingTDigestEngine",
     "ShardedWindowEngine",
     "sharded_hll_init",
     "sharded_hll_step",

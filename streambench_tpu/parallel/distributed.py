@@ -258,6 +258,10 @@ class DistributedWindowEngine(ShardedWindowEngine):
         row range, so hosts flush disjoint campaign sets to Redis — the
         writeback itself is data-parallel across the pod.
         """
+        # This engine drains densely per shard; the base class's dirty-row
+        # tracker (filled by _fold at large C*W) is unused here and must
+        # not accumulate one array per batch forever.
+        self._dirty_rows.clear()
         deltas, wids, self.state = wc.flush_deltas(
             self.state, divisor_ms=self.divisor, lateness_ms=self.lateness)
         wids = np.asarray(wids)  # replicated -> addressable everywhere

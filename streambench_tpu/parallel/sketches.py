@@ -44,10 +44,14 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from streambench_tpu.config import BenchmarkConfig
-from streambench_tpu.engine.sketches import HLLDistinctEngine, SessionCMSEngine
+from streambench_tpu.engine.sketches import (
+    HLLDistinctEngine,
+    SessionCMSEngine,
+    SlidingTDigestEngine,
+)
 from streambench_tpu.io.redis_schema import RedisLike
-from streambench_tpu.ops import cms, hll, session
-from streambench_tpu.ops.windowcount import NEG, assign_windows
+from streambench_tpu.ops import cms, hll, session, sliding, tdigest
+from streambench_tpu.ops.windowcount import NEG, WindowState, assign_windows
 from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
 from streambench_tpu.parallel.sharded import pad_campaigns
 
@@ -282,6 +286,228 @@ class ShardedHLLEngine(HLLDistinctEngine):
                 jnp.int32(self.state.watermark), rep),
             dropped=jax.device_put(jnp.int32(self.state.dropped), rep),
         )
+
+
+# ----------------------------------------------------------------------
+# Sharded sliding windows + t-digest
+# ----------------------------------------------------------------------
+
+def _sliding_td_fold(counts, window_ids, watermark, dropped, means,
+                     weights, join_table, now_rel,
+                     ad_idx, event_type, event_time, valid,
+                     *, size_ms: int, slide_ms: int, lateness_ms: int,
+                     view_type: int):
+    """One batch folded into a campaign shard: S sliding memberships
+    into the counts ring + latency samples into the shard's t-digests.
+
+    The batch columns ``all_gather`` over the data axis and each
+    campaign shard folds the full batch masked to its own campaigns —
+    the digest "merge" is OWNERSHIP (every campaign's digest has exactly
+    one writer), the same unifier-by-routing as the exact engine's
+    psum-free counts (``ApplicationDimensionComputation.java:120`` is
+    the reference's explicit-unifier analog); ``ops.tdigest.merge``
+    remains the explicit union for offline digest joins.  Mirrors
+    ``ops.sliding.step`` + ``SlidingTDigestEngine._device_step``
+    semantics exactly (within-key ranks are key-local, so shard-local
+    folding is bit-compatible with the single-device digest up to
+    float-add ordering inside a centroid).
+    """
+    Cl, W = counts.shape
+    S = size_ms // slide_ms
+    late_eff = sliding.effective_lateness(size_ms, slide_ms, lateness_ms)
+
+    gather = functools.partial(jax.lax.all_gather, axis_name=DATA_AXIS,
+                               tiled=True)
+    ad = gather(ad_idx)
+    et = gather(event_type)
+    tm = gather(event_time)
+    v = gather(valid)
+
+    campaign = join_table[ad]
+    base_wid = tm // slide_ms
+    wanted = v & (et == view_type) & (campaign >= 0)
+    c0 = jax.lax.axis_index(CAMPAIGN_AXIS) * Cl
+    local_c = campaign - c0
+    shard_mask = (local_c >= 0) & (local_c < Cl)
+    wanted_n = jnp.sum(wanted.astype(jnp.int32))
+
+    ids = window_ids
+    new_wm = watermark
+    counted_acc = jnp.int32(0)
+    for k in range(S):
+        wid = base_wid - k
+        slot, count_mask, ids, new_wm = assign_windows(
+            ids, watermark, wid, wanted, v, tm,
+            divisor_ms=slide_ms, lateness_ms=late_eff)
+        in_shard = count_mask & shard_mask
+        flat = jnp.where(in_shard, local_c * W + slot, Cl * W)
+        counts = (counts.reshape(-1)
+                  .at[flat].add(1, mode="drop")
+                  .reshape(Cl, W))
+        counted_acc = counted_acc + jnp.sum(in_shard.astype(jnp.int32))
+    # ONE scalar psum for all S memberships (psum is linear; per-slot
+    # psums would put S collectives on the hot path for the same result)
+    dropped = dropped + S * wanted_n - jax.lax.psum(counted_acc,
+                                                    CAMPAIGN_AXIS)
+
+    # Latency sample per view event into the owner shard's digest.
+    lat = jnp.maximum(now_rel - tm, 0)
+    dmask = wanted & shard_mask
+    dg = tdigest.update(
+        tdigest.TDigestState(means, weights),
+        jnp.where(dmask, local_c, Cl), lat, dmask)
+    return counts, ids, new_wm, dropped, dg.means, dg.weights
+
+
+_SLIDING_STATE_SPECS = (P(CAMPAIGN_AXIS, None), P(), P(), P(),
+                        P(CAMPAIGN_AXIS, None), P(CAMPAIGN_AXIS, None))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sliding_step(mesh: Mesh, size_ms: int, slide_ms: int,
+                        lateness_ms: int, view_type: int = 0):
+    def body(counts, ids, wm, dr, means, weights, join_table, now_rel,
+             ad_idx, event_type, event_time, valid):
+        return _sliding_td_fold(
+            counts, ids, wm, dr, means, weights, join_table, now_rel,
+            ad_idx, event_type, event_time, valid, size_ms=size_ms,
+            slide_ms=slide_ms, lateness_ms=lateness_ms,
+            view_type=view_type)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=_SLIDING_STATE_SPECS + (P(), P(), P(DATA_AXIS),
+                                         P(DATA_AXIS), P(DATA_AXIS),
+                                         P(DATA_AXIS)),
+        out_specs=_SLIDING_STATE_SPECS,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 4, 5))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sliding_scan(mesh: Mesh, size_ms: int, slide_ms: int,
+                        lateness_ms: int, view_type: int = 0):
+    """Scanned sharded sliding+t-digest: fold ``[K, B]`` stacked batches
+    in one dispatch (the catchup hot path, peer of
+    ``engine.sketches._sliding_tdigest_scan``)."""
+
+    def body(counts, ids, wm, dr, means, weights, join_table, now_rel,
+             ad_idx, event_type, event_time, valid):
+        def one(carry, xs):
+            a, e, t, v = xs
+            return _sliding_td_fold(
+                *carry, join_table, now_rel, a, e, t, v, size_ms=size_ms,
+                slide_ms=slide_ms, lateness_ms=lateness_ms,
+                view_type=view_type), None
+
+        carry, _ = jax.lax.scan(
+            one, (counts, ids, wm, dr, means, weights),
+            (ad_idx, event_type, event_time, valid))
+        return carry
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=_SLIDING_STATE_SPECS + (P(), P(), P(None, DATA_AXIS),
+                                         P(None, DATA_AXIS),
+                                         P(None, DATA_AXIS),
+                                         P(None, DATA_AXIS)),
+        out_specs=_SLIDING_STATE_SPECS,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 4, 5))
+
+
+class ShardedSlidingTDigestEngine(SlidingTDigestEngine):
+    """Sliding-window counts + per-campaign latency t-digest with both
+    the counts ring and the digests sharded on the campaign axis.
+
+    The last sketch family's mesh form (VERDICT r4 missing #2): counts
+    merge exactly as the exact engine's (ownership + in-place scatter);
+    digests merge by ownership — each campaign's centroids live on one
+    shard, so the cross-partition "unifier" is the batch all_gather, and
+    reading quantiles gathers the [C, K] centroid block to the host.
+    Drop-in: same host loop, Redis writeback, checkpoint format
+    (snapshots gather to host arrays; restore re-places shardings).
+    """
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 mesh: Mesh, campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 size_ms: int | None = None, slide_ms: int = 1_000,
+                 window_slots: int | None = None, compression: int = 64,
+                 input_format: str = "json"):
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, size_ms=size_ms, slide_ms=slide_ms,
+                         window_slots=window_slots, compression=compression,
+                         input_format=input_format)
+        self.mesh = mesh
+        n_data = mesh.shape[DATA_AXIS]
+        if self.batch_size % n_data:
+            raise ValueError(
+                f"batch size {self.batch_size} not divisible by data-axis "
+                f"size {n_data}")
+        self._place_sliding()
+
+    def _place_sliding(self) -> None:
+        """(Re-)apply mesh shardings, padding the campaign axis."""
+        C = pad_campaigns(self.encoder.num_campaigns, self.mesh)
+        rep = NamedSharding(self.mesh, P())
+        cshard = NamedSharding(self.mesh, P(CAMPAIGN_AXIS, None))
+
+        def pad_rows(a):
+            a = np.asarray(a)
+            if a.shape[0] < C:
+                a = np.pad(a, ((0, C - a.shape[0]),) + ((0, 0),) *
+                           (a.ndim - 1))
+            return a
+
+        self.state = WindowState(
+            counts=jax.device_put(jnp.asarray(pad_rows(self.state.counts)),
+                                  cshard),
+            window_ids=jax.device_put(
+                jnp.asarray(np.asarray(self.state.window_ids)), rep),
+            watermark=jax.device_put(jnp.int32(self.state.watermark), rep),
+            dropped=jax.device_put(jnp.int32(self.state.dropped), rep))
+        self.digest = tdigest.TDigestState(
+            means=jax.device_put(jnp.asarray(pad_rows(self.digest.means)),
+                                 cshard),
+            weights=jax.device_put(
+                jnp.asarray(pad_rows(self.digest.weights)), cshard))
+        self.join_table = jax.device_put(
+            jnp.asarray(self.encoder.join_table), rep)
+
+    def _carry(self):
+        return (self.state.counts, self.state.window_ids,
+                self.state.watermark, self.state.dropped,
+                self.digest.means, self.digest.weights)
+
+    def _uncarry(self, out) -> None:
+        counts, ids, wm, dr, means, weights = out
+        self.state = WindowState(counts, ids, wm, dr)
+        self.digest = tdigest.TDigestState(means, weights)
+
+    def _device_step(self, batch) -> None:
+        fn = _build_sliding_step(self.mesh, self.size_ms, self.slide_ms,
+                                 self.base_lateness)
+        self._uncarry(fn(*self._carry(), self.join_table, self._now_rel(),
+                         jnp.asarray(batch.ad_idx),
+                         jnp.asarray(batch.event_type),
+                         jnp.asarray(batch.event_time),
+                         jnp.asarray(batch.valid)))
+
+    def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
+        fn = _build_sliding_scan(self.mesh, self.size_ms, self.slide_ms,
+                                 self.base_lateness)
+        self._uncarry(fn(*self._carry(), self.join_table, self._now_rel(),
+                         ad_idx, event_type, event_time, valid))
+
+    def quantiles(self) -> np.ndarray:
+        # padded campaign rows are empty digests; slice them off
+        q = super().quantiles()
+        return q[:self.encoder.num_campaigns]
+
+    def restore(self, snap) -> None:
+        super().restore(snap)
+        self._place_sliding()
 
 
 # ----------------------------------------------------------------------
