@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "processes can shard one paced load across "
                         "partitions, like parallel Kafka producers)")
     p.add_argument("--maxEvents", type=int, default=None)
+    p.add_argument("--users", type=int, default=100,
+                   help="paced-mode user-id universe (default 100, the "
+                        "reference's; session workloads need a larger "
+                        "one for inter-arrival gaps to exceed the "
+                        "session gap)")
     p.add_argument("--eventsNum", type=int, default=None,
                    help="override events.num for -s")
     return p
@@ -132,7 +137,7 @@ def main(argv: list[str] | None = None) -> int:
             sent = gen.run_paced(
                 sink, args.throughput, duration_s=args.duration,
                 max_events=args.maxEvents, with_skew=args.with_skew,
-                workdir=args.workdir,
+                workdir=args.workdir, num_users=args.users,
                 on_behind=lambda ms: print(f"Falling behind by: {ms:.0f}ms"),
             )
         print(f"emitted {sent} events")

@@ -376,6 +376,7 @@ def run_paced(sink: JournalWriter, throughput: int,
               workdir: str = ".",
               rng: random.Random | None = None,
               tick_s: float = 0.01,
+              num_users: int = 100,
               on_behind: Callable[[float], None] | None = None) -> int:
     """``-r -t N``: paced emission at ``throughput`` events/s (``run``,
     ``core.clj:183-204``).
@@ -393,8 +394,9 @@ def run_paced(sink: JournalWriter, throughput: int,
             f"id files not found in {workdir!r}; run -n (new setup) first")
     _, ads = ids
     rng = rng or random.Random()
-    src = EventSource(ads=ads, user_ids=make_ids(100, rng),
-                      page_ids=make_ids(100, rng), with_skew=with_skew, rng=rng)
+    src = EventSource(ads=ads, user_ids=make_ids(num_users, rng),
+                      page_ids=make_ids(100, rng), with_skew=with_skew,
+                      rng=rng)
 
     period_ns = int(1e9 / throughput)
     # Blob mode: native formatter renders the tick's batch as one byte

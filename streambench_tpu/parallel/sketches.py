@@ -45,9 +45,12 @@ from jax.sharding import PartitionSpec as P
 
 from streambench_tpu.config import BenchmarkConfig
 from streambench_tpu.engine.sketches import (
+    LAT_BIN_MS,
+    LAT_BINS,
     HLLDistinctEngine,
     SessionCMSEngine,
     SlidingTDigestEngine,
+    _hist_rows,
 )
 from streambench_tpu.io.redis_schema import RedisLike
 from streambench_tpu.ops import cms, hll, session, sliding, tdigest
@@ -353,9 +356,9 @@ def _sliding_td_fold(counts, window_ids, watermark, dropped, means,
     # Latency sample per view event into the owner shard's digest.
     lat = jnp.maximum(now_rel - tm, 0)
     dmask = wanted & shard_mask
+    # tdigest.update masks out-of-range keys itself; local_c goes in raw
     dg = tdigest.update(
-        tdigest.TDigestState(means, weights),
-        jnp.where(dmask, local_c, Cl), lat, dmask)
+        tdigest.TDigestState(means, weights), local_c, lat, dmask)
     return counts, ids, new_wm, dropped, dg.means, dg.weights
 
 
@@ -548,7 +551,8 @@ def _cms_delta_psum(shape, closed: session.ClosedSessions):
 
 def _session_fold(last_time, sess_start, clicks, watermark, dropped,
                   cms_table, cms_total, tk_keys, tk_ests, closed_n,
-                  clicks_n, user_idx, event_type, event_time, valid,
+                  clicks_n, lat_hist, now_rel,
+                  user_idx, event_type, event_time, valid,
                   *, gap_ms: int, lateness_ms: int, user_capacity: int):
     """One batch folded into a user shard + the replicated CMS/ring.
 
@@ -585,6 +589,13 @@ def _session_fold(last_time, sess_start, clicks, watermark, dropped,
 
     cms_state = cms.CMSState(cms_table, cms_total)
     topk = cms.TopKState(tk_keys, tk_ests)
+    # closures determined by this batch's evidence (see
+    # engine.sketches.SessionCMSEngine._device_step): one shared latency
+    # per batch; per-shard closure counts psum into the replicated
+    # histogram alongside the counters.
+    det_lat = jnp.maximum(
+        now_rel - jnp.max(jnp.where(valid, event_time, NEG)), 0)
+    det_bin = jnp.clip(det_lat // LAT_BIN_MS, 0, LAT_BINS - 1)
     for closed in (_globalize(closed_in, u0), _globalize(closed_carry, u0)):
         dt, dn = _cms_delta_psum(cms_table.shape, closed)
         cms_state = cms.CMSState(cms_state.table + dt,
@@ -592,18 +603,20 @@ def _session_fold(last_time, sess_start, clicks, watermark, dropped,
         gathered = _gather_closed(closed)
         topk = cms.update_topk(cms_state, topk, gathered.user,
                                gathered.valid)
-        closed_n = closed_n + jax.lax.psum(
+        n_closed = jax.lax.psum(
             jnp.sum(closed.valid.astype(jnp.int32)), MESH_AXES)
+        closed_n = closed_n + n_closed
+        lat_hist = lat_hist.at[det_bin].add(n_closed)
         clicks_n = clicks_n + jax.lax.psum(
             jnp.sum(jnp.where(closed.valid, closed.clicks, 0)), MESH_AXES)
 
     return (st.last_time, st.sess_start, st.clicks, new_wm, new_dropped,
             cms_state.table, cms_state.total, topk.keys, topk.ests,
-            closed_n, clicks_n)
+            closed_n, clicks_n, lat_hist)
 
 
 _SESS_STATE_SPECS = (P(MESH_AXES), P(MESH_AXES), P(MESH_AXES), P(), P(),
-                     P(), P(), P(), P(), P(), P())
+                     P(), P(), P(), P(), P(), P(), P())
 
 
 @functools.lru_cache(maxsize=None)
@@ -616,7 +629,7 @@ def _build_session_step(mesh: Mesh, gap_ms: int, lateness_ms: int,
 
     mapped = shard_map(
         body, mesh=mesh,
-        in_specs=_SESS_STATE_SPECS + (P(), P(), P(), P()),
+        in_specs=_SESS_STATE_SPECS + (P(), P(), P(), P(), P()),
         out_specs=_SESS_STATE_SPECS,
     )
     return jax.jit(mapped)
@@ -629,22 +642,24 @@ def _build_session_scan(mesh: Mesh, gap_ms: int, lateness_ms: int,
     ``[K, B]`` stacked batches in one dispatch, collectives inside the
     scan body (peer of ``engine.sketches._session_cms_scan``)."""
 
-    def body(lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl,
-             user_idx, event_type, event_time, valid):
+    def body(lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl, hist,
+             now_rel, user_idx, event_type, event_time, valid):
         def one(carry, xs):
             u, e, t, v = xs
-            return _session_fold(*carry, u, e, t, v, gap_ms=gap_ms,
+            return _session_fold(*carry, now_rel, u, e, t, v,
+                                 gap_ms=gap_ms,
                                  lateness_ms=lateness_ms,
                                  user_capacity=user_capacity), None
 
         carry, _ = jax.lax.scan(
-            one, (lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl),
+            one, (lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl,
+                  hist),
             (user_idx, event_type, event_time, valid))
         return carry
 
     mapped = shard_map(
         body, mesh=mesh,
-        in_specs=_SESS_STATE_SPECS + (P(None, None), P(None, None),
+        in_specs=_SESS_STATE_SPECS + (P(), P(None, None), P(None, None),
                                       P(None, None), P(None, None)),
         out_specs=_SESS_STATE_SPECS,
     )
@@ -653,8 +668,8 @@ def _build_session_scan(mesh: Mesh, gap_ms: int, lateness_ms: int,
 
 def _session_flush_fold(last_time, sess_start, clicks, watermark, dropped,
                         cms_table, cms_total, tk_keys, tk_ests, closed_n,
-                        clicks_n, *, gap_ms: int, lateness_ms: int,
-                        force: bool):
+                        clicks_n, lat_hist, now_rel, *, gap_ms: int,
+                        lateness_ms: int, force: bool):
     Ul = last_time.shape[0]
     u0 = _shard_index() * Ul
     local = session.SessionState(last_time, sess_start, clicks,
@@ -672,9 +687,17 @@ def _session_flush_fold(last_time, sess_start, clicks, watermark, dropped,
         jnp.sum(closed.valid.astype(jnp.int32)), MESH_AXES)
     clicks_n = clicks_n + jax.lax.psum(
         jnp.sum(jnp.where(closed.valid, closed.clicks, 0)), MESH_AXES)
+    if not force:
+        # time-expired closures: per-row due latency, shard-local rows
+        # psum into the replicated histogram (forced closures at close()
+        # are cut early and carry no meaningful latency)
+        due = expired.end + (gap_ms + lateness_ms)
+        delta = _hist_rows(jnp.zeros((LAT_BINS,), jnp.int32),
+                           jnp.maximum(now_rel - due, 0), expired.valid)
+        lat_hist = lat_hist + jax.lax.psum(delta, MESH_AXES)
     return (st.last_time, st.sess_start, st.clicks, st.watermark,
             st.dropped, cms_state.table, cms_state.total, topk.keys,
-            topk.ests, closed_n, clicks_n)
+            topk.ests, closed_n, clicks_n, lat_hist)
 
 
 @functools.lru_cache(maxsize=None)
@@ -684,7 +707,8 @@ def _build_session_flush(mesh: Mesh, gap_ms: int, lateness_ms: int,
         return _session_flush_fold(*args, gap_ms=gap_ms,
                                    lateness_ms=lateness_ms, force=force)
 
-    mapped = shard_map(body, mesh=mesh, in_specs=_SESS_STATE_SPECS,
+    mapped = shard_map(body, mesh=mesh,
+                       in_specs=_SESS_STATE_SPECS + (P(),),
                        out_specs=_SESS_STATE_SPECS)
     return jax.jit(mapped)
 
@@ -746,17 +770,18 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
             ests=jax.device_put(self.topk.ests, rep))
         self._closed_dev = jax.device_put(self._closed_dev, rep)
         self._clicks_dev = jax.device_put(self._clicks_dev, rep)
+        self.lat_hist = jax.device_put(self.lat_hist, rep)
 
     def _carry(self):
         return (self.state.last_time, self.state.sess_start,
                 self.state.clicks, self.state.watermark,
                 self.state.dropped, self.cms.table, self.cms.total,
                 self.topk.keys, self.topk.ests, self._closed_dev,
-                self._clicks_dev)
+                self._clicks_dev, self.lat_hist)
 
     def _uncarry(self, out) -> None:
         (lt, ss, ck, wm, dr, table, total, tkk, tke,
-         self._closed_dev, self._clicks_dev) = out
+         self._closed_dev, self._clicks_dev, self.lat_hist) = out
         self.state = session.SessionState(lt, ss, ck, wm, dr)
         self.cms = cms.CMSState(table, total)
         self.topk = cms.TopKState(tkk, tke)
@@ -764,7 +789,8 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
     def _device_step(self, batch) -> None:
         fn = _build_session_step(self.mesh, self.gap_ms, self.lateness,
                                  self.user_capacity)
-        self._uncarry(fn(*self._carry(), jnp.asarray(batch.user_idx),
+        self._uncarry(fn(*self._carry(), self._now_rel(),
+                         jnp.asarray(batch.user_idx),
                          jnp.asarray(batch.event_type),
                          jnp.asarray(batch.event_time),
                          jnp.asarray(batch.valid)))
@@ -772,13 +798,13 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
     def _device_scan(self, user_idx, event_type, event_time, valid) -> None:
         fn = _build_session_scan(self.mesh, self.gap_ms, self.lateness,
                                  self.user_capacity)
-        self._uncarry(fn(*self._carry(), user_idx, event_type, event_time,
-                         valid))
+        self._uncarry(fn(*self._carry(), self._now_rel(), user_idx,
+                         event_type, event_time, valid))
 
     def _sharded_flush(self, force: bool) -> None:
         fn = _build_session_flush(self.mesh, self.gap_ms, self.lateness,
                                   force)
-        self._uncarry(fn(*self._carry()))
+        self._uncarry(fn(*self._carry(), self._now_rel()))
 
     def _drain_device(self) -> None:
         self._sharded_flush(force=False)

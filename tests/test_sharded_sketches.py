@@ -155,14 +155,22 @@ def test_sharded_session_cms_matches_single_device(dshape):
         tk = cms.update_topk(cm, tk, closed.user, closed.valid)
         return cm, tk
 
+    from streambench_tpu.engine.sketches import LAT_BIN_MS, LAT_BINS
+
+    now_rel = 600_000
     ref_closed = 0
+    want_hist = np.zeros(LAT_BINS, np.int64)
     for user, et, tm, valid in batches:
         ref, in_b, carry = session.step(ref, user, et, tm, valid,
                                         gap_ms=gap, lateness_ms=late)
         ref_cms, ref_tk = absorb(ref_cms, ref_tk, in_b)
         ref_cms, ref_tk = absorb(ref_cms, ref_tk, carry)
-        ref_closed += int(np.asarray(in_b.valid).sum())
-        ref_closed += int(np.asarray(carry.valid).sum())
+        n_closed = (int(np.asarray(in_b.valid).sum())
+                    + int(np.asarray(carry.valid).sum()))
+        ref_closed += n_closed
+        det_bin = min(max(now_rel - int(tm[valid].max()), 0) // LAT_BIN_MS,
+                      LAT_BINS - 1)
+        want_hist[det_bin] += n_closed
 
     fn = _build_session_step(mesh, gap, late, U)
     lt = jnp.full((U,), -1, jnp.int32)
@@ -171,10 +179,11 @@ def test_sharded_session_cms_matches_single_device(dshape):
     carry_t = (lt, ss, ck, jnp.int32(0), jnp.int32(0),
                jnp.zeros((4, 256), jnp.int32), jnp.int32(0),
                jnp.full((M,), -1, jnp.int32), jnp.full((M,), -1, jnp.int32),
-               jnp.int32(0), jnp.int32(0))
+               jnp.int32(0), jnp.int32(0),
+               jnp.zeros((LAT_BINS,), jnp.int32))
     for user, et, tm, valid in batches:
-        carry_t = fn(*carry_t, user, et, tm, valid)
-    (lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl) = carry_t
+        carry_t = fn(*carry_t, jnp.int32(now_rel), user, et, tm, valid)
+    (lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl, hist) = carry_t
 
     assert np.array_equal(np.asarray(ref.last_time), np.asarray(lt))
     # sess_start/clicks only meaningful where a session is open
@@ -189,6 +198,9 @@ def test_sharded_session_cms_matches_single_device(dshape):
     assert int(ref_cms.total) == int(total)
     assert _ring_dict(ref_tk) == _ring_dict(cms.TopKState(tkk, tke))
     assert ref_closed == int(cn)
+    # the close->absorb latency histogram matches the per-batch
+    # evidence-latency accounting (same bins, same counts)
+    assert np.array_equal(want_hist, np.asarray(hist))
 
 
 def test_sharded_session_scan_matches_step_sequence():
@@ -199,18 +211,21 @@ def test_sharded_session_scan_matches_step_sequence():
     step_fn = _build_session_step(mesh, gap, late, U)
     scan_fn = _build_session_scan(mesh, gap, late, U)
 
+    from streambench_tpu.engine.sketches import LAT_BINS
+
+    now_rel = jnp.int32(600_000)
     init = (jnp.full((U,), -1, jnp.int32), jnp.zeros((U,), jnp.int32),
             jnp.zeros((U,), jnp.int32), jnp.int32(0), jnp.int32(0),
             jnp.zeros((4, 256), jnp.int32), jnp.int32(0),
             jnp.full((M,), -1, jnp.int32), jnp.full((M,), -1, jnp.int32),
-            jnp.int32(0), jnp.int32(0))
+            jnp.int32(0), jnp.int32(0), jnp.zeros((LAT_BINS,), jnp.int32))
 
     seq = init
     for user, et, tm, valid in batches:
-        seq = step_fn(*seq, user, et, tm, valid)
+        seq = step_fn(*seq, now_rel, user, et, tm, valid)
 
     cols = [np.stack(c) for c in zip(*batches)]
-    sc = scan_fn(*init, *cols)
+    sc = scan_fn(*init, now_rel, *cols)
 
     for a, b in zip(seq, sc):
         assert np.array_equal(np.asarray(a), np.asarray(b)), (a, b)

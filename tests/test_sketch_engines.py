@@ -269,3 +269,36 @@ def test_sliding_fused_scan_matches_per_batch_counts():
     wa = np.asarray(a.digest.weights).sum(axis=1)
     wb = np.asarray(b.digest.weights).sum(axis=1)
     np.testing.assert_array_equal(wa, wb)
+
+
+def test_session_latency_quantile_reads_histogram():
+    """latency_quantile interpolates the device histogram correctly and
+    reports (values, count); empty histogram reports ([], 0)."""
+    import jax.numpy as jnp
+
+    from streambench_tpu.engine.sketches import (
+        LAT_BIN_MS,
+        LAT_BINS,
+        SessionCMSEngine,
+    )
+    from streambench_tpu.config import default_config
+
+    mapping = {f"ad{i}": f"c{i % 5}" for i in range(20)}
+    eng = SessionCMSEngine(default_config(), mapping)
+    assert eng.latency_quantile((0.5, 0.99)) == ([], 0)
+
+    hist = [0] * LAT_BINS
+    hist[0] = 50   # [0, 250) ms
+    hist[3] = 50   # [750, 1000) ms
+    eng.lat_hist = jnp.asarray(hist, jnp.int32)
+    vals, n = eng.latency_quantile((0.5, 1.0))
+    assert n == 100
+    # p50 sits at the boundary of bin 0; p100 at the top of bin 3
+    assert 0 <= vals[0] <= 1 * LAT_BIN_MS
+    assert 3 * LAT_BIN_MS <= vals[1] <= 4 * LAT_BIN_MS
+    # overflow bin reports its lower edge
+    hist = [0] * LAT_BINS
+    hist[LAT_BINS - 1] = 10
+    eng.lat_hist = jnp.asarray(hist, jnp.int32)
+    vals, n = eng.latency_quantile((0.5,))
+    assert n == 10 and vals[0] == (LAT_BINS - 1) * LAT_BIN_MS
