@@ -39,6 +39,13 @@ BASELINE_EVENTS_PER_S = 100_000.0
 # formatter; higher rates shard across processes (see _paced_latency_phase).
 PRODUCER_MAX_RATE = 400_000
 
+
+def _n_producers(rate: int) -> int:
+    """Producer processes a paced rate shards across — THE one policy,
+    used both to launch producers and to split per-producer knobs like
+    the session row's user universe."""
+    return max(1, -(-rate // PRODUCER_MAX_RATE))
+
 PROBE_TIMEOUT_S = float(os.environ.get("STREAMBENCH_BENCH_PROBE_TIMEOUT", "90"))
 # Keep retrying the hardware backend for this long before falling back to
 # CPU.  A healthy backend passes the FIRST probe, so the window costs
@@ -365,7 +372,7 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
     # scales load the same way: kafka.partitions + parallel producers).
     # With the native formatter one producer sustains ~500k ev/s, and on
     # small hosts every extra process is contention — so split late.
-    n_prod = max(1, -(-rate // PRODUCER_MAX_RATE))
+    n_prod = _n_producers(rate)
     broker.create_topic(topic, n_prod)
 
     # Engine construction + warmup happen BEFORE the producers launch:
@@ -749,7 +756,7 @@ def _run_all_configs(cfg, mapping, broker, wd, n_events: int,
     # engine's session-slot capacity scales to hold it.
     sess_users = max(50_000, 4 * paced_rate)
     sess_cap = 1 << max(16, (2 * sess_users - 1).bit_length())
-    sess_n_prod = max(1, -(-paced_rate // PRODUCER_MAX_RATE))
+    sess_n_prod = _n_producers(paced_rate)
     measure("session_cms",
             lambda r: SessionCMSEngine(cfg_sketch, mapping, redis=r,
                                        gap_ms=5_000,

@@ -103,6 +103,15 @@ def ws_read_frame(rfile) -> tuple[int, bytes] | None:
     return opcode, payload
 
 
+# Per-message bounds: query messages are small JSON; anything larger is
+# a malformed or hostile client and the connection closes rather than
+# letting it grow server memory (frame lengths are client-controlled
+# 64-bit values, and fragmented messages could otherwise accumulate
+# without limit).
+MAX_FRAME_BYTES = 4 << 20
+MAX_LINE_BYTES = 64 << 10
+
+
 class _SockStream:
     """recv-based reader whose buffer SURVIVES socket timeouts.
 
@@ -133,8 +142,14 @@ class _SockStream:
 
     def readline(self) -> bytes:
         """One newline-terminated line; idle timeouts keep waiting.
-        Returns b'' on EOF with an empty buffer."""
+        Returns b'' on EOF with an empty buffer, and b'' (dropping the
+        buffer) when a "line" exceeds MAX_LINE_BYTES — callers treat
+        that as a dead peer and close."""
         while b"\n" not in self._buf:
+            if len(self._buf) > MAX_LINE_BYTES:
+                self._buf.clear()
+                self._eof = True
+                return b""
             try:
                 if not self._fill():
                     break
@@ -191,6 +206,8 @@ def read_ws_frame_stream(stream: _SockStream
         if ext is None:
             return None
         n = struct.unpack(">Q", ext)[0]
+    if n > MAX_FRAME_BYTES:  # hostile/corrupt length: drop the peer
+        return None
     mk = stream.read_exact(4) if masked else None
     if masked and mk is None:
         return None
@@ -270,6 +287,8 @@ class _Handler(socketserver.StreamRequestHandler):
                     if not fragmented:
                         continue  # stray continuation: drop
                     fragments += payload
+                    if len(fragments) > MAX_FRAME_BYTES:
+                        return  # unbounded reassembly: drop the peer
                     if not fin:
                         continue
                     payload, fragments, fragmented = fragments, b"", False

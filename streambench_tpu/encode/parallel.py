@@ -78,7 +78,7 @@ class ParallelEncodePool:
     def _job_block(self, data: bytes, batch_size: int, base: int,
                    start: int, end: int):
         return self._worker_enc(base).carve_block(
-            data, batch_size, start=start, end=end)[0]
+            data, batch_size, start=start, end=end)
 
     def carve_block_parallel(self, data: bytes, batch_size: int
                              ) -> tuple[list, int]:
@@ -110,12 +110,25 @@ class ParallelEncodePool:
             pos = data.find(b"\n", max(want, cuts[-1]))
             cuts.append(pos + 1 if pos >= 0 else n)
         cuts.append(n)
-        futures = [self._pool.submit(self._job_block, data, batch_size,
-                                     base, a, b)
-                   for a, b in zip(cuts, cuts[1:]) if a < b]
+        jobs = [(a, b, self._pool.submit(self._job_block, data,
+                                         batch_size, base, a, b))
+                for a, b in zip(cuts, cuts[1:]) if a < b]
         batches = head
-        for fut in futures:
-            batches += fut.result()
+        for a, b, fut in jobs:
+            got, stop = fut.result()
+            batches += got
+            # The reported consumption below assumes every worker parsed
+            # its whole region (interior cuts are newline-aligned; the
+            # last region may hold an unterminated tail).  Verify with
+            # the stop offset the worker actually reached — an early
+            # stop would silently drop records while still reporting
+            # them consumed.
+            expect = (b if data[b - 1] == 0x0A
+                      else max(data.rfind(b"\n", a, b) + 1, a))
+            if stop != expect:
+                raise RuntimeError(
+                    f"parallel carve worker stopped at {stop}, expected "
+                    f"{expect} for region [{a}, {b})")
         # consumption: everything but an unterminated trailing record
         nl_end = data.rfind(b"\n") + 1
         return repack_batches(batches, batch_size), max(start, nl_end)

@@ -420,10 +420,17 @@ class MicroBatchCheckpointer:
             r = m.result
             arrays[f"counts_{r.partition}"] = np.stack(
                 [r.counts[w] for w in range(k0, k)])
+            stamps = [r.stamps[w] for w in range(k0, k)]
+            chunk_stamps = set(stamps)
             per_part.append({
                 "partition": r.partition,
-                "stamps": [r.stamps[w] for w in range(k0, k)],
-                "latency": sorted(r.latency.items()),
+                "stamps": stamps,
+                # ONLY this chunk's windows, looked up by the chunk's
+                # own stamps so save cost is O(chunk) — iterating the
+                # cumulative map would still grow O(total windows) per
+                # save inside the barrier action; load() merges chunks
+                "latency": sorted((s, r.latency[s]) for s in chunk_stamps
+                                  if s in r.latency),
                 "offset": r.offsets[k - 1],
                 "events": r.events, "windows": r.windows,
                 "started_ms": r.started_ms, "finished_ms": r.finished_ms,
@@ -445,6 +452,7 @@ class MicroBatchCheckpointer:
             return None
         chunks: dict[int, list[np.ndarray]] = {}
         stamps: dict[int, list[int]] = {}
+        latency: dict[int, dict] = {}
         expect = 0
         meta = None
         for path in files:
@@ -461,8 +469,13 @@ class MicroBatchCheckpointer:
                         z[f"counts_{p['partition']}"])
                     stamps.setdefault(p["partition"], []).extend(
                         p["stamps"])
+                    # per-chunk latency entries merge across the chain
+                    # (later chunks win for a re-observed stamp)
+                    latency.setdefault(p["partition"], {}).update(
+                        dict(p["latency"]))
         for p in meta["parts"]:
             p["stamps"] = stamps[p["partition"]]
+            p["latency"] = sorted(latency[p["partition"]].items())
         counts = {part: np.concatenate(cs) for part, cs in chunks.items()}
         self._saved_upto = meta["k"]
         return meta["k"], meta, counts

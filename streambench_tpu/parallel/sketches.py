@@ -74,9 +74,13 @@ def shard_map(body, **kw):
     where its out_spec says — but jax's varying-mesh-axes inference
     treats ``all_gather`` results as varying over the gathered axis and
     cannot prove it.  The alternative (pmax/psum laundering) would move
-    O(C*W*R) register bytes over ICI per step, defeating the design; the
-    bit-identity tests against the single-device kernels are the proof
-    the static check cannot give.
+    O(C*W*R) register bytes over ICI per step, defeating the design.
+
+    With the static check off, the multi-device bit-identity tests
+    (``tests/test_sharded_sketches.py``, run on the 8-CPU mesh in CI)
+    are the SOLE replication guard for these kernels: an edit that
+    breaks output replication will only be caught there, so those tests
+    are mandatory for any change to this module.
     """
     try:
         return _shard_map_raw(body, check_vma=False, **kw)
