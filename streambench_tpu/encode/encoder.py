@@ -223,6 +223,11 @@ class EventEncoder:
             cache = self._user_key_cache = list(self.user_index)
         return cache[idx]
 
+    def num_interned_users(self) -> int:
+        """Interned-user count (session engines size legacy-snapshot
+        reseeding by it; the native encoder reads its C-side table)."""
+        return len(self.user_index)
+
     def _intern(self, table: dict[bytes, int], key: bytes) -> int:
         idx = table.get(key)
         if idx is None:

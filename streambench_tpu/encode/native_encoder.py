@@ -87,6 +87,28 @@ class NativeEventEncoder(EventEncoder):
             out.append([raw[offsets[i]:offsets[i + 1]] for i in range(n)])
         return out[0], out[1]
 
+    def user_key(self, idx: int) -> bytes:
+        """Reverse lookup of an interned user index (heavy-hitter
+        reports): the C-side table dumps once and re-dumps only when a
+        newer index appears."""
+        cache = getattr(self, "_user_key_cache", None)
+        if cache is None or idx >= len(cache):
+            cache, _ = self.dump_intern_tables()
+            self._user_key_cache = cache
+        return cache[idx]
+
+    def num_interned_users(self) -> int:
+        return int(self._lib.sb_encoder_n_users(self._enc))
+
+    def _intern(self, table: dict, key: bytes) -> int:
+        """Python-side parse paths (the tbl wire format, encode_tbl)
+        must intern through the SAME C-side maps the native scanner
+        uses — a Python-dict side table would make reverse lookups and
+        intern snapshots see only part of the universe."""
+        fn = (self._lib.sb_intern_user if table is self.user_index
+              else self._lib.sb_intern_page)
+        return int(fn(self._enc, key, len(key)))
+
     def restore_intern_tables(self, users: list[bytes],
                               pages: list[bytes]) -> None:
         if self._lib.sb_encoder_n_users(self._enc) or \

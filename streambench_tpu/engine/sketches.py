@@ -459,10 +459,10 @@ class SessionCMSEngine(_SketchEngineBase):
                  cms_depth: int = 4, cms_width: int = 2048,
                  top_k: int = 16, candidate_capacity: int | None = None,
                  input_format: str = "json"):
-        # The heavy-hitter report needs user-id NAMES; only the Python
-        # encoder keeps the user intern table host-side (the native one
-        # interns in C with no reverse lookup), so pin it here.
-        cfg = dataclasses.replace(cfg, jax_use_native_encoder=False)
+        # The heavy-hitter report needs user-id NAMES: the native
+        # encoder serves them through its intern-table dump
+        # (``NativeEncoder.user_key``), so the C scan path — and with it
+        # block ingest — stays available to the session engine.
         super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
                          redis=redis, input_format=input_format)
         self.gap_ms = gap_ms
@@ -480,6 +480,10 @@ class SessionCMSEngine(_SketchEngineBase):
         # close->absorb latency histogram (VERDICT r4 #5: config #4 must
         # carry a latency number like every other workload, core.clj:149)
         self.lat_hist = jnp.zeros((LAT_BINS,), jnp.int32)
+        # Sessions keep NO window ring: the inherited span guard (sized
+        # for ring reuse) would force wide catchup groups down the
+        # per-batch path for nothing — let the scan fold whole chunks.
+        self._span_guard = 2**31 - 1
 
     ENGINE_FAMILY = "session_cms"
     # The fused scan keeps session windowing + CMS + ring + counters on
@@ -573,7 +577,7 @@ class SessionCMSEngine(_SketchEngineBase):
             self._seed_topk_from_universe()
 
     def _seed_topk_from_universe(self, chunk: int = 8192) -> None:
-        n = len(self.encoder.user_index)
+        n = self.encoder.num_interned_users()
         for off in range(0, n, chunk):
             keys = np.zeros(chunk, np.int32)
             width = min(chunk, n - off)
