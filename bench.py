@@ -472,6 +472,19 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
     engine.close()
     wall = time.monotonic() - t0
     log(engine.tracer.report())
+    if runner.stats.events == 0 and sent.get("n"):
+        # Observed once (round 5): producers emitted, engine read nothing
+        # for the whole run.  Record everything needed to diagnose a
+        # recurrence instead of leaving a bare zero in the artifact.
+        for p_idx in range(n_prod):
+            path = broker.topic_path(topic, p_idx)
+            try:
+                size = os.path.getsize(path)
+            except OSError as e:
+                size = f"stat failed: {e}"
+            log(f"ZERO-CONSUMPTION DIAGNOSTIC: topic={topic} "
+                f"partition={p_idx} journal={path} bytes={size} "
+                f"reader_offset={getattr(reader, 'offset', '?')}")
     if not expect_windows:
         lats = []
         # Engines without canonical window rows can still carry the
