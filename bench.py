@@ -70,28 +70,11 @@ def log(msg: str) -> None:
 # ----------------------------------------------------------------------
 # backend resolution
 def _probe_backend(env: dict, timeout_s: float) -> tuple[bool, str]:
-    """Initialize jax in a THROWAWAY subprocess; return (ok, detail).
+    """Shared hang-proof subprocess probe (see utils.platform)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from streambench_tpu.utils.platform import probe_backend
 
-    In-process init can hang indefinitely when the hardware backend is
-    wedged (observed: rc=1 crash in round 1, a 120 s+ hang when re-judged
-    and again this round).  A subprocess can always be killed.
-    """
-    # Mirror pin_jax_platform: the image's sitecustomize overrides the
-    # JAX_PLATFORMS env var via jax.config, so the probe must re-pin the
-    # config or a cpu probe would still initialize the hardware backend.
-    code = ("import os, jax;\n"
-            "p = os.environ.get('JAX_PLATFORMS')\n"
-            "if p: jax.config.update('jax_platforms', p)\n"
-            "d = jax.devices(); print(jax.default_backend(), len(d))")
-    try:
-        p = subprocess.run([sys.executable, "-c", code], env=env,
-                           capture_output=True, text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return False, f"probe timed out after {timeout_s:.0f}s"
-    if p.returncode != 0:
-        tail = (p.stderr or "").strip().splitlines()[-1:]
-        return False, f"probe rc={p.returncode}: {' '.join(tail)}"
-    return True, p.stdout.strip()
+    return probe_backend(env, timeout_s)
 
 
 def resolve_platform(window_s: float = PROBE_WINDOW_S) -> str:

@@ -291,7 +291,43 @@ def op_stop_load() -> None:
         log(f"stats collection failed (rc={rc})")
 
 
+def _resolve_engine_platform() -> None:
+    """Probe the configured JAX backend in a THROWAWAY subprocess and
+    pin JAX_PLATFORMS=cpu for child processes when it will not
+    initialize.
+
+    Without this, an engine spawned while the hardware tunnel is wedged
+    hangs inside backend init and the 300 s readiness wait times out —
+    the same failure mode bench.py's probe exists to prevent.  The image
+    sets JAX_PLATFORMS to the hardware plugin globally, so the env var
+    being set proves nothing; the probe (which re-pins the config from
+    the env exactly like every CLI entry point) is what proves the
+    platform usable.  CPU is trusted without probing; probes at most
+    once per harness process."""
+    if getattr(_resolve_engine_platform, "_done", False):
+        return
+    _resolve_engine_platform._done = True  # type: ignore[attr-defined]
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want == "cpu":
+        return
+    from streambench_tpu.utils.platform import probe_backend
+
+    ok, detail = probe_backend(timeout_s=90)
+    if ok:
+        log(f"JAX backend ({want or 'ambient'}) ok: {detail}")
+    else:
+        log(f"JAX backend ({want or 'ambient'}) will not initialize "
+            f"({detail}); pinning child processes to CPU")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+# Byte offset where the CURRENT engine instance's log begins (engine.log
+# appends across runs); evidence checks read nothing before it.
+_ENGINE_LOG_START = 0
+
+
 def op_start_jax_processing() -> None:
+    _resolve_engine_platform()
     args = ["--confPath", CONF_FILE, "--workdir", WORKDIR,
             "--brokerDir", BROKER_DIR]
     if SHARDED:
@@ -305,6 +341,10 @@ def op_start_jax_processing() -> None:
         return
     logpath = os.path.join(LOG_DIR, "engine.log")
     log_start = os.path.getsize(logpath) if os.path.exists(logpath) else 0
+    # Remember where THIS instance's log begins (the log appends), so
+    # evidence checks never read a previous run's lines.
+    global _ENGINE_LOG_START
+    _ENGINE_LOG_START = log_start
     pid = start_if_needed("engine", _py("streambench_tpu.engine", *args))
     # Wait until the engine has pre-compiled and printed its ready marker,
     # so a following START_LOAD measures the stream, not XLA compilation.
@@ -336,6 +376,17 @@ def op_jax_test() -> None:
         op_jax_microbatch_test()
         return
     op_setup()
+    # Fix the cause, not just the symptom, of the stale-engine false
+    # pass: a composite test must never adopt an engine left over from a
+    # previous (possibly crashed or hung) run via its pidfile.
+    if running_pid("engine") is not None:
+        log("stopping stale engine from a previous run")
+        stop_if_needed("engine")
+    # ... and only THIS run's stats may count as evidence
+    try:
+        os.unlink(os.path.join(WORKDIR, "seen.txt"))
+    except OSError:
+        pass
     op_start_redis()
     op_start_jax_processing()
     op_start_load()
@@ -344,6 +395,37 @@ def op_jax_test() -> None:
     op_stop_load()
     op_stop_jax_processing()
     op_stop_redis()
+    # A composite test that produced load but measured NOTHING is a
+    # failure (observed: a stale hung engine from a crashed previous run
+    # was reused via its pidfile and the test "passed" with zero
+    # windows), not a quiet success.  The session engine writes no
+    # canonical window rows, so its evidence is the engine's own final
+    # stats line instead of seen.txt.
+    if ENGINE == "session":
+        evidence, what = "", "events"
+        try:
+            with open(os.path.join(LOG_DIR, "engine.log")) as f:
+                f.seek(_ENGINE_LOG_START)  # only THIS run's lines
+                for line in f:
+                    if '"events"' in line:
+                        evidence = line.strip()
+        except OSError:
+            pass
+        ok = '"events": 0' not in evidence and evidence != ""
+    else:
+        what = "window rows"
+        try:
+            n_windows = sum(1 for _ in open(
+                os.path.join(WORKDIR, "seen.txt")))
+        except OSError:
+            n_windows = 0
+        ok = n_windows > 0
+        evidence = f"{n_windows} rows"
+    if not ok:
+        raise SystemExit(
+            f"JAX_TEST measured no {what} — the engine processed "
+            "nothing (stale/hung engine process? check logs/engine.log)")
+    log(f"JAX_TEST evidence: {evidence}")
 
 
 def op_jax_microbatch() -> None:
@@ -351,15 +433,35 @@ def op_jax_microbatch() -> None:
     foreground catchup over the journaled topic (the fork replays its
     events file the same way, ``AdvertisingTopologyNative.java:97-99``),
     dumping the fork-format latency hash to Redis."""
+    _resolve_engine_platform()
     args = ["--confPath", CONF_FILE, "--workdir", WORKDIR,
             "--brokerDir", BROKER_DIR, "--microbatch"]
     if ENGINE != "exact":
         args += ["--engine", ENGINE]
     if CHECKPOINT_DIR:
         args += ["--checkpointDir", CHECKPOINT_DIR]
+    logpath = os.path.join(LOG_DIR, "microbatch.log")
+    log_start = os.path.getsize(logpath) if os.path.exists(logpath) else 0
     rc = _run_tool(_py("streambench_tpu.engine", *args), "microbatch")
     if rc != 0:
         raise SystemExit(f"microbatch run failed (rc={rc})")
+    # Same zero-measurement guard as JAX_TEST: a microbatch run that
+    # folded no events (empty journal, silent load failure) must not
+    # pass quietly.  Only THIS invocation's log bytes count.
+    evidence = ""
+    try:
+        with open(logpath) as f:
+            f.seek(log_start)
+            for line in f:
+                if '"events"' in line:
+                    evidence = line.strip()
+    except OSError:
+        pass
+    if not evidence or '"events": 0,' in evidence:
+        raise SystemExit(
+            "microbatch run measured no events — nothing was folded "
+            "(empty journal? see logs/microbatch.log)")
+    log(f"microbatch evidence: {evidence}")
 
 
 def op_jax_microbatch_test() -> None:

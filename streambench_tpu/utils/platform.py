@@ -24,3 +24,33 @@ def pin_jax_platform(platform: str | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", platform)
+
+
+def probe_backend(env: dict | None = None,
+                  timeout_s: float = 90.0) -> tuple[bool, str]:
+    """Initialize jax in a THROWAWAY subprocess; return (ok, detail).
+
+    In-process init can hang indefinitely when the hardware backend is
+    wedged (a dead chip tunnel); a subprocess can always be killed.  The
+    probe re-pins the config from JAX_PLATFORMS exactly like
+    ``pin_jax_platform`` (the image's sitecustomize overrides the env
+    var via jax.config).  THE one copy, shared by bench.py's platform
+    resolution and the harness's engine-spawn guard."""
+    import subprocess
+    import sys
+
+    code = ("import os, jax\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "d = jax.devices(); print(jax.default_backend(), len(d))")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ) if env is None else env,
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s"
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-1:]
+        return False, f"probe rc={p.returncode}: {' '.join(tail)}"
+    return True, p.stdout.strip()
