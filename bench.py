@@ -938,17 +938,18 @@ def main() -> int:
     # catchup journal was written) — which would be charged to the engine
     # as window latency.  Only if tmpfs can hold the run: ~250 B/event x
     # (journal + topic copy) + the paced rungs' topics, with headroom.
-    tmp_base = None
+    tmp_base = os.environ.get("STREAMBENCH_BENCH_TMPDIR")
     need_bytes = n_events * 250 * 2 + 10 * (1 << 30)
-    try:
-        sv = os.statvfs("/dev/shm")
-        if sv.f_bavail * sv.f_frsize >= need_bytes:
-            tmp_base = "/dev/shm"
-        else:
-            log("tmpfs too small for the dataset; workdir stays on disk "
-                "(paced latencies may include writeback stalls)")
-    except OSError:
-        pass
+    if tmp_base is None:
+        try:
+            sv = os.statvfs("/dev/shm")
+            if sv.f_bavail * sv.f_frsize >= need_bytes:
+                tmp_base = "/dev/shm"
+            else:
+                log("tmpfs too small for the dataset; workdir stays on "
+                    "disk (paced latencies may include writeback stalls)")
+        except OSError:
+            pass
     with tempfile.TemporaryDirectory(dir=tmp_base) as wd:
         r = as_redis(make_store())
         broker = FileBroker(os.path.join(wd, "broker"))

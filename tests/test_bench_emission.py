@@ -1,0 +1,125 @@
+"""The bench's parse-proof emission contract, as a regression test.
+
+The driver records bench stdout and takes the LAST JSON line; it may
+SIGKILL the process at an unknown timeout.  Round 4 lost its entire
+artifact to a single end-of-run print, so round 5 made the bench
+re-emit the headline after every completed phase.  These tests pin that
+contract: a line exists almost immediately, every line parses, and a
+SIGKILL mid-run still leaves a parseable last line.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SMOKE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "STREAMBENCH_BENCH_EVENTS": "30000",
+    "STREAMBENCH_BENCH_REPS": "1",
+    "STREAMBENCH_BENCH_SWEEP_RUNS": "1",
+    "STREAMBENCH_BENCH_PACED_SECS": "5",
+    "STREAMBENCH_BENCH_PACED_RATE": "2000",
+    "STREAMBENCH_BENCH_CONFIGS": "0",  # skip the sketch/config suite
+    # the artifact side file must not clobber the repo's committed one
+    "STREAMBENCH_BENCH_TRACE": "0",
+}
+
+
+def _env(tmp_path, extra=None):
+    env = dict(os.environ)
+    env.update(SMOKE_ENV)
+    # the copied bench.py must find the package
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the bench's workdir lands under pytest's tmp (pruned even when a
+    # SIGKILL skips the bench's own TemporaryDirectory cleanup)
+    env["STREAMBENCH_BENCH_TMPDIR"] = str(tmp_path)
+    env.update(extra or {})
+    return env
+
+
+def _json_lines(text: str):
+    out = []
+    for line in text.splitlines():
+        if line.startswith("{"):
+            out.append(json.loads(line))  # EVERY emitted line must parse
+    return out
+
+
+@pytest.fixture()
+def bench_copy(tmp_path):
+    """bench.py run from a copy next to a scratch streambench_tpu import
+    path, so its bench_latency.json lands in tmp, not the repo."""
+    import shutil
+
+    shutil.copy(os.path.join(REPO, "bench.py"), tmp_path / "bench.py")
+    return str(tmp_path / "bench.py")
+
+
+def test_bench_emits_parseable_line_per_phase(bench_copy, tmp_path):
+    p = subprocess.run(
+        [sys.executable, bench_copy], env=_env(tmp_path), cwd=REPO,
+        capture_output=True, text=True, timeout=420)
+    assert p.returncode == 0, p.stderr[-800:]
+    lines = _json_lines(p.stdout)
+    # probe, setup, pre-oracle, post-oracle, >=1 rung, complete
+    assert len(lines) >= 5
+    phases = [d["phase"] for d in lines]
+    assert phases[0] == "probe" and phases[-1] == "complete"
+    last = lines[-1]
+    assert last["metric"] == "sustained events/sec (oracle-verified)"
+    assert last["value"] > 0
+    assert last["unit"] == "events/s"
+    # the pre-oracle line must NOT claim verification
+    pending = [d for d in lines if "pending" in d["phase"]]
+    assert all("PENDING" in d["metric"] for d in pending)
+    # the side artifact mirrors the final view
+    side = json.load(open(tmp_path / "bench_latency.json"))
+    assert side["phase"] == "complete"
+    assert side["catchup_events_per_s"] == last["value"]
+
+
+def test_bench_sigkill_leaves_parseable_artifact(bench_copy, tmp_path):
+    """SIGKILL right after the oracle-verified catchup emission (the
+    earliest point the driver's kill matters): whatever already hit
+    stdout must parse, with the newest line the richest view."""
+    import selectors
+
+    proc = subprocess.Popen(
+        [sys.executable, bench_copy], env=_env(tmp_path), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    got = []
+    deadline = time.monotonic() + 300
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    buf = ""
+    try:
+        # a selector-bounded read loop: a wedged bench FAILS the test at
+        # the deadline instead of hanging the suite on readline()
+        while time.monotonic() < deadline and len(got) < 4:
+            if not sel.select(timeout=max(deadline - time.monotonic(),
+                                          0.1)):
+                continue
+            chunk = os.read(proc.stdout.fileno(), 65536).decode(
+                "utf-8", "replace")
+            if not chunk:
+                break
+            buf += chunk
+            while "\n" in buf:
+                line, buf = buf.split("\n", 1)
+                if line.startswith("{"):
+                    got.append(line)
+        proc.send_signal(signal.SIGKILL)
+    finally:
+        sel.close()
+        proc.wait(timeout=30)
+    assert len(got) >= 4, "bench never reached its catchup emission"
+    last = json.loads(got[-1])
+    assert last["value"] > 0
+    assert last["configs"][0]["config"] == "exact_count"
