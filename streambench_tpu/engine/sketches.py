@@ -227,22 +227,29 @@ def _sliding_tdigest_scan(win_state, digest, join_table, now_rel,
     One dispatch per chunk, digest samples taken against a single
     ``now_rel`` stamp captured at dispatch time (the same two-clock
     semantics as the per-batch path, which also reads the host clock
-    once per Python-level step)."""
+    once per Python-level step).  Latency samples accumulate in the
+    value-bucketed histogram across the whole chunk and compress into
+    the digest ONCE at the end — the scan body is pure O(B) scatters
+    (the per-batch compress was most of config #3's device time)."""
+    N = digest.means.shape[0]
 
     def body(carry, xs):
-        st, dg = carry
+        st, hn, hw = carry
         a, et, t, v = xs
         st = sliding.step(st, join_table, a, et, t, v, size_ms=size_ms,
                           slide_ms=slide_ms, lateness_ms=lateness_ms)
         lat = jnp.maximum(now_rel - t, 0)
         campaign = join_table[a]
         mask = v & (et == 0) & (campaign >= 0)
-        dg = tdigest.update(dg, campaign, lat, mask)
-        return (st, dg), None
+        w = jnp.where(mask, 1.0, 0.0).astype(jnp.float32)
+        # fold_hist masks out-of-range keys itself; campaign goes in raw
+        hn, hw = tdigest.fold_hist(hn, hw, campaign, lat, w, N)
+        return (st, hn, hw), None
 
-    carry, _ = jax.lax.scan(body, (win_state, digest),
-                            (ad_idx, event_type, event_time, valid))
-    return carry
+    (st, hn, hw), _ = jax.lax.scan(
+        body, (win_state,) + tdigest.hist_init(N),
+        (ad_idx, event_type, event_time, valid))
+    return st, tdigest.absorb_hist(digest, hn, hw)
 
 
 class SlidingTDigestEngine(_SketchEngineBase):
@@ -289,6 +296,12 @@ class SlidingTDigestEngine(_SketchEngineBase):
         self.base_lateness = cfg.jax_allowed_lateness_ms
         self.digest = tdigest.init_state(self.encoder.num_campaigns,
                                          compression=compression)
+        # The fused scan carries a [C, HIST_BINS] x2 float32 histogram
+        # (8 KB/campaign) across the chunk; past ~16k campaigns that
+        # transient dwarfs the digest state, so fall back to the
+        # per-batch path (sort-based _fold, O(C*K) memory) there.
+        if (self.encoder.num_campaigns * tdigest.HIST_BINS) > (1 << 24):
+            self.SCAN_SUPPORTED = False
 
     ENGINE_FAMILY = "sliding_tdigest"
     SCAN_SUPPORTED = True  # fused sliding+digest scan (columns: default)

@@ -303,7 +303,7 @@ def _sliding_td_fold(counts, window_ids, watermark, dropped, means,
                      weights, join_table, now_rel,
                      ad_idx, event_type, event_time, valid,
                      *, size_ms: int, slide_ms: int, lateness_ms: int,
-                     view_type: int):
+                     view_type: int, hist=None):
     """One batch folded into a campaign shard: S sliding memberships
     into the counts ring + latency samples into the shard's t-digests.
 
@@ -360,10 +360,17 @@ def _sliding_td_fold(counts, window_ids, watermark, dropped, means,
     # Latency sample per view event into the owner shard's digest.
     lat = jnp.maximum(now_rel - tm, 0)
     dmask = wanted & shard_mask
-    # tdigest.update masks out-of-range keys itself; local_c goes in raw
-    dg = tdigest.update(
-        tdigest.TDigestState(means, weights), local_c, lat, dmask)
-    return counts, ids, new_wm, dropped, dg.means, dg.weights
+    if hist is None:
+        # step form: fold + compress this one batch into the digest
+        # (tdigest.update masks out-of-range keys itself; local_c raw)
+        dg = tdigest.update(
+            tdigest.TDigestState(means, weights), local_c, lat, dmask)
+        return counts, ids, new_wm, dropped, dg.means, dg.weights
+    # scan form: O(B) histogram fold only; the caller absorbs once per
+    # chunk (fold_hist masks out-of-range local_c itself)
+    w = jnp.where(dmask, 1.0, 0.0).astype(jnp.float32)
+    hn, hw = tdigest.fold_hist(hist[0], hist[1], local_c, lat, w, Cl)
+    return counts, ids, new_wm, dropped, means, weights, (hn, hw)
 
 
 _SLIDING_STATE_SPECS = (P(CAMPAIGN_AXIS, None), P(), P(), P(),
@@ -400,17 +407,25 @@ def _build_sliding_scan(mesh: Mesh, size_ms: int, slide_ms: int,
 
     def body(counts, ids, wm, dr, means, weights, join_table, now_rel,
              ad_idx, event_type, event_time, valid):
-        def one(carry, xs):
-            a, e, t, v = xs
-            return _sliding_td_fold(
-                *carry, join_table, now_rel, a, e, t, v, size_ms=size_ms,
-                slide_ms=slide_ms, lateness_ms=lateness_ms,
-                view_type=view_type), None
+        Cl = counts.shape[0]
 
-        carry, _ = jax.lax.scan(
-            one, (counts, ids, wm, dr, means, weights),
+        def one(carry, xs):
+            c, i, w_, d, hn, hw = carry
+            a, e, t, v = xs
+            c, i, w_, d, _, _, (hn, hw) = _sliding_td_fold(
+                c, i, w_, d, means, weights, join_table, now_rel,
+                a, e, t, v, size_ms=size_ms, slide_ms=slide_ms,
+                lateness_ms=lateness_ms, view_type=view_type,
+                hist=(hn, hw))
+            return (c, i, w_, d, hn, hw), None
+
+        (c, i, w_, d, hn, hw), _ = jax.lax.scan(
+            one, (counts, ids, wm, dr) + tdigest.hist_init(Cl),
             (ad_idx, event_type, event_time, valid))
-        return carry
+        # one compress per chunk: the scan body stays O(B) scatters
+        dg = tdigest.absorb_hist(
+            tdigest.TDigestState(means, weights), hn, hw)
+        return c, i, w_, d, dg.means, dg.weights
 
     mapped = shard_map(
         body, mesh=mesh,

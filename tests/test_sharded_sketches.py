@@ -344,7 +344,13 @@ def test_sharded_sliding_step_matches_single_device(dshape):
 
 
 def test_sharded_sliding_scan_matches_step_sequence():
-    """One scanned dispatch == the same batches stepped one by one."""
+    """One scanned dispatch == the same batches stepped one by one.
+
+    Counts/ids/watermark/dropped are exact.  Digests compress once per
+    chunk on the scan path vs once per batch on the step path (the
+    histogram fold amortizes the compress), so centroid layouts differ
+    legitimately — compare what the cadence must conserve: total weight
+    per campaign (exactly) and quantiles (within digest tolerance)."""
     from streambench_tpu.parallel.sketches import (
         _build_sliding_scan,
         _build_sliding_step,
@@ -376,9 +382,22 @@ def test_sharded_sliding_scan_matches_step_sequence():
     cols = [np.stack([b[i] for b in batches]) for i in (0, 2, 3, 4)]
     got = scan(*fresh(), jt, now_rel, *(jnp.asarray(c) for c in cols))
 
-    for a, b in zip(carry, got):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-3)
+    for a, b in zip(carry[:4], got[:4]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    from streambench_tpu.ops import tdigest
+    w_step = np.asarray(carry[5]).sum(axis=1)
+    w_scan = np.asarray(got[5]).sum(axis=1)
+    np.testing.assert_allclose(w_scan, w_step, rtol=1e-6)
+    qs = jnp.asarray([0.5, 0.99], jnp.float32)
+    q_step = np.asarray(tdigest.quantile(
+        tdigest.TDigestState(jnp.asarray(carry[4]), jnp.asarray(carry[5])),
+        qs))
+    q_scan = np.asarray(tdigest.quantile(
+        tdigest.TDigestState(jnp.asarray(got[4]), jnp.asarray(got[5])),
+        qs))
+    sampled = w_step > 0
+    np.testing.assert_allclose(q_scan[sampled], q_step[sampled],
+                               rtol=0.12, atol=1.0)
 
 
 def test_sharded_sliding_engine_end_to_end(tmp_path):

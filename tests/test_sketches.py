@@ -142,6 +142,30 @@ def test_tdigest_empty_key_returns_zero():
     assert 3.0 < q[0, 0] < 6.0
 
 
+def test_tdigest_fold_hist_out_of_range_keys_drop():
+    """JAX normalizes negative scatter indices NumPy-style BEFORE the
+    mode='drop' bounds check — an unmasked negative key would wrap into
+    the LAST key's histogram row.  fold_hist must mask the key range
+    explicitly and clamp negative values (code-review findings)."""
+    hn, hw = tdigest.hist_init(4)
+    key = np.array([-1, 4, 2], np.int32)
+    val = np.array([5.0, 5.0, -3.0], np.float32)
+    w = np.ones(3, np.float32)
+    hn, hw = tdigest.fold_hist(hn, hw, jnp.asarray(key), jnp.asarray(val),
+                               jnp.asarray(w), 4)
+    hw_np = np.asarray(hw)
+    assert hw_np[3].sum() == 0          # key -1 must NOT wrap to key 3
+    assert hw_np.sum() == 1 and hw_np[2, 0] == 1  # only key 2 lands
+    assert np.asarray(hn).min() >= 0.0  # value -3 clamps to 0
+
+    # the per-batch update path applies the same key/value domain
+    st = tdigest.init_state(4, 16)
+    st = tdigest.update(st, jnp.asarray(key), jnp.asarray(val),
+                        jnp.asarray(np.ones(3, bool)))
+    wsum = np.asarray(st.weights).sum(axis=1)
+    assert wsum[3] == 0 and wsum[2] == 1 and wsum.sum() == 1
+
+
 def test_tdigest_tail_quantile_with_empty_centroids():
     """Digests with unoccupied centroid slots must not interpolate tail
     quantiles toward empty (mean-0) centroids (code-review finding)."""
