@@ -17,6 +17,7 @@ guard trips.  Wall-clock flush cadence to Redis stays the reference's 1 Hz
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from collections import defaultdict
@@ -248,6 +249,28 @@ class AdAnalyticsEngine:
         # ("compact", idx, vals, nnz, dense_handle, wids) or
         # ("rows", rows_np, n, row_block, wids)
         self._undrained: list[tuple] = []
+        # Drains parked one flush cycle ago whose device->host copies were
+        # started asynchronously (tunneled accelerators: a blocking pull
+        # costs ~150 ms fixed and, behind a backed-up transfer queue,
+        # seconds — the round-5 TPU trace billed 5.5 s of a 30 s paced run
+        # to exactly this).  flush() materializes THESE (data already
+        # local) and rotates the fresh drains in behind them; full
+        # materialization points (snapshot, final flush, catchup end)
+        # drain both lists.
+        self._undrained_ready: list[tuple] = []
+        backend = jax.default_backend()
+        defer_env = os.environ.get("STREAMBENCH_DEFER_DRAIN_PULL", "auto")
+        self._defer_pull = (backend != "cpu" if defer_env == "auto"
+                            else defer_env not in ("0", "false"))
+        # Packed wire word (ops.windowcount.pack_columns): only when this
+        # class's own device hooks are the exact-count kernels (subclasses
+        # that override them consume unpacked columns) and the ad space
+        # fits the 28-bit field.
+        self._pack_ok = self.encoder.join_table.size < wc.PACK_AD_MAX
+        self._packed_scan = (
+            self._pack_ok
+            and type(self)._device_scan is AdAnalyticsEngine._device_scan
+            and type(self)._device_step is AdAnalyticsEngine._device_step)
         # Dirty-campaign tracking (large key spaces only): per-batch
         # campaign sets accumulated host-side so a drain can gather just
         # the touched rows instead of walking C x W cells.
@@ -338,9 +361,16 @@ class AdAnalyticsEngine:
                 # power of two when the config says so).
                 sizes.append(self.scan_batches)
                 for k in sizes:
-                    cols = [jnp.asarray(np.stack([getattr(zb, c)] * k))
-                            for c in self.SCAN_COLUMNS]
-                    self._device_scan(*cols)
+                    if self._packed_scan:
+                        pk = wc.pack_columns(zb.ad_idx, zb.event_type,
+                                             zb.valid)
+                        self._device_scan_packed(
+                            jnp.asarray(np.stack([pk] * k)),
+                            jnp.asarray(np.stack([zb.event_time] * k)))
+                    else:
+                        cols = [jnp.asarray(np.stack([getattr(zb, c)] * k))
+                                for c in self.SCAN_COLUMNS]
+                        self._device_scan(*cols)
             self._drain_device()
             if self._track_dirty_rows():
                 # compile the dirty-rows drain program too (a ~3 s XLA
@@ -449,16 +479,30 @@ class AdAnalyticsEngine:
         while k < len(batches):
             k *= 2
         pad = min(k, self.scan_batches) - len(batches)
-        cols = []
-        for name in self.SCAN_COLUMNS:
-            arrs = [getattr(b, name) for b in batches]
-            if pad:
-                arrs += [np.zeros_like(arrs[0])] * pad
-            cols.append(jnp.asarray(np.stack(arrs)))
         if self._track_dirty_rows():
             self._note_batch_campaigns(batches)
-        with self.tracer.span("device_scan"):
-            self._device_scan(*cols)
+        if self._packed_scan:
+            # One packed word + time per event (8 B instead of 13 B in
+            # four buffers): a packed-zero pad row decodes to
+            # (ad 0, type -1, valid False) — masked everywhere.
+            packs = [wc.pack_columns(b.ad_idx, b.event_type, b.valid)
+                     for b in batches]
+            times = [b.event_time for b in batches]
+            if pad:
+                packs += [np.zeros_like(packs[0])] * pad
+                times += [np.zeros_like(times[0])] * pad
+            with self.tracer.span("device_scan"):
+                self._device_scan_packed(jnp.asarray(np.stack(packs)),
+                                         jnp.asarray(np.stack(times)))
+        else:
+            cols = []
+            for name in self.SCAN_COLUMNS:
+                arrs = [getattr(b, name) for b in batches]
+                if pad:
+                    arrs += [np.zeros_like(arrs[0])] * pad
+                cols.append(jnp.asarray(np.stack(arrs)))
+            with self.tracer.span("device_scan"):
+                self._device_scan(*cols)
         self.events_processed += sum(b.n for b in batches)
         self.last_event_ms = now_ms()
 
@@ -467,6 +511,15 @@ class AdAnalyticsEngine:
         self.state = wc.scan_steps(
             self.state, self.join_table, ad_idx, event_type, event_time,
             valid, divisor_ms=self.divisor, lateness_ms=self.lateness,
+            method=self.method)
+
+    def _device_scan_packed(self, packed, event_time) -> None:
+        """``_device_scan`` over the packed wire word (half the transfer
+        bytes, two buffers instead of four — see
+        ``ops.windowcount.pack_columns``)."""
+        self.state = wc.scan_steps_packed(
+            self.state, self.join_table, packed, event_time,
+            divisor_ms=self.divisor, lateness_ms=self.lateness,
             method=self.method)
 
     # ------------------------------------------------------------------
@@ -583,6 +636,15 @@ class AdAnalyticsEngine:
         """Fold one ``EncodedBatch`` into device state (subclass hook:
         the sharded engine swaps in the mesh version; sketch engines use
         additional columns like ``user_idx``)."""
+        if self._pack_ok:
+            packed = wc.pack_columns(batch.ad_idx, batch.event_type,
+                                     batch.valid)
+            self.state = wc.step_packed(
+                self.state, self.join_table, jnp.asarray(packed),
+                jnp.asarray(batch.event_time),
+                divisor_ms=self.divisor, lateness_ms=self.lateness,
+                method=self.method)
+            return
         self.state = wc.step(
             self.state, self.join_table,
             jnp.asarray(batch.ad_idx), jnp.asarray(batch.event_type),
@@ -675,14 +737,12 @@ class AdAnalyticsEngine:
                         self.state, jnp.asarray(padded),
                         divisor_ms=self.divisor,
                         lateness_ms=self.lateness)
-                    self._undrained.append(("rows_host", rows, sub_np,
-                                            wids))
+                    self._park(("rows_host", rows, sub_np, wids))
                 else:
                     sub, wids, self.state = wc.flush_deltas_rows(
                         self.state, jnp.asarray(padded),
                         divisor_ms=self.divisor, lateness_ms=self.lateness)
-                    self._undrained.append(("rows", rows, rows.size, sub,
-                                            wids))
+                    self._park(("rows", rows, rows.size, sub, wids))
                 self._span_start = None
                 return
             # touched set overflowed the cap: fall through to the full-
@@ -692,16 +752,37 @@ class AdAnalyticsEngine:
                 wc.flush_deltas_compact(
                     self.state, cap=self.COMPACT_DRAIN_CAP,
                     divisor_ms=self.divisor, lateness_ms=self.lateness)
-            self._undrained.append(("compact", idx, vals, nnz, dense,
-                                    wids))
+            self._park(("compact", idx, vals, nnz, dense, wids))
         else:
             deltas, wids, self.state = wc.flush_deltas(
                 self.state, divisor_ms=self.divisor,
                 lateness_ms=self.lateness)
-            self._undrained.append(("dense", deltas, wids))
+            self._park(("dense", deltas, wids))
         self._span_start = None
 
-    def _materialize_drains(self) -> None:
+    def _park(self, parked: tuple) -> None:
+        """Park a drain's device handles; on non-CPU backends also start
+        their device->host copies NOW, so a later materialization finds
+        the data already local instead of paying a blocking tunnel pull
+        (~150 ms fixed, seconds behind a backed-up transfer queue)."""
+        if self._defer_pull:
+            # The compact tuple's dense element is the ORIGINAL [C, W]
+            # counts handle, read only in the rare nnz-overflow case —
+            # async-copying it would occupy the tunnel with >= 16 MB per
+            # drain that is almost always discarded.
+            skip = {4} if parked[0] == "compact" else set()
+            for i, x in enumerate(parked):
+                if i in skip:
+                    continue
+                copy = getattr(x, "copy_to_host_async", None)
+                if copy is not None:
+                    try:
+                        copy()
+                    except Exception:
+                        pass  # backend without async copies: pull blocks
+        self._undrained.append(parked)
+
+    def _materialize_drains(self, ready_only: bool = False) -> None:
         """Merge parked drain results into the host pending buffers.
 
         Stays in numpy: the (campaign, window, count) triples land in
@@ -710,12 +791,21 @@ class AdAnalyticsEngine:
         ``_pending`` dict remains the slow-path buffer for reclaimed
         failed writes; ``_fold_pending_arrays`` merges the two views
         whenever dict semantics are required (snapshots).
+
+        ``ready_only`` materializes just the drains whose async host
+        copies were started a flush cycle ago (``_undrained_ready``);
+        the default drains everything, in dispatch order.
         """
-        if not self._undrained:
+        parked_list = self._undrained_ready
+        self._undrained_ready = []
+        if not ready_only:
+            parked_list = parked_list + self._undrained
+            self._undrained = []
+        if not parked_list:
             return
         base = self.encoder.base_time_ms or 0
         W = self.W
-        for parked in self._undrained:
+        for parked in parked_list:
             if parked[0] in ("rows", "rows_host"):
                 if parked[0] == "rows":
                     _, rows_np, nrow, sub_d, wids_d = parked
@@ -755,7 +845,6 @@ class AdAnalyticsEngine:
                     (ci.astype(np.int64),
                      base + wid.astype(np.int64) * self.divisor,
                      vals.astype(np.int64)))
-        self._undrained.clear()
 
     def _fold_pending_arrays(self) -> None:
         """Merge ``_pending_np`` array triples into the ``_pending`` dict
@@ -779,16 +868,31 @@ class AdAnalyticsEngine:
         self._fold_pending_arrays()
         return dict(self._pending)
 
-    def flush(self, time_updated: int | None = None) -> int:
+    def flush(self, time_updated: int | None = None, *,
+              final: bool = False) -> int:
         """Drain device + write all pending deltas to Redis.
 
         Stamps ``time_updated`` at actual write time (``core.clj:149``
         defines latency truth as ``time_updated − window_ts``).  Returns
         window rows written.
+
+        On tunneled accelerator backends a periodic (non-``final``)
+        flush materializes only the drains parked LAST cycle — their
+        async host copies have had a full flush interval to stream back
+        — and rotates this cycle's drains in behind them.  That bounds
+        the added write latency by one flush interval while removing the
+        blocking tunnel pull (~150 ms fixed, seconds when the transfer
+        queue is backed up) from the ingest loop.  ``final=True`` (end
+        of run, close, snapshots) drains everything.
         """
         with self.tracer.span("drain"):
             self._drain_device()
-            self._materialize_drains()
+            if self._defer_pull and not final:
+                self._materialize_drains(ready_only=True)
+                self._undrained_ready = self._undrained
+                self._undrained = []
+            else:
+                self._materialize_drains()
         self._reclaim_failed_writes()
         if not self._pending and not self._pending_np:
             return 0
@@ -980,6 +1084,7 @@ class AdAnalyticsEngine:
         """Re-establish every host-side field from snapshot meta."""
         self.drain_writes()
         self._undrained.clear()
+        self._undrained_ready.clear()
         self._dirty_rows = []
         if self._track_dirty_rows() and snap.counts.size:
             # restored counts may hold undrained cells the tracker never
@@ -1020,7 +1125,7 @@ class AdAnalyticsEngine:
     def close(self) -> None:
         """Final flush + fork-style latency dump
         (``AdvertisingTopologyNative.java:521-532``)."""
-        self.flush()
+        self.flush(final=True)
         if self._encode_pool is not None:
             self._encode_pool.close()
             self._encode_pool = None

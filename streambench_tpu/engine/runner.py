@@ -233,7 +233,7 @@ class StreamRunner:
 
         if pending:
             dispatch()
-        st.windows_written += self.engine.flush()
+        st.windows_written += self.engine.flush(final=True)
         st.flushes += 1
         if self.checkpointer is not None:
             self._checkpoint_now(time.monotonic())
@@ -278,7 +278,7 @@ class StreamRunner:
                 last_flush = now
                 if self._checkpoint_due(now):
                     self._checkpoint_now(now)
-        st.windows_written += self.engine.flush()
+        st.windows_written += self.engine.flush(final=True)
         st.flushes += 1
         if self.checkpointer is not None:
             self._checkpoint_now(time.monotonic())

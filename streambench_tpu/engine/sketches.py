@@ -658,7 +658,8 @@ class SessionCMSEngine(_SketchEngineBase):
             expired.valid)
         self._span_start = None
 
-    def flush(self, time_updated: int | None = None) -> int:
+    def flush(self, time_updated: int | None = None, *,
+              final: bool = False) -> int:
         self._drain_device()
         return 0  # sessions have no canonical window rows
 

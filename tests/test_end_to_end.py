@@ -83,3 +83,40 @@ def test_tiny_ring_forces_span_guard_drains(tmp_path):
     correct, differ, missing = gen.check_correct(r, str(tmp_path),
                                                  log=lambda s: None)
     assert differ == 0 and missing == 0 and correct > 0
+
+
+def test_deferred_drain_pull_conserves_counts(tmp_path, monkeypatch):
+    """STREAMBENCH_DEFER_DRAIN_PULL=1 (the tunneled-accelerator mode,
+    forced here on CPU): periodic flushes materialize one cycle late,
+    the final flush drains everything — the -c oracle must still see
+    every window CORRECT, and a mid-run flush must leave the fresh
+    drain parked for the next cycle."""
+    monkeypatch.setenv("STREAMBENCH_DEFER_DRAIN_PULL", "1")
+    cfg, r, broker, engine, reader = setup_run(tmp_path, events=12_000,
+                                               batch=256, slots=9)
+    assert engine._defer_pull
+    runner = StreamRunner(engine, reader, buffer_timeout_ms=20,
+                          flush_interval_ms=50)
+    stats = runner.run(idle_timeout_s=0.5)
+
+    # exercise the rotation invariant directly: with fresh device deltas,
+    # a non-final flush parks them (ready list) instead of writing
+    ads = [k.decode() for k in engine.encoder.ad_index]
+    extra_ms = engine.encoder.base_time_ms + 10_000_000
+    engine.process_lines([(
+        '{"user_id": "u", "page_id": "p", "ad_id": "%s", '
+        '"ad_type": "banner", "event_type": "view", "event_time": "%d", '
+        '"ip_address": "1.2.3.4"}' % (ads[0], extra_ms)).encode()])
+    engine.flush()
+    assert engine._undrained_ready, "fresh drain should be parked one cycle"
+    extra_ts = extra_ms // 10_000 * 10_000
+    assert extra_ts not in engine.window_latency, \
+        "deferred flush must not have written the fresh drain yet"
+    engine.close()  # final=True path drains the parked cycle
+    assert extra_ts in engine.window_latency, \
+        "final flush must write the one-cycle-parked drain"
+
+    assert stats.events == 12_000
+    correct, differ, missing = gen.check_correct(r, str(tmp_path),
+                                                 log=lambda s: None)
+    assert differ == 0 and missing == 0 and correct > 0
