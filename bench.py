@@ -713,6 +713,12 @@ def _run_all_configs(cfg, mapping, broker, wd, n_events: int,
                 if seed:
                     seed_campaigns(r, camps)
                 engine = factory(r)
+                # Compile EVERY program the run can hit before the clock
+                # starts: without this, rep 1 of every config row billed
+                # the XLA compiles to the measurement (the compact drain
+                # alone is ~7-12 s at C=1e6 on the tunneled chip — the
+                # recorded rep-1-always-slower pattern was exactly this).
+                engine.warmup()
                 runner = StreamRunner(
                     engine, broker_row.reader(cfg_row.kafka_topic),
                     flush_interval_ms=flush_interval_ms)
@@ -810,8 +816,14 @@ def _run_all_configs(cfg, mapping, broker, wd, n_events: int,
         # recorded number (observed 91k vs 193k across clean runs)
         ev5 = min(n_events, int(os.environ.get(
             "STREAMBENCH_BENCH_CONFIG5_EVENTS", "1000000")))
+        # scan_batches=1: with the 64-slot ring every 16-batch group
+        # outspans the span guard, so the scanned fold NEVER executes for
+        # this row — but warmup would still compile all 5 scan shapes,
+        # and each shard_map scan at C=1e6 is minutes of XLA compile on
+        # a small host (the round-5 bench lost its config5 paced phase
+        # to exactly that).  Per-batch folding is what actually runs.
         cfg5 = default_config(jax_window_slots=64,
-                              jax_scan_batches=cfg.jax_scan_batches,
+                              jax_scan_batches=1,
                               jax_batch_size=cfg.jax_batch_size,
                               jax_num_campaigns=1_000_000,
                               jax_ads_per_campaign=1)

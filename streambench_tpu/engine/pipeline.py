@@ -237,6 +237,11 @@ class AdAnalyticsEngine:
 
         # host-side bookkeeping
         self._span_start: int | None = None   # min unflushed event time (abs)
+        # Host mirror of the device watermark (max absolute event time
+        # folded): lets drains recompute the unflushed span WITHOUT a
+        # blocking device pull (sketch engines whose open windows stay
+        # on device need the oldest-possibly-open window after a drain).
+        self._host_wm: int | None = None
         # Deferred drains: (deltas, window_ids) DEVICE arrays from
         # flush_deltas calls whose host materialization is postponed.  The
         # device executes enqueued programs in order, so the ring is safe
@@ -264,15 +269,19 @@ class AdAnalyticsEngine:
         self._defer_pull = (backend != "cpu" if defer_env in ("auto", "")
                             else defer_env not in ("0", "false", "off",
                                                    "no"))
-        # Packed wire word (ops.windowcount.pack_columns): only when this
-        # class's own device hooks are the exact-count kernels (subclasses
-        # that override them consume unpacked columns) and the ad space
-        # fits the 28-bit field.
+        # Packed wire word (ops.windowcount.pack_columns): when the ad
+        # space fits the 28-bit field AND either this class's device
+        # hooks are the exact-count kernels (pure base) or the subclass
+        # ships its own packed scan (e.g. the sharded engine).  Sketch
+        # engines override _device_scan with extra columns and inherit
+        # the base _device_scan_packed -> excluded automatically.
         self._pack_ok = self.encoder.join_table.size < wc.PACK_AD_MAX
-        self._packed_scan = (
-            self._pack_ok
-            and type(self)._device_scan is AdAnalyticsEngine._device_scan
-            and type(self)._device_step is AdAnalyticsEngine._device_step)
+        self._packed_scan = self._pack_ok and (
+            type(self)._device_scan_packed
+            is not AdAnalyticsEngine._device_scan_packed
+            or (type(self)._device_scan is AdAnalyticsEngine._device_scan
+                and type(self)._device_step
+                is AdAnalyticsEngine._device_step))
         # Dirty-campaign tracking (large key spaces only): per-batch
         # campaign sets accumulated host-side so a drain can gather just
         # the touched rows instead of walking C x W cells.
@@ -320,6 +329,9 @@ class AdAnalyticsEngine:
     # EncodedBatch columns the scanned kernel consumes, in _device_scan
     # argument order (sketch engines need e.g. user_idx).
     SCAN_COLUMNS = ("ad_idx", "event_type", "event_time", "valid")
+    # Extra EncodedBatch columns a subclass's packed scan consumes
+    # between the packed word and event_time (e.g. HLL's user ids).
+    PACKED_EXTRA_COLS: tuple = ()
     # Engines whose kernel reads interned user/page columns must keep a
     # single consistent intern table and clear this (encode.parallel).
     PARALLEL_ENCODE_OK = True
@@ -366,9 +378,13 @@ class AdAnalyticsEngine:
                     if self._packed_scan:
                         pk = wc.pack_columns(zb.ad_idx, zb.event_type,
                                              zb.valid)
-                        self._device_scan_packed(
-                            jnp.asarray(np.stack([pk] * k)),
-                            jnp.asarray(np.stack([zb.event_time] * k)))
+                        cols = ([jnp.asarray(np.stack([pk] * k))]
+                                + [jnp.asarray(np.stack(
+                                    [getattr(zb, c)] * k))
+                                   for c in self.PACKED_EXTRA_COLS]
+                                + [jnp.asarray(np.stack(
+                                    [zb.event_time] * k))])
+                        self._device_scan_packed(*cols)
                     else:
                         cols = [jnp.asarray(np.stack([getattr(zb, c)] * k))
                                 for c in self.SCAN_COLUMNS]
@@ -484,18 +500,25 @@ class AdAnalyticsEngine:
         if self._track_dirty_rows():
             self._note_batch_campaigns(batches)
         if self._packed_scan:
-            # One packed word + time per event (8 B instead of 13 B in
-            # four buffers): a packed-zero pad row decodes to
-            # (ad 0, type -1, valid False) — masked everywhere.
+            # One packed word (+ any engine extras, e.g. HLL's user ids)
+            # + time per event instead of four-to-five buffers: a
+            # packed-zero pad row decodes to (ad 0, type -1,
+            # valid False) — masked everywhere.
             packs = [wc.pack_columns(b.ad_idx, b.event_type, b.valid)
                      for b in batches]
+            extras = [[getattr(b, c) for b in batches]
+                      for c in self.PACKED_EXTRA_COLS]
             times = [b.event_time for b in batches]
             if pad:
                 packs += [np.zeros_like(packs[0])] * pad
+                for arrs in extras:
+                    arrs += [np.zeros_like(arrs[0])] * pad
                 times += [np.zeros_like(times[0])] * pad
+            cols = ([jnp.asarray(np.stack(packs))]
+                    + [jnp.asarray(np.stack(a)) for a in extras]
+                    + [jnp.asarray(np.stack(times))])
             with self.tracer.span("device_scan"):
-                self._device_scan_packed(jnp.asarray(np.stack(packs)),
-                                         jnp.asarray(np.stack(times)))
+                self._device_scan_packed(*cols)
         else:
             cols = []
             for name in self.SCAN_COLUMNS:
@@ -505,6 +528,8 @@ class AdAnalyticsEngine:
                 cols.append(jnp.asarray(np.stack(arrs)))
             with self.tracer.span("device_scan"):
                 self._device_scan(*cols)
+        for b in batches:
+            self._note_watermark(b)
         self.events_processed += sum(b.n for b in batches)
         self.last_event_ms = now_ms()
 
@@ -612,8 +637,25 @@ class AdAnalyticsEngine:
             # device completion (that overlaps the next encode — the
             # pipeline-parallel analog, SURVEY.md §2)
             self._device_step(batch)
+        self._note_watermark(batch)
         self.events_processed += batch.n
         self.last_event_ms = now_ms()
+
+    def _note_watermark(self, batch) -> None:
+        """Advance the host watermark mirror — strictly AFTER the fold
+        that carries these events is dispatched, and over VALID rows
+        only, so ``_host_wm`` equals the device watermark at every
+        drain point (device programs execute in dispatch order).
+        Updating before dispatch let the host run ahead of the device
+        and a drain's span recompute treat still-open ring slots as
+        closed."""
+        v = batch.valid[:batch.n]
+        if not v.any():
+            return
+        vt = batch.event_time[:batch.n]
+        mx = int(vt.max() if v.all() else vt[v].max()) + batch.base_time_ms
+        if self._host_wm is None or mx > self._host_wm:
+            self._host_wm = mx
 
     @staticmethod
     def _halves(batch):
@@ -741,10 +783,19 @@ class AdAnalyticsEngine:
                         lateness_ms=self.lateness)
                     self._park(("rows_host", rows, sub_np, wids))
                 else:
-                    sub, wids, self.state = wc.flush_deltas_rows(
-                        self.state, jnp.asarray(padded),
-                        divisor_ms=self.divisor, lateness_ms=self.lateness)
-                    self._park(("rows", rows, rows.size, sub, wids))
+                    # Accelerators: compact the gathered rows ON DEVICE
+                    # — the padded-row pull is CAP-sized (33 MB at
+                    # [131072, 64]) and the full-space compaction scans
+                    # C x W cells; this scans R x W and pulls ~1 MB.
+                    idx, vals, nnz, sub, wids, self.state = \
+                        wc.flush_deltas_rows_compact(
+                            self.state, jnp.asarray(padded),
+                            jnp.int32(rows.size),
+                            cap=self.COMPACT_DRAIN_CAP,
+                            divisor_ms=self.divisor,
+                            lateness_ms=self.lateness)
+                    self._park(("rows_compact", rows, idx, vals, nnz,
+                                sub, wids))
                 self._span_start = None
                 return
             # touched set overflowed the cap: fall through to the full-
@@ -768,11 +819,13 @@ class AdAnalyticsEngine:
         the data already local instead of paying a blocking tunnel pull
         (~150 ms fixed, seconds behind a backed-up transfer queue)."""
         if self._defer_pull:
-            # The compact tuple's dense element is the ORIGINAL [C, W]
-            # counts handle, read only in the rare nnz-overflow case —
-            # async-copying it would occupy the tunnel with >= 16 MB per
-            # drain that is almost always discarded.
-            skip = {4} if parked[0] == "compact" else set()
+            # The compact/rows_compact tuples carry a dense fallback
+            # handle ([C, W] counts / the gathered [R, W] block), read
+            # only in the rare nnz-overflow case — async-copying it
+            # would occupy the tunnel with 16-33 MB per drain that is
+            # almost always discarded.
+            skip = {"compact": {4}, "rows_compact": {5}}.get(
+                parked[0], set())
             for i, x in enumerate(parked):
                 if i in skip:
                     continue
@@ -829,22 +882,28 @@ class AdAnalyticsEngine:
                 ci = rows_np[ci_l]
             elif parked[0] == "compact":
                 _, idx_d, vals_d, nnz_d, dense_d, wids_d = parked
-                nnz = int(nnz_d)
                 wids = np.asarray(wids_d)
-                if nnz <= self.COMPACT_DRAIN_CAP:
-                    idx = np.asarray(idx_d)[:nnz].astype(np.int64)
-                    vals = np.asarray(vals_d)[:nnz]
-                    ci, si = np.divmod(idx, W)
-                else:  # overflow: read the dense block after all
-                    deltas = np.asarray(dense_d)
-                    ci, si = np.nonzero(deltas)
-                    vals = deltas[ci, si]
-            else:
+                ci, si, vals = self._decode_compact(
+                    idx_d, vals_d, nnz_d, lambda: np.asarray(dense_d))
+            elif parked[0] == "rows_compact":
+                _, rows_np, idx_d, vals_d, nnz_d, sub_d, wids_d = parked
+                wids = np.asarray(wids_d)
+                ci_l, si, vals = self._decode_compact(
+                    idx_d, vals_d, nnz_d,
+                    lambda: np.asarray(sub_d)[:rows_np.size])
+                ci = rows_np[ci_l]
+            elif parked[0] == "dense":
                 _, deltas_d, wids_d = parked
                 deltas = np.asarray(deltas_d)
                 wids = np.asarray(wids_d)
                 ci, si = np.nonzero(deltas)
                 vals = deltas[ci, si]
+            else:
+                # engine-specific parked drain (e.g. the HLL estimate
+                # block): the subclass absorbs it into its own pending
+                # form, still in dispatch order
+                self._materialize_custom(parked)
+                continue
             if ci.size == 0:
                 continue
             wid = wids[si]
@@ -856,6 +915,42 @@ class AdAnalyticsEngine:
                     (ci.astype(np.int64),
                      base + wid.astype(np.int64) * self.divisor,
                      vals.astype(np.int64)))
+
+    def _materialize_custom(self, parked: tuple) -> None:
+        """Hook for subclasses that park drains under their own tag
+        (see ``_materialize_drains``); the base engine parks none."""
+        raise ValueError(f"unknown parked drain tag {parked[0]!r}")
+
+    def _decode_compact(self, idx_d, vals_d, nnz_d, fallback):
+        """Decode one cap-compacted drain: ``(row_idx, slot, vals)``
+        from the (idx, vals) pairs, or — when ``nnz`` overflowed the
+        cap and the pairs are incomplete — from the dense 2-D block
+        ``fallback()`` materializes.  The ONE copy of the overflow
+        protocol for both the full-space and touched-rows drains."""
+        nnz = int(nnz_d)
+        if nnz <= self.COMPACT_DRAIN_CAP:
+            idx = np.asarray(idx_d)[:nnz].astype(np.int64)
+            vals = np.asarray(vals_d)[:nnz]
+            ci, si = np.divmod(idx, self.W)
+            return ci, si, vals
+        dense = fallback()
+        ci, si = np.nonzero(dense)
+        return ci, si, dense[ci, si]
+
+    def _oldest_open_span_start(self) -> int | None:
+        """Absolute event time of the oldest window that could still be
+        open, from the HOST-tracked watermark (no device pull): a window
+        starting at ``ws`` is closed once ``ws + divisor + lateness <=
+        watermark``.  Conservative by construction — it may point at a
+        window that already closed (slightly earlier drains), never past
+        one that is still open."""
+        if self._host_wm is None:
+            return None
+        base = self.encoder.base_time_ms or 0
+        min_open_wid = (self._host_wm - base - self.lateness) // self.divisor
+        if min_open_wid < 0:
+            min_open_wid = 0
+        return base + min_open_wid * self.divisor
 
     def _fold_pending_arrays(self) -> None:
         """Merge ``_pending_np`` array triples into the ``_pending`` dict
@@ -1107,6 +1202,8 @@ class AdAnalyticsEngine:
                 self._dirty_rows.append(live)
         self.encoder.set_base_time(snap.meta["base_time_ms"])
         self._span_start = snap.meta["span_start"]
+        self._host_wm = (int(snap.meta["base_time_ms"])
+                         + int(snap.watermark)) if int(snap.watermark) else None
         self.events_processed = int(snap.meta["events_processed"])
         self.windows_written = int(snap.meta["windows_written"])
         self.started_ms = int(snap.meta["started_ms"])

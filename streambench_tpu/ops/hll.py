@@ -168,3 +168,28 @@ def scan_steps(state: HLLState, join_table: jax.Array,
     final, _ = jax.lax.scan(
         body, state, (ad_idx, user_idx, event_type, event_time, valid))
     return final
+
+
+@functools.partial(
+    jax.jit, static_argnames=("divisor_ms", "lateness_ms", "view_type"))
+def scan_steps_packed(state: HLLState, join_table: jax.Array,
+                      packed: jax.Array, user_idx: jax.Array,
+                      event_time: jax.Array,
+                      *, divisor_ms: int = 10_000,
+                      lateness_ms: int = 60_000,
+                      view_type: int = 0) -> HLLState:
+    """``scan_steps`` over the packed wire word
+    (``windowcount.pack_columns``) + user ids: 12 B/event on the wire
+    instead of 17 B across five buffers."""
+    from streambench_tpu.ops.windowcount import unpack_columns
+
+    def body(carry, xs):
+        p, u, t = xs
+        a, e, v = unpack_columns(p)
+        return step(carry, join_table, a, u, e, t, v,
+                    divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                    view_type=view_type), None
+
+    final, _ = jax.lax.scan(
+        body, state, (packed, user_idx, event_time))
+    return final

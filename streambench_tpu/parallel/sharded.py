@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 from streambench_tpu.config import BenchmarkConfig
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine
 from streambench_tpu.io.redis_schema import RedisLike
+from streambench_tpu.ops import windowcount as wc
 from streambench_tpu.ops.windowcount import NEG, WindowState
 from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
 
@@ -88,34 +89,58 @@ def _fold_one(counts, window_ids, watermark, dropped, join_table,
     the same full batch, so the slot claim and watermark are computed
     identically everywhere — replicated by construction, no pmax.
     """
+    ad_idx, event_type, event_time, valid = (
+        _gather_replicated(x, n_data)
+        for x in (ad_idx, event_type, event_time, valid))
+    valid = valid > 0
+    return _fold_core(counts, window_ids, watermark, dropped, join_table,
+                      ad_idx, event_type, event_time, valid,
+                      divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                      view_type=view_type)
+
+
+def _gather_replicated(x, n_data: int):
+    """All-gather a data-axis-sharded column with a PROVABLY replicated
+    int32 result: scatter the local shard into a zero [B_total] buffer
+    and psum — the checker knows psum output is unvarying over the
+    axis, where all_gather's output it must assume varying.  One
+    [B_total] collective either way; B is KBs, the counts are the MBs
+    that stay put.  (A size-1 axis still marks its inputs varying, so
+    the n_data == 1 case is an identity psum that proves replication.)
+    The ONE copy of this trick — both the unpacked and the packed fold
+    must gather identically."""
+    if n_data == 1:
+        return jax.lax.psum(x.astype(jnp.int32), DATA_AXIS)
+    b = x.shape[0]
+    buf = jnp.zeros((n_data * b,), jnp.int32)
+    i = jax.lax.axis_index(DATA_AXIS)
+    buf = jax.lax.dynamic_update_slice(buf, x.astype(jnp.int32), (i * b,))
+    return jax.lax.psum(buf, DATA_AXIS)
+
+
+def _fold_one_packed(counts, window_ids, watermark, dropped, join_table,
+                     packed, event_time,
+                     *, divisor_ms: int, lateness_ms: int, view_type: int,
+                     n_data: int):
+    """``_fold_one`` consuming the packed wire word
+    (``ops.windowcount.pack_columns``): two data-axis collectives per
+    batch instead of four — the packing that halves host->device bytes
+    also halves the ICI all-gather traffic.  Unpacks AFTER the gather,
+    so every device decodes the identical replicated words."""
+    packed = _gather_replicated(packed, n_data)
+    event_time = _gather_replicated(event_time, n_data)
+    ad_idx, event_type, valid = wc.unpack_columns(packed)
+    return _fold_core(counts, window_ids, watermark, dropped, join_table,
+                      ad_idx, event_type, event_time, valid,
+                      divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                      view_type=view_type)
+
+
+def _fold_core(counts, window_ids, watermark, dropped, join_table,
+               ad_idx, event_type, event_time, valid,
+               *, divisor_ms: int, lateness_ms: int, view_type: int):
+    """The shard-local fold over an already-replicated batch."""
     Cl, W = counts.shape
-
-    if n_data > 1:  # replicate the small batch instead of the big state
-        def gather_rep(x):
-            """All-gather along the data axis with a PROVABLY replicated
-            result: scatter the local shard into a zero [B_total] buffer
-            and psum — the checker knows psum output is unvarying over
-            the axis, where all_gather's output it must assume varying.
-            One [B_total] collective either way; B is KBs, counts are
-            the MBs that stay put."""
-            b = x.shape[0]
-            buf = jnp.zeros((n_data * b,), jnp.int32)
-            i = jax.lax.axis_index(DATA_AXIS)
-            buf = jax.lax.dynamic_update_slice(
-                buf, x.astype(jnp.int32), (i * b,))
-            return jax.lax.psum(buf, DATA_AXIS)
-
-        ad_idx = gather_rep(ad_idx)
-        event_type = gather_rep(event_type)
-        event_time = gather_rep(event_time)
-        valid = gather_rep(valid) > 0
-    else:
-        # a size-1 axis still marks its inputs varying; psum over it is
-        # an identity that proves replication
-        ad_idx = jax.lax.psum(ad_idx, DATA_AXIS)
-        event_type = jax.lax.psum(event_type, DATA_AXIS)
-        event_time = jax.lax.psum(event_time, DATA_AXIS)
-        valid = jax.lax.psum(valid.astype(jnp.int32), DATA_AXIS) > 0
 
     campaign = join_table[ad_idx]                 # [B] gather-join
     wid = event_time // divisor_ms
@@ -216,6 +241,58 @@ def _build_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
     return jax.jit(mapped, donate_argnums=(0,))
 
 
+@functools.lru_cache(maxsize=None)
+def _build_step_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                       view_type: int):
+    """``_build_step`` consuming (packed, event_time) wire columns."""
+    n_data = mesh.shape[DATA_AXIS]
+
+    def body(counts, window_ids, watermark, dropped, join_table,
+             packed, event_time):
+        return _fold_one_packed(
+            counts, window_ids, watermark, dropped, join_table,
+            packed, event_time, divisor_ms=divisor_ms,
+            lateness_ms=lateness_ms, view_type=view_type, n_data=n_data)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
+                  P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_scan_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                       view_type: int):
+    """``_build_scan`` consuming [K, B] (packed, event_time) columns."""
+    n_data = mesh.shape[DATA_AXIS]
+
+    def body(counts, window_ids, watermark, dropped, join_table,
+             packed, event_time):
+        def one(carry, xs):
+            c, ids, wm, dr = carry
+            p, t = xs
+            return _fold_one_packed(
+                c, ids, wm, dr, join_table, p, t,
+                divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                view_type=view_type, n_data=n_data), None
+
+        carry, _ = jax.lax.scan(
+            one, (counts, window_ids, watermark, dropped),
+            (packed, event_time))
+        return carry
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
+                  P(None, DATA_AXIS), P(None, DATA_AXIS)),
+        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
 def sharded_step(mesh: Mesh, state: WindowState, join_table: jax.Array,
                  ad_idx, event_type, event_time, valid,
                  *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
@@ -275,6 +352,17 @@ class ShardedWindowEngine(AdAnalyticsEngine):
         )
 
     def _device_step(self, batch) -> None:
+        if self._pack_ok:
+            fn = _build_step_packed(self.mesh, self.divisor, self.lateness,
+                                    0)
+            packed = wc.pack_columns(batch.ad_idx, batch.event_type,
+                                     batch.valid)
+            counts, ids, wm, dropped = fn(
+                self.state.counts, self.state.window_ids,
+                self.state.watermark, self.state.dropped, self.join_table,
+                jnp.asarray(packed), jnp.asarray(batch.event_time))
+            self.state = WindowState(counts, ids, wm, dropped)
+            return
         self.state = sharded_step(
             self.mesh, self.state, self.join_table,
             batch.ad_idx, batch.event_type, batch.event_time, batch.valid,
@@ -286,4 +374,11 @@ class ShardedWindowEngine(AdAnalyticsEngine):
             self.state.counts, self.state.window_ids, self.state.watermark,
             self.state.dropped, self.join_table,
             ad_idx, event_type, event_time, valid)
+        self.state = WindowState(counts, ids, wm, dropped)
+
+    def _device_scan_packed(self, packed, event_time) -> None:
+        fn = _build_scan_packed(self.mesh, self.divisor, self.lateness, 0)
+        counts, ids, wm, dropped = fn(
+            self.state.counts, self.state.window_ids, self.state.watermark,
+            self.state.dropped, self.join_table, packed, event_time)
         self.state = WindowState(counts, ids, wm, dropped)

@@ -361,11 +361,12 @@ def test_dirty_rows_drain_matches_dense(monkeypatch):
 
 
 def test_dirty_rows_device_branch_matches_dense(monkeypatch):
-    """The accelerator-side rows drain (``flush_deltas_rows`` device
-    gather + the "rows" materialize arm) — config #5's TPU path — must
-    match the dense drain.  CPU CI otherwise only ever runs the
-    "rows_host" branch, so the backend probe is patched to force the
-    device branch (the ops themselves are backend-generic)."""
+    """The accelerator-side rows drain (``flush_deltas_rows_compact``
+    on-device gather+compaction + the "rows_compact" materialize arm) —
+    config #5's TPU path — must match the dense drain.  CPU CI
+    otherwise only ever runs the "rows_host" branch, so the backend
+    probe is patched to force the device branch (the ops themselves are
+    backend-generic)."""
     import streambench_tpu.engine.pipeline as pipeline_mod
 
     lines, mapping, campaigns = make_lines(4000, seed=37)
@@ -382,7 +383,7 @@ def test_dirty_rows_device_branch_matches_dense(monkeypatch):
     eng = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns)
     eng.process_chunk(lines)
     eng._drain_device()
-    assert eng._undrained and eng._undrained[-1][0] == "rows"
+    assert eng._undrained and eng._undrained[-1][0] == "rows_compact"
     monkeypatch.undo()  # materialize/compare on the real backend
     eng._materialize_drains()
     eng._fold_pending_arrays()

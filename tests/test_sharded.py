@@ -112,3 +112,57 @@ def test_sharded_engine_end_to_end_oracle(tmp_path):
                                                  log=logs.append)
     assert differ == 0 and missing == 0, logs[:5]
     assert correct >= 20
+
+
+@pytest.mark.parametrize("dshape", [(4, 2), (1, 8)])
+def test_sharded_packed_step_and_scan_bit_identical(dshape):
+    """The packed-word sharded step/scan (2 data-axis collectives per
+    batch instead of 4) must match the unpacked sharded kernels exactly
+    on the virtual mesh."""
+    from streambench_tpu.parallel.sharded import (
+        _build_scan,
+        _build_scan_packed,
+        _build_step_packed,
+    )
+
+    d, c = dshape
+    mesh = build_mesh(data=d, campaign=c, devices=jax.devices()[:d * c])
+    rng = np.random.default_rng(17)
+    C, W, A, B, K = 16, 8, 64, 8 * d, 3
+    jt = np.concatenate([rng.integers(0, C, A).astype(np.int32), [-1]])
+    batches = rand_batches(rng, K, B, A + 1)
+
+    plain = sharded_init_state(C, W, mesh)
+    for ad, et, tm, va in batches:
+        plain = sharded_step(mesh, plain, jt, ad, et, tm, va)
+
+    packed_fn = _build_step_packed(mesh, 10_000, 60_000, 0)
+    ps = sharded_init_state(C, W, mesh)
+    for ad, et, tm, va in batches:
+        word = wc.pack_columns(ad, et, va)
+        counts, ids, wm, dr = packed_fn(
+            ps.counts, ps.window_ids, ps.watermark, ps.dropped,
+            jt, word, tm)
+        ps = wc.WindowState(counts, ids, wm, dr)
+    assert np.array_equal(np.asarray(plain.counts), np.asarray(ps.counts))
+    assert np.array_equal(np.asarray(plain.window_ids),
+                          np.asarray(ps.window_ids))
+    assert int(plain.dropped) == int(ps.dropped)
+
+    # scans: unpacked vs packed over the same [K, B] stacks
+    stack = lambda i: np.stack([b[i] for b in batches])
+    s0 = sharded_init_state(C, W, mesh)
+    scan_fn = _build_scan(mesh, 10_000, 60_000, 0)
+    counts, ids, wm, dr = scan_fn(
+        s0.counts, s0.window_ids, s0.watermark, s0.dropped, jt,
+        stack(0), stack(1), stack(2), stack(3))
+    s1 = sharded_init_state(C, W, mesh)
+    pscan = _build_scan_packed(mesh, 10_000, 60_000, 0)
+    words = np.stack([wc.pack_columns(ad, et, va)
+                      for ad, et, tm, va in batches])
+    pcounts, pids, pwm, pdr = pscan(
+        s1.counts, s1.window_ids, s1.watermark, s1.dropped, jt,
+        words, stack(2))
+    assert np.array_equal(np.asarray(counts), np.asarray(pcounts))
+    assert np.array_equal(np.asarray(ids), np.asarray(pids))
+    assert int(dr) == int(pdr)

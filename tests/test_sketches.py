@@ -174,3 +174,73 @@ def test_tdigest_tail_quantile_with_empty_centroids():
     st = tdigest.update(st, np.zeros(4, np.int32), vals, np.ones(4, bool))
     q = np.asarray(tdigest.quantile(st, jnp.array([0.5, 0.99, 1.0])))
     assert np.allclose(q[0], 100.0), q
+
+
+def test_hll_scan_packed_bit_identical():
+    """hll.scan_steps_packed over the packed wire word must match
+    hll.scan_steps exactly (registers, ids, watermark, dropped)."""
+    import jax.numpy as jnp
+
+    from streambench_tpu.ops import hll
+    from streambench_tpu.ops import windowcount as wc
+
+    rng = np.random.default_rng(23)
+    C, W, A, B, K = 10, 8, 40, 256, 4
+    jt = np.concatenate([rng.integers(0, C, A).astype(np.int32), [-1]])
+    ad = rng.integers(0, A + 1, (K, B)).astype(np.int32)
+    user = rng.integers(0, 1 << 30, (K, B)).astype(np.int32)
+    et = rng.integers(-1, 3, (K, B)).astype(np.int32)
+    tm = np.sort(rng.integers(70_000, 200_000, (K, B))).astype(np.int32)
+    va = rng.random((K, B)) < 0.9
+
+    s0 = hll.init_state(C, W, num_registers=32)
+    plain = hll.scan_steps(s0, jnp.asarray(jt), ad, user, et, tm, va)
+    s1 = hll.init_state(C, W, num_registers=32)
+    packed = np.stack([wc.pack_columns(ad[k], et[k], va[k])
+                       for k in range(K)])
+    got = hll.scan_steps_packed(s1, jnp.asarray(jt), packed, user, tm)
+    assert np.array_equal(np.asarray(plain.registers),
+                          np.asarray(got.registers))
+    assert np.array_equal(np.asarray(plain.window_ids),
+                          np.asarray(got.window_ids))
+    assert int(plain.dropped) == int(got.dropped)
+
+
+def test_sliding_scan_packed_bit_identical():
+    import jax.numpy as jnp
+
+    from streambench_tpu.engine.sketches import (
+        _sliding_tdigest_scan,
+        _sliding_tdigest_scan_packed,
+    )
+    from streambench_tpu.ops import sliding
+    from streambench_tpu.ops import tdigest
+    from streambench_tpu.ops import windowcount as wc
+
+    rng = np.random.default_rng(29)
+    C, W, A, B, K = 6, 64, 30, 128, 3
+    jt = np.concatenate([rng.integers(0, C, A).astype(np.int32), [-1]])
+    ad = rng.integers(0, A + 1, (K, B)).astype(np.int32)
+    et = rng.integers(-1, 3, (K, B)).astype(np.int32)
+    tm = np.sort(rng.integers(70_000, 120_000, (K, B))).astype(np.int32)
+    va = rng.random((K, B)) < 0.9
+    now = jnp.int32(130_000)
+
+    st0 = wc.init_state(C, W)
+    d0 = tdigest.init_state(C, compression=32)
+    s_plain, d_plain = _sliding_tdigest_scan(
+        st0, d0, jnp.asarray(jt), now, ad, et, tm, va,
+        size_ms=10_000, slide_ms=1_000, lateness_ms=60_000)
+    packed = np.stack([wc.pack_columns(ad[k], et[k], va[k])
+                       for k in range(K)])
+    st1 = wc.init_state(C, W)
+    d1 = tdigest.init_state(C, compression=32)
+    s_got, d_got = _sliding_tdigest_scan_packed(
+        st1, d1, jnp.asarray(jt), now, packed, tm,
+        size_ms=10_000, slide_ms=1_000, lateness_ms=60_000)
+    assert np.array_equal(np.asarray(s_plain.counts),
+                          np.asarray(s_got.counts))
+    assert np.array_equal(np.asarray(d_plain.means),
+                          np.asarray(d_got.means))
+    assert np.array_equal(np.asarray(d_plain.weights),
+                          np.asarray(d_got.weights))

@@ -282,3 +282,35 @@ def test_scan_steps_packed_equals_scan_steps():
     assert np.array_equal(np.asarray(plain.window_ids),
                           np.asarray(packed.window_ids))
     assert int(plain.dropped) == int(packed.dropped)
+
+
+def test_flush_deltas_rows_compact_matches_rows():
+    """The on-device rows compaction must report exactly the touched
+    cells — including when campaign row 0 has counts AND the rows
+    vector is zero-PADDED (the padding re-gathers row 0; unmasked, its
+    cells would be duplicated once per pad row)."""
+    lines, mapping, campaigns = make_dataset(1200, seed=41)
+    enc = EventEncoder(mapping, campaigns)
+    state = run_engine(lines, enc, W=16, B=256)
+    counts = np.asarray(state.counts)
+    touched = np.nonzero(counts.any(axis=1))[0]
+    assert counts[0].any(), "fixture must exercise a nonzero row 0"
+    R, cap = 16, 64  # rows padded wide: pad entries re-gather row 0
+    assert touched.size <= R
+    padded = np.zeros(R, np.int32)
+    padded[:touched.size] = touched
+    idx, vals, nnz, sub, wids, new_state = wc.flush_deltas_rows_compact(
+        state, jnp.asarray(padded), jnp.int32(touched.size), cap=cap)
+    n = int(nnz)
+    assert n == int((counts > 0).sum())
+    idx = np.asarray(idx)[:n]
+    vals = np.asarray(vals)[:n]
+    ci = touched[idx // 16]
+    si = idx % 16
+    got = {(int(c), int(s)): int(v) for c, s, v in zip(ci, si, vals)}
+    want = {(int(c), int(s)): int(counts[c, s])
+            for c, s in zip(*np.nonzero(counts))}
+    assert got == want
+    assert not np.asarray(new_state.counts).any()
+    # the gathered fallback block carries the real rows in order
+    assert np.array_equal(np.asarray(sub)[:touched.size], counts[touched])
