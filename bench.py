@@ -603,7 +603,10 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
     results = []
     best = None
     rate = start_rate
-    for run_id in range(max_runs):
+    run_id = 0
+    runs_allowed = max_runs
+    stall_retry_used = False
+    while run_id < runs_allowed:
         if deadline is not None and (
                 time.monotonic() + duration_s + 45 > deadline):
             log("latency sweep stopped: bench time budget would be "
@@ -612,6 +615,7 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
         res = _paced_latency_phase(cfg, mapping, broker,
                                    as_redis(make_store()), workdir,
                                    rate, duration_s, run_id=run_id)
+        run_id += 1
         results.append(res)
         _judge_rung(res, sla_ms, duration_s)
         sustained = res["sustained"]
@@ -630,6 +634,23 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
             if rate_ceiling and rate > rate_ceiling:
                 break  # can't sustain beyond catchup throughput anyway
         else:
+            p90 = res.get("p90_ms")
+            if (not stall_retry_used and not res["invalid_producer"]
+                    and res.get("processed") == res.get("sent")
+                    and p90 is not None and p90 <= sla_ms):
+                # Stall signature: the BULK of windows landed within the
+                # SLA and only the extreme tail blew (a multi-second
+                # host/tunnel stall inside a 2-minute rung, not the
+                # engine's limit — the recorded r5 case: p50 11.6 s,
+                # p90 17.6 s... one anomalous rung halved the whole
+                # ladder).  Re-run the same rate ONCE; both attempts
+                # stay in the artifact.
+                stall_retry_used = True
+                res["stall_retried"] = True
+                runs_allowed = max_runs + 1
+                log(f"rate {rate}/s: retrying once — stall signature "
+                    f"(p90 {p90} ms within SLA, only the tail blew)")
+                continue
             rate = max(int(rate * 0.5), 1_000)
             if best is not None and rate <= best:
                 break

@@ -250,9 +250,14 @@ class AdAnalyticsEngine:
         # queued before it — the round-2 bench lost 85% of its wall time
         # exactly there.  Materialization happens at flush()/snapshot()
         # time, when the 1 Hz cadence has let the queue drain naturally.
-        # tagged parked drains: ("dense", deltas, wids),
-        # ("compact", idx, vals, nnz, dense_handle, wids) or
-        # ("rows", rows_np, n, row_block, wids)
+        # tagged parked drains:
+        #   ("dense", deltas, wids)
+        #   ("compact", idx, vals, nnz, dense_handle, wids)
+        #   ("rows_compact", rows_np, idx, vals, nnz, sub_handle, wids)
+        #   ("rows_host", rows_np, sub_np, wids)      [CPU zero-copy]
+        # plus engine-specific tags absorbed by _materialize_custom
+        # (e.g. ("hll", est, wids)).  When adding a tag with a dense
+        # fallback handle, extend _park's async-copy skip table.
         self._undrained: list[tuple] = []
         # Drains parked one flush cycle ago whose device->host copies were
         # started asynchronously (tunneled accelerators: a blocking pull
@@ -272,9 +277,15 @@ class AdAnalyticsEngine:
         # Packed wire word (ops.windowcount.pack_columns): when the ad
         # space fits the 28-bit field AND either this class's device
         # hooks are the exact-count kernels (pure base) or the subclass
-        # ships its own packed scan (e.g. the sharded engine).  Sketch
-        # engines override _device_scan with extra columns and inherit
-        # the base _device_scan_packed -> excluded automatically.
+        # ships its own packed scan (e.g. the sharded engine).
+        # Deliberately method-identity introspection, NOT an inherited
+        # opt-in flag: a flag would silently stay True in a subclass
+        # that overrides _device_scan with different columns (the
+        # inheritance trap), while introspection fails CLOSED — a
+        # subclass that overrides a device hook without shipping
+        # _device_scan_packed falls back to unpacked transfers
+        # (correct, just slower on tunneled backends; override
+        # _device_scan_packed to reclaim the packed win).
         self._pack_ok = self.encoder.join_table.size < wc.PACK_AD_MAX
         self._packed_scan = self._pack_ok and (
             type(self)._device_scan_packed
@@ -870,12 +881,8 @@ class AdAnalyticsEngine:
         base = self.encoder.base_time_ms or 0
         W = self.W
         for parked in parked_list:
-            if parked[0] in ("rows", "rows_host"):
-                if parked[0] == "rows":
-                    _, rows_np, nrow, sub_d, wids_d = parked
-                    sub = np.asarray(sub_d)[:nrow]
-                else:
-                    _, rows_np, sub, wids_d = parked
+            if parked[0] == "rows_host":
+                _, rows_np, sub, wids_d = parked
                 wids = np.asarray(wids_d)
                 ci_l, si = np.nonzero(sub)
                 vals = sub[ci_l, si]

@@ -252,15 +252,15 @@ def flush_deltas_rows_compact(state: WindowState, rows: jax.Array,
                               lateness_ms: int = 60_000):
     """Touched-rows drain with ON-DEVICE nonzero compaction.
 
-    The two existing large-key-space drains each have a cost that does
-    not scale with the live data on a tunneled accelerator:
-    ``flush_deltas_rows`` transfers the CAP-padded ``[R, W]`` row block
-    (33 MB at the 131072-row cap, W=64 — measured ~70% of config5's TPU
-    catchup wall), and ``flush_deltas_compact`` scans all ``C x W``
-    cells on device (64M at C=1e6).  This op gathers just the touched
-    rows (device-internal, no transfer), compacts THEIR ``R x W`` cells
-    (8.4M at the cap — 8x less device work), and hands the host only
-    ``(flat_idx, count)`` pairs.  ``flat_idx`` indexes the GATHERED
+    The alternatives each have a cost that does not scale with the live
+    data on a tunneled accelerator: transferring the CAP-padded
+    ``[R, W]`` row block costs 33 MB at the 131072-row cap with W=64
+    (measured ~70% of config5's TPU catchup wall — the retired
+    ``flush_deltas_rows``), and ``flush_deltas_compact`` scans all
+    ``C x W`` cells on device (64M at C=1e6).  This op gathers just the
+    touched rows (device-internal, no transfer), compacts THEIR
+    ``R x W`` cells (8.4M at the cap — 8x less device work), and hands
+    the host only ``(flat_idx, count)`` pairs.  ``flat_idx`` indexes the GATHERED
     block: ``campaign = rows[flat_idx // W]``, ``slot = flat_idx % W``.
     Entries past ``nnz`` are padding; ``nnz > cap`` means incomplete
     compaction and the caller must read ``sub`` (the gathered block
@@ -279,30 +279,6 @@ def flush_deltas_rows_compact(state: WindowState, rows: jax.Array,
     vals = flat[idx]
     _, wids, new_state = _zero_rows(state, rows, divisor_ms, lateness_ms)
     return idx.astype(jnp.int32), vals, nnz, sub, wids, new_state
-
-
-@functools.partial(jax.jit, static_argnames=("divisor_ms", "lateness_ms"),
-                   donate_argnums=(0,))
-def flush_deltas_rows(state: WindowState, rows: jax.Array, *,
-                      divisor_ms: int = 10_000, lateness_ms: int = 60_000):
-    """``flush_deltas`` returning only the given campaign rows.
-
-    At large key spaces (config #5: C=1e6) a drain's cost must scale
-    with what was *touched* since the last drain, not with the [C, W]
-    key space — the reference's own 1e6-key analog reports at window
-    close instead of walking the key universe
-    (``ProcessTimeAwareStore.java:115-176``).  The host knows every
-    batch's campaign set at encode time, so it passes the touched rows
-    in; the device gathers just those rows ``[R, W]``.  ``rows`` is
-    padded to a static shape with arbitrary valid indices; the caller
-    slices to its true count.  Only the touched rows are zeroed (in
-    place when the caller donates ``state.counts``) — every other row
-    is already zero, so the full-space memset ``flush_deltas`` pays is
-    skipped too.  Returns ``(row_block [R, W], window_ids, new_state)``.
-    """
-    sub = state.counts[rows]
-    _, wids, new_state = _zero_rows(state, rows, divisor_ms, lateness_ms)
-    return sub, wids, new_state
 
 
 def _zero_rows(state: WindowState, rows: jax.Array,
@@ -336,7 +312,7 @@ def flush_free_slots(state: WindowState, *, divisor_ms: int = 10_000,
                    donate_argnums=(0,))
 def flush_rows_zero(state: WindowState, rows: jax.Array, *,
                     divisor_ms: int = 10_000, lateness_ms: int = 60_000):
-    """The zero-and-free half of ``flush_deltas_rows``, for callers that
+    """The zero-and-free half of a touched-rows drain, for callers that
     already copied the touched rows out host-side.  On CPU backends the
     count block is host memory: ``np.asarray`` is a zero-copy view and a
     numpy fancy-index reads the touched rows ~13x faster than XLA's row

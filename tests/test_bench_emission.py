@@ -123,3 +123,39 @@ def test_bench_sigkill_leaves_parseable_artifact(bench_copy, tmp_path):
     last = json.loads(got[-1])
     assert last["value"] > 0
     assert last["configs"][0]["config"] == "exact_count"
+
+
+def test_ladder_retries_stall_signature_once(monkeypatch):
+    """A failed rung whose p90 is within the SLA (only the extreme tail
+    blew — the multi-second host/tunnel stall signature) is re-run once
+    at the SAME rate instead of halving the ladder; both attempts stay
+    in the artifact."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    calls = []
+
+    def fake_phase(cfg, mapping, broker, redis, wd, rate, dur,
+                   run_id=0, **kw):
+        calls.append(rate)
+        row = {"rate": rate, "sent": int(rate * dur),
+               "processed": int(rate * dur), "windows": 14,
+               "generator_behind_max_ms": 0, "generator_behind_events": 0,
+               "p50_ms": 11_500, "p90_ms": 11_600, "p99_ms": 11_700}
+        if len(calls) == 1:  # first attempt: stall-shaped tail blowout
+            row["p99_ms"] = 27_000
+        return row
+
+    monkeypatch.setattr(bench, "_paced_latency_phase", fake_phase)
+    sweep = bench._latency_sweep(None, None, None, None, 100_000, 125.0,
+                                 15_000, max_runs=4,
+                                 rate_ceiling=120_000)
+    assert calls[0] == 100_000 and calls[1] == 100_000, calls
+    assert sweep["rates"][0].get("stall_retried") is True
+    assert sweep["max_sustained_rate"] == 100_000
+    # a second tail blowout would NOT be retried (one per ladder)
+    assert sum(1 for r in sweep["rates"] if r.get("stall_retried")) == 1
