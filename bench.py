@@ -582,6 +582,18 @@ def _judge_rung(res: dict, sla_ms: int, duration_s: float,
                         and res["processed"] == sent)
 
 
+def _stall_signature(res: dict, sla_ms: int) -> bool:
+    """True when a failed paced run looks like a transient host/tunnel
+    stall rather than the engine's limit: every event was consumed and
+    the MEDIAN window still landed within the SLA — only the tail blew.
+    A genuinely overloaded engine backs up continuously, dragging p50
+    past the SLA too."""
+    p50 = res.get("p50_ms")
+    return (res.get("processed") == res.get("sent")
+            and p50 is not None and p50 <= sla_ms
+            and (res.get("p99_ms") or 0) > sla_ms)
+
+
 def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
                    duration_s: float, sla_ms: int,
                    max_runs: int = 4, rate_ceiling: int | None = None,
@@ -634,22 +646,29 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
             if rate_ceiling and rate > rate_ceiling:
                 break  # can't sustain beyond catchup throughput anyway
         else:
-            p90 = res.get("p90_ms")
             if (not stall_retry_used and not res["invalid_producer"]
-                    and res.get("processed") == res.get("sent")
-                    and p90 is not None and p90 <= sla_ms):
-                # Stall signature: the BULK of windows landed within the
-                # SLA and only the extreme tail blew (a multi-second
+                    and _stall_signature(res, sla_ms)
+                    and (deadline is None or time.monotonic()
+                         + duration_s + 45 <= deadline)):
+                # budget re-checked HERE so the flag is only stamped on
+                # a rung whose retry actually runs (the loop-top check
+                # would otherwise break first and record a phantom
+                # retry)
+                # Stall signature: the MAJORITY of windows landed within
+                # the SLA and only the tail blew (a multi-second
                 # host/tunnel stall inside a 2-minute rung, not the
-                # engine's limit — the recorded r5 case: p50 11.6 s,
-                # p90 17.6 s... one anomalous rung halved the whole
-                # ladder).  Re-run the same rate ONCE; both attempts
-                # stay in the artifact.
+                # engine's limit — recorded r5 cases: p50 11.6 s with
+                # p99 27 s, and p50 11.4 s with p90 18.7 s; each one
+                # anomalous rung halved the whole ladder).  A genuinely
+                # overloaded engine backs up continuously and blows p50
+                # too.  Re-run the same rate ONCE; both attempts stay
+                # in the artifact.
                 stall_retry_used = True
                 res["stall_retried"] = True
                 runs_allowed = max_runs + 1
                 log(f"rate {rate}/s: retrying once — stall signature "
-                    f"(p90 {p90} ms within SLA, only the tail blew)")
+                    f"(p50 {res.get('p50_ms')} ms within SLA, only the "
+                    "tail blew)")
                 continue
             rate = max(int(rate * 0.5), 1_000)
             if best is not None and rate <= best:
@@ -782,16 +801,38 @@ def _run_all_configs(cfg, mapping, broker, wd, n_events: int,
             f"{row['catchup_events_per_s']:,.0f} ev/s "
             f"({stats.events} events)")
         try:
-            paced = _paced_latency_phase(
-                cfg_row, mapping_row, broker_row, as_redis(make_store()),
-                wd_row, paced_rate, paced_secs,
-                run_id=9000 + len(rows), engine_factory=factory,
-                expect_windows=expect_windows,
-                flush_interval_ms=flush_interval_ms,
-                latency_from_engine=latency_from_engine,
-                producer_args=producer_args)
-            _judge_rung(paced, sla_ms, paced_secs,
-                        needs_windows=expect_windows)
+            def run_paced(run_id: int) -> dict:
+                paced = _paced_latency_phase(
+                    cfg_row, mapping_row, broker_row,
+                    as_redis(make_store()),
+                    wd_row, paced_rate, paced_secs,
+                    run_id=run_id, engine_factory=factory,
+                    expect_windows=expect_windows,
+                    flush_interval_ms=flush_interval_ms,
+                    latency_from_engine=latency_from_engine,
+                    producer_args=producer_args)
+                _judge_rung(paced, sla_ms, paced_secs,
+                            needs_windows=expect_windows)
+                return paced
+
+            paced = run_paced(9000 + len(rows))
+            if (not paced["sustained"] and not paced["invalid_producer"]
+                    and _stall_signature(paced, sla_ms)
+                    and time.monotonic() + paced_secs + margin_s
+                    < deadline):
+                # same one-shot stall-signature retry as the ladder: a
+                # multi-second host/tunnel stall inside the row's single
+                # paced run is weather, not the engine's limit; the
+                # first attempt stays on the record
+                log(f"config [{key}] paced: retrying once — stall "
+                    f"signature (p50 {paced.get('p50_ms')} ms within "
+                    "SLA, only the tail blew)")
+                first = paced
+                # the measured first attempt must survive a retry that
+                # raises — park it on the row BEFORE re-running
+                row["paced"] = first
+                paced = run_paced(9500 + len(rows))
+                paced["stall_retry_of"] = first
             row["paced"] = paced
         except Exception as e:  # a config row must not kill the artifact
             log(f"config [{key}] paced phase failed (non-fatal): {e!r}")

@@ -147,6 +147,10 @@ def test_ladder_retries_stall_signature_once(monkeypatch):
                "generator_behind_max_ms": 0, "generator_behind_events": 0,
                "p50_ms": 11_500, "p90_ms": 11_600, "p99_ms": 11_700}
         if len(calls) == 1:  # first attempt: stall-shaped tail blowout
+            # p90 PAST the SLA too (the longer-stall shape from the
+            # recorded r5 run: p50 11.4 s, p90 18.7 s, p99 20.8 s) —
+            # the signature is judged on the MEDIAN, not p90
+            row["p90_ms"] = 18_700
             row["p99_ms"] = 27_000
         return row
 

@@ -344,7 +344,8 @@ def test_dirty_rows_drain_matches_dense(monkeypatch):
     # the tracker saw every batch
     assert rows_eng._dirty_rows
     assert drained_pending(rows_eng) == want
-    # drained: tracker reset, parked entry is tagged "rows"
+    # drained: tracker reset ("rows_host" parked on CPU,
+    # "rows_compact" on accelerators)
     assert rows_eng._dirty_rows == []
 
     # an immediate second drain has nothing tracked: no parked entry
