@@ -163,3 +163,50 @@ def test_ladder_retries_stall_signature_once(monkeypatch):
     assert sweep["max_sustained_rate"] == 100_000
     # a second tail blowout would NOT be retried (one per ladder)
     assert sum(1 for r in sweep["rates"] if r.get("stall_retried")) == 1
+
+
+def test_config_row_stall_retry_parks_first_attempt(monkeypatch):
+    """The config-row paced retry must stamp the ladder's stall_retried
+    key on the first attempt, hand it to on_first BEFORE re-running (a
+    raising retry must not destroy the measured attempt), and skip the
+    retry entirely when the median blew the SLA or the budget is gone."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    def make_row(p50, p99):
+        return {"rate": 20_000, "sent": 100, "processed": 100,
+                "sustained": p99 <= 15_000, "invalid_producer": False,
+                "p50_ms": p50, "p90_ms": p50, "p99_ms": p99}
+
+    # stall shape: retried, first attempt parked before attempt 2 runs
+    parked = []
+    attempts = []
+
+    def run_paced(attempt):
+        attempts.append((attempt, list(parked)))
+        return make_row(11_400, 27_000 if attempt == 0 else 11_500)
+
+    out = bench._paced_with_stall_retry(
+        run_paced, 15_000, deadline=time.monotonic() + 10_000,
+        reserve_s=1.0, key="t", on_first=parked.append)
+    assert out["sustained"] and out["stall_retry_of"]["stall_retried"]
+    assert attempts[1][1], "first attempt must be parked before retry"
+
+    # overload shape (median blown): no retry
+    calls = []
+    out = bench._paced_with_stall_retry(
+        lambda a: calls.append(a) or make_row(16_000, 27_000),
+        15_000, deadline=time.monotonic() + 10_000, reserve_s=1.0,
+        key="t")
+    assert calls == [0] and "stall_retry_of" not in out
+
+    # stall shape but budget exhausted: no retry
+    calls = []
+    out = bench._paced_with_stall_retry(
+        lambda a: calls.append(a) or make_row(11_400, 27_000),
+        15_000, deadline=time.monotonic() + 0.5, reserve_s=1.0, key="t")
+    assert calls == [0] and "stall_retried" not in out
