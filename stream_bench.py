@@ -482,16 +482,50 @@ def op_jax_test_suite() -> None:
     as a fully isolated JAX_TEST in its own workdir + subprocess — the
     peer of the reference harness's per-engine composite tests
     (``stream-bench.sh:286-343``)."""
+    summary = []
     for engine in ("exact", "hll", "sliding", "session"):
         wd = os.path.join(WORKDIR, f"suite-{engine}")
         log(f"=== JAX_TEST [{engine}] (workdir {wd}) ===")
         env = dict(os.environ, ENGINE=engine, WORKDIR=wd,
                    CONF_FILE=os.path.join(wd, "localConf.yaml"))
-        rc = subprocess.run([sys.executable, os.path.abspath(__file__),
-                             "JAX_TEST"], env=env, cwd=REPO_ROOT).returncode
-        if rc != 0:
-            raise SystemExit(f"JAX_TEST [{engine}] failed (rc={rc})")
+        cmd = [sys.executable, os.path.abspath(__file__), "JAX_TEST"]
+        attempts = []
+
+        def run_once():
+            p = subprocess.run(cmd, env=env, cwd=REPO_ROOT,
+                               capture_output=True, text=True)
+            sys.stdout.write(p.stdout)
+            sys.stderr.write(p.stderr)
+            attempts.append(p.returncode)
+            return p
+        p = run_once()
+        if p.returncode != 0:
+            # One retry per family, gated on the startup-wedge signature
+            # ("measured no events"): a tunneled-accelerator backend can
+            # wedge during the engine's first compile for the whole
+            # TEST_TIME while the same family passes cleanly moments
+            # later.  Any OTHER failure (oracle diff, crash) fails
+            # immediately — a retry must not launder intermittent bugs.
+            wedge = "measured no events" in (p.stdout + p.stderr)
+            if not wedge:
+                raise SystemExit(f"JAX_TEST [{engine}] failed "
+                                 f"(rc={p.returncode})")
+            log(f"JAX_TEST [{engine}] hit the startup-wedge signature "
+                f"(rc={p.returncode}); retrying once")
+            p = run_once()
+            if p.returncode != 0:
+                raise SystemExit(f"JAX_TEST [{engine}] failed twice "
+                                 f"(rc={p.returncode})")
+        summary.append({"engine": engine, "attempt_rcs": attempts,
+                        "retried": len(attempts) > 1})
         log(f"=== JAX_TEST [{engine}] done ===")
+    out = os.path.join(WORKDIR, "jax_test_suite.json")
+    with open(out, "w") as f:  # every attempt on the record
+        json.dump({"timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   "families": summary}, f, indent=1)
+    log(f"suite summary -> {out}: " + ", ".join(
+        f"{s['engine']}{' (retried)' if s['retried'] else ''}"
+        for s in summary))
 
 
 def op_pytest_suite() -> None:

@@ -125,17 +125,24 @@ def test_bench_sigkill_leaves_parseable_artifact(bench_copy, tmp_path):
     assert last["configs"][0]["config"] == "exact_count"
 
 
+def _load_bench(name="bench_mod"):
+    """Import bench.py as a throwaway module (its CLI lives under
+    __main__, so module-level exec is side-effect-free)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def test_ladder_retries_stall_signature_once(monkeypatch):
     """A failed rung whose p90 is within the SLA (only the extreme tail
     blew — the multi-second host/tunnel stall signature) is re-run once
     at the SAME rate instead of halving the ladder; both attempts stay
     in the artifact."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "bench_mod", os.path.join(REPO, "bench.py"))
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench()
 
     calls = []
 
@@ -165,17 +172,12 @@ def test_ladder_retries_stall_signature_once(monkeypatch):
     assert sum(1 for r in sweep["rates"] if r.get("stall_retried")) == 1
 
 
-def test_config_row_stall_retry_parks_first_attempt(monkeypatch):
+def test_config_row_stall_retry_parks_first_attempt():
     """The config-row paced retry must stamp the ladder's stall_retried
     key on the first attempt, hand it to on_first BEFORE re-running (a
     raising retry must not destroy the measured attempt), and skip the
     retry entirely when the median blew the SLA or the budget is gone."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "bench_mod2", os.path.join(REPO, "bench.py"))
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench("bench_mod2")
 
     def make_row(p50, p99):
         return {"rate": 20_000, "sent": 100, "processed": 100,
