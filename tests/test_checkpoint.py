@@ -152,3 +152,43 @@ def test_checkpointer_rotation_and_torn_file(tmp_path):
     ck2 = Checkpointer(str(tmp_path / "ck"), keep=2)
     ck2.save(mk(400))
     assert ck2.load().offset == 400
+
+
+def test_snapshot_mid_deferral_carries_parked_cycle(tmp_path, monkeypatch):
+    """A snapshot taken while drain cycles are parked (deferred-pull
+    mode, forced on CPU) must carry the parked deltas —
+    ``_snapshot_sync`` drains BOTH lists — so crash-after-snapshot +
+    restore reproduces exactly the uninterrupted engine's Redis
+    contents."""
+    from tests.test_scan_chunk import make_lines
+
+    from streambench_tpu.io.redis_schema import (
+        read_seen_counts,
+        seed_campaigns,
+    )
+
+    monkeypatch.setenv("STREAMBENCH_DEFER_DRAIN_PULL", "1")
+    lines, mapping, campaigns = make_lines(3000, seed=5)
+    cfg = default_config(jax_batch_size=256, jax_window_slots=16)
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, campaigns)
+    src = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns, redis=r)
+    assert src._defer_pull
+    src.process_chunk(lines[:2000])
+    src.flush()  # parks the first cycle (nothing written yet)
+    src.process_chunk(lines[2000:])
+    src.flush()  # materializes+writes cycle 1; parks cycle 2
+    snap = src.snapshot(offset=0)
+    src.drain_writes()
+    del src  # crash: no close(), the parked cycle only lives in snap
+
+    dst = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns, redis=r)
+    dst.restore(snap)
+    dst.close()  # writes the snapshot-carried pending
+
+    r2 = as_redis(FakeRedisStore())
+    seed_campaigns(r2, campaigns)
+    ref = AdAnalyticsEngine(cfg, mapping, campaigns=campaigns, redis=r2)
+    ref.process_chunk(lines)
+    ref.close()
+    assert read_seen_counts(r) == read_seen_counts(r2)

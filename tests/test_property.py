@@ -207,3 +207,50 @@ def test_microbatch_barrier_churn(seed, windows, extra):
     for k in range(windows):
         got = {campaigns[i]: int(v) for i, v in enumerate(merged[k]) if v}
         assert got == golden[k], f"window {k}"
+
+
+@given(stream=event_stream(), chunking=st.integers(1, 4))
+@settings(**SETTINGS)
+def test_deferred_drains_match_dostats_on_adversarial_streams(
+        stream, chunking):
+    """The tunneled-accelerator flush mode (drains parked one cycle,
+    forced on CPU here) under ragged chunking + random mid-stream
+    flushes must still agree with the golden model exactly — a
+    lost/duplicated parked cycle would show as a count diff."""
+    import os
+
+    # manual save/restore (not monkeypatch: hypothesis re-runs the body
+    # many times against one function-scoped fixture instance, which
+    # trips a health check) — a pre-existing value must survive
+    prior = os.environ.get("STREAMBENCH_DEFER_DRAIN_PULL")
+    os.environ["STREAMBENCH_DEFER_DRAIN_PULL"] = "1"
+    try:
+        cfg = default_config(jax_batch_size=B)
+        r = as_redis(FakeRedisStore())
+        seed_campaigns(r, sorted(set(MAPPING.values())))
+        eng = AdAnalyticsEngine(cfg, MAPPING, redis=r)
+        assert eng._defer_pull
+        rng = pyrandom.Random(4321)
+        i = 0
+        while i < len(stream):
+            step_n = rng.randint(1, chunking * B)
+            eng.process_lines(stream[i:i + step_n])
+            i += step_n
+            if rng.random() < 0.4:
+                eng.flush()  # non-final: parks the fresh drain
+        eng.close()  # final: materializes every parked cycle
+        assert eng.dropped == 0
+
+        golden = gen.dostats(events=stream, mapping_path=None,
+                             time_divisor_ms=DIV, mapping=MAPPING)
+        got = read_seen_counts(r)
+        flat_got = {(c, w // DIV): n
+                    for c in got for w, n in got[c].items()}
+        flat_want = {(c, b): n for c, per in golden.items()
+                     for b, n in per.items()}
+        assert flat_got == flat_want
+    finally:
+        if prior is None:
+            os.environ.pop("STREAMBENCH_DEFER_DRAIN_PULL", None)
+        else:
+            os.environ["STREAMBENCH_DEFER_DRAIN_PULL"] = prior
