@@ -119,3 +119,56 @@ def test_ops_are_rerunnable(tmp_path):
     proc = run_harness(["STOP_ALL"], {"WORKDIR": wd})
     assert proc.returncode == 0
     assert "No running instances" in proc.stdout
+
+
+def test_suite_retry_gated_on_wedge_signature(tmp_path, monkeypatch):
+    """op_jax_test_suite retries a family ONCE only on the zero-evidence
+    startup-wedge signature; any other failure fails immediately, and
+    every attempt's rc lands in jax_test_suite.json."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("sb_mod", SB)
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    monkeypatch.setattr(sb, "WORKDIR", str(tmp_path))
+
+    calls = []
+
+    class P:
+        def __init__(self, rc, out=""):
+            self.returncode = rc
+            self.stdout = out
+            self.stderr = ""
+
+    def fake_run(cmd, env=None, cwd=None, capture_output=None, text=None):
+        engine = env["ENGINE"]
+        calls.append(engine)
+        if engine == "hll" and calls.count("hll") == 1:
+            # first hll attempt: the wedge signature -> retried
+            return P(1, "JAX_TEST measured no events — the engine "
+                        "processed nothing")
+        return P(0)
+
+    monkeypatch.setattr(sb.subprocess, "run", fake_run)
+    sb.op_jax_test_suite()
+    assert calls == ["exact", "hll", "hll", "sliding", "session"]
+    rec = json.load(open(tmp_path / "jax_test_suite.json"))
+    by = {f["engine"]: f for f in rec["families"]}
+    assert by["hll"]["retried"] and by["hll"]["attempt_rcs"] == [1, 0]
+    assert not by["exact"]["retried"]
+
+    # a NON-wedge failure (oracle diff, crash) must fail immediately
+    calls.clear()
+
+    def fake_run_hard_fail(cmd, env=None, cwd=None, capture_output=None,
+                           text=None):
+        calls.append(env["ENGINE"])
+        return P(1, "windows DIFFER: 3")
+
+    monkeypatch.setattr(sb.subprocess, "run", fake_run_hard_fail)
+    try:
+        sb.op_jax_test_suite()
+        raise AssertionError("suite must fail on a non-wedge failure")
+    except SystemExit:
+        pass
+    assert calls == ["exact"], "no retry for a non-wedge failure"
