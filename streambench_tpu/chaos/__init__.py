@@ -1,0 +1,33 @@
+"""Chaos layer: deterministic fault injection + supervised recovery.
+
+See ROBUSTNESS.md for the failure model.  The pieces:
+
+- ``plan``       — seeded :class:`FaultPlan`, :class:`CrashScheduler`,
+  the simulated :class:`EngineCrash`
+- ``inject``     — :class:`FaultInjector` and its surface wrappers
+  (:class:`ChaosRedis`, :class:`ChaosJournalReader`)
+- ``supervisor`` — :class:`Supervisor` restart loop with capped
+  exponential backoff and no-progress give-up
+- ``verify``     — the executable at-least-once bound
+  (:func:`check_at_least_once`)
+"""
+
+from streambench_tpu.chaos.inject import (  # noqa: F401
+    ChaosJournalReader,
+    ChaosRedis,
+    FaultInjector,
+)
+from streambench_tpu.chaos.plan import (  # noqa: F401
+    CrashScheduler,
+    EngineCrash,
+    FaultPlan,
+)
+from streambench_tpu.chaos.supervisor import (  # noqa: F401
+    Supervisor,
+    SupervisorStats,
+)
+from streambench_tpu.chaos.verify import (  # noqa: F401
+    ChaosVerdict,
+    check_at_least_once,
+    segment_view_counts,
+)

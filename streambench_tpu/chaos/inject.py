@@ -1,0 +1,216 @@
+"""Fault-injecting wrappers for the three failure surfaces.
+
+One :class:`FaultInjector` owns a :class:`~streambench_tpu.chaos.plan.FaultPlan`
+plus the *global* operation counters, and hands out wrappers:
+
+- :meth:`FaultInjector.wrap_redis` — a sink proxy injecting connection
+  refusals, timeouts, and transient RESP errors into the window-writeback
+  path (raised *before* forwarding: a faulted op applies nothing);
+- :meth:`FaultInjector.wrap_reader` — a journal-reader wrapper injecting
+  torn tails, truncated reads, and corrupt records, all transient (the
+  damaged bytes are rewound and re-delivered intact on the next poll, so
+  injection can never lose an event);
+- :attr:`FaultInjector.scheduler` — the crash scheduler a
+  ``StreamRunner`` takes as ``crash_points``.
+
+Operation indices are owned by the injector, NOT the wrappers, so a
+supervised restart (which re-wraps fresh engine/reader objects) continues
+the plan where the crashed attempt left it instead of replaying the same
+faults forever.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from streambench_tpu.chaos.plan import CrashScheduler, FaultPlan
+from streambench_tpu.io.resp import RespError
+from streambench_tpu.metrics import FaultCounters
+
+# The NUL zero-page a crashed writer's torn append leaves behind
+# (filesystems zero-fill the unwritten tail of a dirtied page).
+TORN_PAGE = b"\x00" * 64
+
+
+class ChaosRedis:
+    """RedisLike proxy that injects scheduled sink faults.
+
+    Faults are atomic — raised before the command is forwarded — so a
+    faulted write applies nothing (see ``chaos.plan`` for why the
+    at-least-once bound needs this).  One fault decision per
+    ``execute``/``pipeline_execute`` call: the writeback path submits
+    whole flush batches, so per-call granularity is per-batch
+    granularity, matching how a real connection fails.
+
+    Underscore attributes are deliberately NOT forwarded: the engine
+    probes ``redis._store`` to pick its in-C bulk writeback, which would
+    bypass this proxy entirely — hiding it forces every flush through
+    the faultable path.
+    """
+
+    def __init__(self, target, injector: "FaultInjector"):
+        self._target = target
+        self._injector = injector
+
+    def _maybe_fault(self) -> None:
+        kind = self._injector.sink_fault()
+        if kind == "refused":
+            raise ConnectionRefusedError("chaos: connection refused")
+        if kind == "timeout":
+            raise TimeoutError("chaos: sink operation timed out")
+        if kind == "resp":
+            raise RespError(
+                "LOADING chaos: Redis is loading the dataset in memory")
+
+    def execute(self, *args):
+        self._maybe_fault()
+        return self._target.execute(*args)
+
+    def pipeline_execute(self, commands):
+        self._maybe_fault()
+        return self._target.pipeline_execute(commands)
+
+    def reconnect(self) -> None:
+        """Connection management, never faulted (a refused reconnect is
+        modeled as the NEXT op faulting, which the plan already covers)."""
+        reconnect = getattr(self._target, "reconnect", None)
+        if reconnect is not None:
+            reconnect()
+
+    def close(self) -> None:
+        self._target.close()
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._target, name)
+
+
+class ChaosJournalReader:
+    """JournalReader wrapper injecting transient read damage.
+
+    Wraps a single-partition ``JournalReader`` (``MultiReader`` is not
+    supported: rewind bookkeeping needs one byte offset).  Fault kinds:
+
+    - ``truncated`` — a short read: only a prefix (cut at a record
+      boundary) is delivered, the rest rewound;
+    - ``torn``      — a torn tail: a prefix plus a NUL zero-page
+      pseudo-record (``TORN_PAGE``), the real records rewound;
+    - ``corrupt``   — the record after the cut is delivered as a
+      NUL-damaged copy and rewound for intact re-delivery.
+
+    Every fault preserves the journal's byte-exactness: ``offset`` never
+    covers damaged bytes, so checkpoints taken through this wrapper
+    resume correctly.  Damaged pseudo-records always contain NULs and
+    can never parse as events (the encoder rejects them), so injection
+    shows up as ``bad_lines``, never as count drift.
+    """
+
+    def __init__(self, delegate, injector: "FaultInjector"):
+        self._delegate = delegate
+        self._injector = injector
+        self.fault_counters = injector.counters
+
+    # -- checkpoint surface (forwarded byte-exactly) -------------------
+    @property
+    def offset(self) -> int:
+        return self._delegate.offset
+
+    def seek(self, offset: int) -> None:
+        self._delegate.seek(offset)
+
+    def close(self) -> None:
+        self._delegate.close()
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._delegate, name)
+
+    # -- faulted reads -------------------------------------------------
+    def poll(self, max_records: int = 65536) -> list[bytes]:
+        before = self._delegate.offset
+        lines = self._delegate.poll(max_records)
+        if not lines:
+            return lines
+        kind = self._injector.journal_fault()
+        if kind is None:
+            return lines
+        cut = len(lines) // 2
+        keep = lines[:cut]
+        self._delegate.seek(before + sum(len(l) + 1 for l in keep))
+        if kind == "truncated":
+            return keep
+        if kind == "torn":
+            return keep + [TORN_PAGE]
+        victim = lines[cut]
+        half = max(len(victim) // 2, 1)
+        return keep + [victim[:half] + b"\x00" * (len(victim) - half)]
+
+    def poll_block(self, max_bytes: int | None = None) -> bytes:
+        before = self._delegate.offset
+        data = self._delegate.poll_block(max_bytes)
+        if not data:
+            return data
+        kind = self._injector.journal_fault()
+        if kind is None:
+            return data
+        # cut at the record boundary nearest the middle of the block
+        pos = data.rfind(b"\n", 0, max(len(data) // 2, 1)) + 1
+        self._delegate.seek(before + pos)
+        keep = data[:pos]
+        if kind == "truncated":
+            return keep
+        if kind == "torn":
+            return keep + TORN_PAGE + b"\n"
+        end = data.find(b"\n", pos)
+        victim = data[pos:end if end >= 0 else len(data)]
+        half = max(len(victim) // 2, 1)
+        return (keep + victim[:half]
+                + b"\x00" * (len(victim) - half) + b"\n")
+
+
+class FaultInjector:
+    """The plan's executor: wraps surfaces, owns global fault indices.
+
+    One injector per chaos run.  Wrap fresh engine/reader objects at
+    every supervised restart; the injector's counters make the plan
+    progress monotonically across attempts.
+    """
+
+    def __init__(self, plan: FaultPlan,
+                 counters: FaultCounters | None = None):
+        self.plan = plan
+        self.counters = counters if counters is not None else FaultCounters()
+        self.scheduler = CrashScheduler(plan.crashes, self.counters)
+        self._lock = threading.Lock()
+        self._sink_idx = 0
+        self._journal_idx = 0
+
+    def sink_fault(self) -> str | None:
+        with self._lock:
+            i = self._sink_idx
+            self._sink_idx += 1
+        kind = self.plan.sink_faults.get(i)
+        if kind is not None:
+            self.counters.inc("chaos_sink_faults")
+        return kind
+
+    def journal_fault(self) -> str | None:
+        with self._lock:
+            i = self._journal_idx
+            self._journal_idx += 1
+        kind = self.plan.journal_faults.get(i)
+        if kind is not None:
+            self.counters.inc("journal_faults")
+        return kind
+
+    def wrap_redis(self, target) -> ChaosRedis:
+        return ChaosRedis(target, self)
+
+    def wrap_reader(self, delegate) -> ChaosJournalReader:
+        if not hasattr(delegate, "offset") or not hasattr(delegate, "seek"):
+            raise TypeError(
+                "ChaosJournalReader wraps a single-partition "
+                "JournalReader (MultiReader has no scalar offset)")
+        return ChaosJournalReader(delegate, self)

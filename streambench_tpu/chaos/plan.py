@@ -1,0 +1,166 @@
+"""Deterministic fault plans: the seeded script a chaos run executes.
+
+The reference fork has no fault story at all — ``enableCheckpointing`` is
+commented out (``AdvertisingTopologyNative.java:81-84``) and a Redis
+outage is a Jedis stack trace; nothing ever *exercises* recovery.  A
+``FaultPlan`` makes adversity reproducible: every injected fault (sink
+error, journal read damage, simulated crash) is scheduled up front from
+one RNG seed, so a failing chaos run replays bit-identically under the
+same seed — the property the oracle-verified recovery tests depend on.
+
+Fault surfaces (see ``chaos.inject`` for the wrappers):
+
+- **sink** — per store-operation index: ``refused`` (connection refused),
+  ``timeout`` (socket timeout), ``resp`` (transient server-side RESP
+  error, e.g. ``LOADING``).  Faults are injected *before* the command is
+  forwarded, i.e. atomically: a faulted operation applies nothing.  This
+  matches a refused connection exactly and models timeouts
+  conservatively (a real timeout can land after a partial pipeline; the
+  at-least-once bound in ROBUSTNESS.md assumes atomic failure).
+- **journal** — per reader-poll index: ``truncated`` (short read),
+  ``torn`` (a NUL zero-page tail, what a crashed writer's partial page
+  looks like), ``corrupt`` (a NUL-damaged copy of the next record).
+  All three are *transient*: the damaged bytes are re-delivered intact
+  on the next poll, so no event is ever lost to injection — required
+  for the oracle lower bound to hold.
+- **crash** — ordered ``(boundary, count)`` points consumed one at a
+  time by the :class:`CrashScheduler`; boundary kinds are ``batch``,
+  ``flush``, ``checkpoint`` (the hooks in ``StreamRunner``).
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from dataclasses import dataclass, field
+
+from streambench_tpu.metrics import FaultCounters
+
+SINK_KINDS = ("refused", "timeout", "resp")
+JOURNAL_KINDS = ("truncated", "torn", "corrupt")
+CRASH_KINDS = ("batch", "flush", "checkpoint")
+
+
+class EngineCrash(RuntimeError):
+    """A simulated process crash raised at a runner boundary.
+
+    Semantically the injected peer of ``kill -9``: the engine object is
+    abandoned exactly where it stood (device state, parked drains,
+    queued writebacks — all lost), and recovery must come entirely from
+    the checkpoint + journal replay path, never from cleanup code."""
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """One immutable, fully-enumerated fault schedule.
+
+    ``sink_faults``/``journal_faults`` map an operation index (counted by
+    the injecting wrapper from its construction) to a fault kind;
+    ``crashes`` is the ordered crash script.  An empty plan
+    (:meth:`zeros`) injects nothing — wrappers built from it are exact
+    pass-throughs, pinned by the byte-identical test.
+    """
+
+    seed: int = 0
+    sink_faults: dict = field(default_factory=dict)      # op idx -> kind
+    journal_faults: dict = field(default_factory=dict)   # poll idx -> kind
+    crashes: tuple = ()                                  # ((kind, n), ...)
+
+    @classmethod
+    def zeros(cls) -> "FaultPlan":
+        """The no-fault plan (chaos layer present, adversity absent)."""
+        return cls()
+
+    @classmethod
+    def generate(cls, seed: int, *,
+                 sink_rate: float = 0.0,
+                 sink_ops: int = 0,
+                 sink_outage: tuple[int, int] | None = None,
+                 journal_rate: float = 0.0,
+                 journal_polls: int = 0,
+                 crashes: int = 0,
+                 crash_span: int = 8) -> "FaultPlan":
+        """Roll a deterministic plan from ``seed``.
+
+        ``sink_rate``/``journal_rate`` are per-operation fault
+        probabilities over the first ``sink_ops``/``journal_polls``
+        operations (beyond those indices the surface runs clean, which
+        guarantees retries eventually succeed).  ``sink_outage=(start,
+        length)`` additionally fails every sink op in that index range —
+        a hard outage window.  ``crashes`` schedules that many crash
+        points, each at a random boundary kind within the first
+        ``crash_span`` boundaries of an attempt.
+        """
+        rng = random.Random(seed)
+        sink: dict[int, str] = {}
+        for i in range(sink_ops):
+            if rng.random() < sink_rate:
+                sink[i] = rng.choice(SINK_KINDS)
+        if sink_outage is not None:
+            start, length = sink_outage
+            for i in range(start, start + length):
+                sink[i] = "refused"
+        journal: dict[int, str] = {}
+        for i in range(journal_polls):
+            if rng.random() < journal_rate:
+                journal[i] = rng.choice(JOURNAL_KINDS)
+        # Batch boundaries are plentiful; flush/checkpoint boundaries are
+        # scarce in catchup mode (one final flush + one final checkpoint
+        # per attempt, plus the 1 Hz periodic ones a fast drain may never
+        # reach) — cap their scheduled ordinal at 2 so the armed head of
+        # the script is always reachable and never wedges the whole plan.
+        crash_script = []
+        for _ in range(crashes):
+            kind = rng.choice(CRASH_KINDS)
+            hi = crash_span if kind == "batch" else min(crash_span, 2)
+            crash_script.append((kind, rng.randrange(1, hi + 1)))
+        crash_script = tuple(crash_script)
+        return cls(seed=seed, sink_faults=sink, journal_faults=journal,
+                   crashes=crash_script)
+
+    @property
+    def is_zero(self) -> bool:
+        return not (self.sink_faults or self.journal_faults or self.crashes)
+
+
+class CrashScheduler:
+    """Raises :class:`EngineCrash` at scripted runner boundaries.
+
+    Holds the plan's ordered crash script; only the HEAD entry is armed.
+    Boundary counts are per-attempt (``reset()`` at every supervised
+    restart), so ``("flush", 3)`` means "the 3rd flush of the current
+    attempt", which keeps crash points reachable no matter where the
+    previous crash left the stream.  Exhausted schedulers never raise —
+    the run is guaranteed to finish once the script is consumed.
+    """
+
+    def __init__(self, crashes, counters: FaultCounters | None = None):
+        for kind, n in crashes:
+            if kind not in CRASH_KINDS or n < 1:
+                raise ValueError(f"bad crash point ({kind!r}, {n})")
+        self._pending = deque(crashes)
+        self.counters = counters if counters is not None else FaultCounters()
+        self._counts: dict[str, int] = {}
+
+    def reset(self) -> None:
+        """New run attempt: boundary counts restart at zero."""
+        self._counts = {}
+
+    @property
+    def exhausted(self) -> bool:
+        return not self._pending
+
+    @property
+    def remaining(self) -> int:
+        return len(self._pending)
+
+    def point(self, kind: str) -> None:
+        """One boundary of ``kind`` passed; crash here if scripted."""
+        self._counts[kind] = c = self._counts.get(kind, 0) + 1
+        if not self._pending:
+            return
+        want_kind, want_n = self._pending[0]
+        if kind == want_kind and c >= want_n:
+            self._pending.popleft()
+            self.counters.inc("crashes_injected")
+            raise EngineCrash(f"injected crash at {kind} #{c}")

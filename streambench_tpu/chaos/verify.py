@@ -1,0 +1,142 @@
+"""Oracle-verified at-least-once accounting: the executable contract.
+
+``checkpoint.py`` documents the recovery guarantee prose-style: *"the
+replay window is bounded by the snapshot cadence"*.  This module turns
+that sentence into an assertable invariant.  For a supervised chaos run
+(``chaos.supervisor``) over a journaled topic, every per-window Redis
+count must satisfy::
+
+    oracle(w)  <=  count(w)  <=  oracle(w) + bound(w)
+
+where ``oracle`` is the golden model's exact view count
+(``datagen.gen.dostats``, the peer of ``check-correct`` in
+``core.clj:215-237``) and ``bound`` is the sum of the two legal
+over-count sources the supervisor recorded:
+
+- the *replay segments*: for each crash, the view events in the journal
+  byte range ``[resume_offset, crash_offset)`` — events that may have
+  been flushed before the crash and re-folded after the resume;
+- the *carried pending*: snapshot-carried deltas (reclaimed failed
+  writes) that may already have landed before the crash and are
+  re-flushed after restore.
+
+Anything outside those bounds is a real bug: a count below the oracle is
+lost data (the at-least-once side), a count above the bound is
+double-counting the documented contract does not allow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.redis_schema import read_seen_counts
+
+
+def segment_view_counts(topic_path: str, segments,
+                        mapping: dict[str, str],
+                        divisor_ms: int = 10_000) -> dict:
+    """Per-window view counts over journal byte ranges.
+
+    ``segments`` are ``(lo, hi)`` byte offsets into the topic file (the
+    unit the supervisor records); multi-partition offset vectors are not
+    supported (the chaos harness drives single-partition topics).
+    Returns ``(campaign, abs_window_ts) -> count`` summed over segments;
+    overlapping segments intentionally double-count (each crash is an
+    independent replay opportunity).
+    """
+    out: dict[tuple[str, int], int] = {}
+    with open(topic_path, "rb") as f:
+        for lo, hi in segments:
+            if isinstance(lo, list) or isinstance(hi, list):
+                raise ValueError(
+                    "segment offsets must be scalars (single-partition "
+                    f"topics only): ({lo!r}, {hi!r})")
+            if hi <= lo:
+                continue
+            f.seek(lo)
+            blob = f.read(hi - lo)
+            for line in blob.split(b"\n"):
+                if not line.strip() or b"\x00" in line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # segment edge cut a record in half
+                if ev.get("event_type") != "view":
+                    continue
+                campaign = mapping.get(ev.get("ad_id"))
+                if campaign is None:
+                    continue
+                wts = (int(ev["event_time"]) // divisor_ms) * divisor_ms
+                out[(campaign, wts)] = out.get((campaign, wts), 0) + 1
+    return out
+
+
+@dataclass
+class ChaosVerdict:
+    """The bound check's full report (``ok`` is the headline)."""
+
+    ok: bool
+    windows: int = 0
+    exact: int = 0               # count == oracle
+    within_bound: int = 0        # oracle < count <= oracle + bound
+    undercounts: list = field(default_factory=list)
+    overcounts: list = field(default_factory=list)
+    max_overcount: int = 0
+
+    def summary(self) -> str:
+        return (f"chaos verdict: ok={self.ok} windows={self.windows} "
+                f"exact={self.exact} within_bound={self.within_bound} "
+                f"under={len(self.undercounts)} over={len(self.overcounts)} "
+                f"max_overcount={self.max_overcount}")
+
+
+def check_at_least_once(redis, workdir: str, topic_path: str,
+                        replay_segments=(), carried=None,
+                        divisor_ms: int = 10_000) -> ChaosVerdict:
+    """Assert the at-least-once contract against a finished chaos run.
+
+    ``redis`` holds the engine's writes; ``workdir`` holds the
+    generator's ``kafka-json.txt`` + ad mapping (the oracle inputs);
+    ``topic_path`` is the single-partition topic file whose byte offsets
+    the supervisor's ``replay_segments`` index; ``carried`` is the
+    supervisor's snapshot-carried pending map.  Violations are collected,
+    not raised — tests assert on ``verdict.ok`` and print ``summary()``.
+    """
+    mapping = gen.load_ad_mapping_file(
+        os.path.join(workdir, gen.AD_TO_CAMPAIGN_FILE))
+    oracle_buckets = gen.dostats(workdir, time_divisor_ms=divisor_ms)
+    oracle = {(c, b * divisor_ms): n
+              for c, per in oracle_buckets.items()
+              for b, n in per.items()}
+    bound = segment_view_counts(topic_path, replay_segments, mapping,
+                                divisor_ms)
+    for key, n in (carried or {}).items():
+        bound[key] = bound.get(key, 0) + n
+    actual_nested = read_seen_counts(redis)
+    actual = {(c, ts): n
+              for c, per in actual_nested.items()
+              for ts, n in per.items()}
+
+    v = ChaosVerdict(ok=True)
+    for key in sorted(set(oracle) | set(actual)):
+        want = oracle.get(key, 0)
+        have = actual.get(key, 0)
+        slack = bound.get(key, 0)
+        v.windows += 1
+        if have == want:
+            v.exact += 1
+        elif want < have <= want + slack:
+            v.within_bound += 1
+            v.max_overcount = max(v.max_overcount, have - want)
+        elif have < want:
+            v.ok = False
+            v.undercounts.append((key, have, want))
+        else:
+            v.ok = False
+            v.overcounts.append((key, have, want, slack))
+            v.max_overcount = max(v.max_overcount, have - want)
+    return v

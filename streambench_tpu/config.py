@@ -100,6 +100,27 @@ class BenchmarkConfig:
     jax_mesh_shape: tuple[int, ...] = (1,)  # device mesh (batch axis first)
     jax_mesh_axes: tuple[str, ...] = ("data",)
     jax_use_native_encoder: bool = True    # C++ fast-path when the .so is built
+    # --- robustness knobs (ROBUSTNESS.md; the reference has none of these:
+    # a Redis outage is a Jedis stack trace and enableCheckpointing is
+    # commented out, AdvertisingTopologyNative.java:81-84) ---
+    jax_sink_retry_base_ms: int = 100      # first writer backoff after a
+    #   failed window writeback; doubles per consecutive failure
+    jax_sink_retry_cap_ms: int = 5000      # backoff ceiling (keeps the retry
+    #   cadence near the 1 Hz flush once an outage persists)
+    jax_sink_dirty_cap_rows: int = 1 << 18  # retained-row high-water mark:
+    #   past this the failed-write buffer is coalesced by (campaign, window)
+    #   and a warning is logged; rows are NEVER dropped (dropping = silent
+    #   undercount, the failure mode the retained-batch design exists to
+    #   prevent)
+    jax_supervisor_restarts: int = 3       # consecutive NO-PROGRESS restarts
+    #   (checkpoint offset did not advance) before the supervisor gives up;
+    #   restarts that advance the offset reset the count
+    jax_supervisor_backoff_base_ms: int = 50   # restart backoff, doubled per
+    #   consecutive crash, with jitter
+    jax_supervisor_backoff_cap_ms: int = 2000  # restart backoff ceiling
+    jax_deadletter_enabled: bool = False   # journal malformed events to a
+    #   <topic>-deadletter topic instead of only counting them (bad_lines);
+    #   off by default: the reference drops bad tuples silently
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -195,6 +216,15 @@ class BenchmarkConfig:
             jax_mesh_shape=mesh_shape_t,
             jax_mesh_axes=tuple(_as_list(mesh_axes)) or ("data",),
             jax_use_native_encoder=getb("jax.use.native.encoder", True),
+            jax_sink_retry_base_ms=geti("jax.sink.retry.base.ms", 100),
+            jax_sink_retry_cap_ms=geti("jax.sink.retry.cap.ms", 5000),
+            jax_sink_dirty_cap_rows=geti("jax.sink.dirty.cap.rows", 1 << 18),
+            jax_supervisor_restarts=geti("jax.supervisor.restarts", 3),
+            jax_supervisor_backoff_base_ms=geti(
+                "jax.supervisor.backoff.base.ms", 50),
+            jax_supervisor_backoff_cap_ms=geti(
+                "jax.supervisor.backoff.cap.ms", 2000),
+            jax_deadletter_enabled=getb("jax.deadletter.enabled", False),
             raw=dict(conf),
         )
 

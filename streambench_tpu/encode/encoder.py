@@ -180,10 +180,31 @@ class EventEncoder:
         self.base_time_ms: int | None = None
         self.fallback_lines = 0
         self.bad_lines = 0
+        # Dead-letter sink (optional): malformed lines are appended here
+        # raw instead of only being counted — the reference silently drops
+        # bad tuples; a DLQ keeps them replayable after a parser fix.
+        self._deadletter = None
+        self.dlq_lines = 0
 
     @property
     def num_campaigns(self) -> int:
         return len(self.campaigns)
+
+    def set_deadletter(self, sink) -> None:
+        """Attach a dead-letter sink (anything with ``append(bytes)``,
+        e.g. a ``JournalWriter`` on a ``<topic>-deadletter`` topic).
+        Every line that would only bump ``bad_lines`` is also appended
+        raw; both encoder paths (fast/fallback, Python/native) reject
+        through the same counting sites, so the DLQ sees every reject."""
+        self._deadletter = sink
+
+    def _reject(self, line: bytes) -> None:
+        """One malformed line: count it, and dead-letter it if a sink is
+        attached (the ONLY place ``bad_lines`` is allowed to grow)."""
+        self.bad_lines += 1
+        if self._deadletter is not None:
+            self._deadletter.append(bytes(line))
+            self.dlq_lines += 1
 
     def set_base_time(self, base_time_ms: int | None) -> None:
         """Pin the rebase origin (checkpoint restore): window ids are
@@ -305,7 +326,7 @@ class EventEncoder:
                 self.fallback_lines += 1
                 rec = self._parse_slow(line)
                 if rec is None:
-                    self.bad_lines += 1
+                    self._reject(line)
                     continue
             u, p, ad, at, et, t = rec
             if self.base_time_ms is None:
@@ -338,9 +359,9 @@ class EventEncoder:
         for line in lines:
             f = line.rstrip(b"\n").split(b"|")
             if len(f) < 6:
-                self.bad_lines += 1
+                self._reject(line)
                 continue
-            converted.append(f)
+            converted.append((line, f))
         if len(converted) > B:
             raise ValueError(f"{len(converted)} lines exceed batch size {B}")
         ad_idx = np.zeros(B, np.int32)
@@ -351,11 +372,12 @@ class EventEncoder:
         ad_type = np.full(B, -1, np.int32)
         valid = np.zeros(B, bool)
         n = 0
-        for u, p, ad, at, et, t in (c[:6] for c in converted):
+        for line, c in converted:
+            u, p, ad, at, et, t = c[:6]
             try:
                 ti = int(t)
             except ValueError:
-                self.bad_lines += 1
+                self._reject(line)
                 continue
             if self.base_time_ms is None:
                 self._rebase(ti)

@@ -164,7 +164,7 @@ class NativeEventEncoder(EventEncoder):
             self.fallback_lines += 1
             rec = self._parse_fallback(lines[i])
             if rec is None:
-                self.bad_lines += 1
+                self._reject(lines[i])
                 status[i] = 0
                 continue
             (ad_idx[i], etype[i], etime[i], user_idx[i], page_idx[i],
@@ -233,7 +233,7 @@ class NativeEventEncoder(EventEncoder):
             self.fallback_lines += 1
             rec = self._parse_fallback(line)
             if rec is None:
-                self.bad_lines += 1
+                self._reject(line)
                 status[i] = 0
                 continue
             (ad_idx[i], etype[i], etime[i], user_idx[i], page_idx[i],

@@ -159,6 +159,15 @@ def main(argv: list[str] | None = None) -> int:
                          args.brokerDir
                          or os.path.join(args.workdir, "broker"))
     broker.create_topic(cfg.kafka_topic)
+    # Dead-letter queue (off by default): malformed events are journaled
+    # to <topic>-deadletter instead of only bumping bad_lines, so they
+    # stay replayable after a parser fix (the reference drops bad tuples
+    # silently).  Wired to the primary encoder; parallel encode pool
+    # workers still count rejects but journal only from the primary.
+    deadletter = None
+    if cfg.jax_deadletter_enabled:
+        deadletter = broker.writer(f"{cfg.kafka_topic}-deadletter")
+        engine.encoder.set_deadletter(deadletter)
     # Checkpointing works for every engine family (sketch snapshots carry
     # their device state + intern tables, engine.sketches) and for
     # multi-partition topics (per-partition offset vector, checkpoint.py).
@@ -203,6 +212,8 @@ def main(argv: list[str] | None = None) -> int:
                                idle_timeout_s=args.idleTimeout,
                                max_events=args.maxEvents)
     engine.close()
+    if deadletter is not None:
+        deadletter.close()
     # stage spans + Apex-style decile report (SURVEY.md §5.1/§5.5)
     print(engine.tracer.report(), file=sys.stderr, flush=True)
     print(engine.latency_tracker.report(), file=sys.stderr, flush=True)
@@ -214,6 +225,7 @@ def main(argv: list[str] | None = None) -> int:
         "windows_written": stats.windows_written,
         "events_per_s": round(stats.events_per_s, 1),
         "dropped": engine.dropped, "wall_s": round(stats.wall_s, 2),
+        "faults": stats.faults,
     }), flush=True)
     return 0
 

@@ -33,7 +33,7 @@ from streambench_tpu.io.redis_schema import (
     dump_latency_hash,
     write_windows_pipelined,
 )
-from streambench_tpu.metrics import LatencyTracker
+from streambench_tpu.metrics import FaultCounters, LatencyTracker
 from streambench_tpu.ops import windowcount as wc
 from streambench_tpu.trace import Tracer
 from streambench_tpu.utils.ids import now_ms
@@ -93,14 +93,29 @@ class _RedisWriter:
     actual write time (``core.clj:149`` defines latency truth), unless the
     caller pinned a stamp.  A bounded queue provides backpressure; errors
     surface on the next ``drain``/``close``.
+
+    Sink-outage tolerance (ROBUSTNESS.md): a failed write is retained for
+    reclaim (never dropped), the NEXT attempt is delayed by capped
+    exponential backoff (a down sink must not be hammered at queue
+    drain speed), a ``reconnect()``-capable client is re-dialed before
+    retrying, and the retained buffer is coalesced by (campaign, window)
+    past a high-water row count so an hours-long outage holds memory at
+    O(dirty windows), not O(outage duration).
     """
 
     def __init__(self, redis: RedisLike, absolute: bool, tracer: Tracer,
-                 on_written) -> None:
+                 on_written, faults: "FaultCounters | None" = None,
+                 retry_base_ms: int = 100, retry_cap_ms: int = 5000,
+                 dirty_cap_rows: int = 1 << 18) -> None:
         self._redis = redis
         self._absolute = absolute
         self._tracer = tracer
         self._on_written = on_written   # (rows, stamp) latency bookkeeping
+        self._faults = faults if faults is not None else FaultCounters()
+        self._retry_base_ms = max(int(retry_base_ms), 1)
+        self._retry_cap_ms = max(int(retry_cap_ms), self._retry_base_ms)
+        self._dirty_cap_rows = max(int(dirty_cap_rows), 1)
+        self._consec_failures = 0
         # window/list-UUID memo across flushes (sole-writer assumption,
         # see write_windows_pipelined); only this thread touches it
         self._uuid_cache: dict = {}
@@ -111,9 +126,69 @@ class _RedisWriter:
         # into _pending (take_failed) — a transient Redis outage must not
         # permanently undercount windows.
         self._failed: list[list] = []
+        self._failed_rows = 0
+        # interruptible backoff sleep: close() sets this so shutdown never
+        # waits out a capped backoff
+        self._wake = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="redis-writer")
         self._thread.start()
+
+    def _backoff_ms(self) -> int:
+        """Capped exponential backoff for the current failure streak."""
+        n = min(self._consec_failures, 16)  # 2**16 already >> any cap
+        return min(self._retry_base_ms * (1 << max(n - 1, 0)),
+                   self._retry_cap_ms)
+
+    def _on_failure(self, rows: list, err: BaseException) -> None:
+        import sys
+
+        self._consec_failures += 1
+        self._faults.inc("sink_errors")
+        back = self._backoff_ms()
+        self._faults.inc("sink_backoff_ms", back)
+        print(f"redis writer: write of {len(rows)} rows failed "
+              f"({err!r}); retained for retry, backoff {back} ms",
+              file=sys.stderr, flush=True)
+        with self._lock:
+            self._failed.append(rows)
+            self._failed_rows += len(rows)
+            self._error = err
+            if self._failed_rows > self._dirty_cap_rows:
+                self._coalesce_failed_locked()
+        # Re-dial before the next attempt: a half-open socket hangs every
+        # command until its timeout; a fresh connect fails fast or works.
+        reconnect = getattr(self._redis, "reconnect", None)
+        if reconnect is not None:
+            try:
+                reconnect()
+                self._faults.inc("sink_reconnects")
+            except Exception:
+                pass  # still down; the backoff covers it
+        self._wake.wait(back / 1000.0)
+        self._wake.clear()
+
+    def _coalesce_failed_locked(self) -> None:
+        """Merge the retained batches by (campaign, window) — deltas sum;
+        absolute values keep the freshest (batch order is write order).
+        Called with the lock held, past the high-water mark only."""
+        import sys
+
+        merged: dict[tuple, int] = {}
+        for batch in self._failed:
+            for camp, ts, n in batch:
+                if self._absolute:
+                    merged[(camp, ts)] = n
+                else:
+                    merged[(camp, ts)] = merged.get((camp, ts), 0) + n
+        rows = [(c, ts, n) for (c, ts), n in merged.items()]
+        before = self._failed_rows
+        self._failed = [rows]
+        self._failed_rows = len(rows)
+        self._faults.inc("sink_dirty_high_water")
+        print(f"redis writer: retained rows passed high water "
+              f"({before} > {self._dirty_cap_rows}); coalesced to "
+              f"{len(rows)} dirty windows", file=sys.stderr, flush=True)
 
     def _run(self) -> None:
         while True:
@@ -140,19 +215,18 @@ class _RedisWriter:
                                 absolute=self._absolute,
                                 cache=self._uuid_cache)
                 except BaseException as e:  # retained for reclaim/retry
-                    import sys
-                    rows = (payload.to_rows() if arrays else payload)
-                    print(f"redis writer: write of {len(rows)} rows "
-                          f"failed ({e!r}); retained for retry",
-                          file=sys.stderr, flush=True)
-                    with self._lock:
-                        self._failed.append(rows)
-                        self._error = e
+                    self._on_failure(payload.to_rows() if arrays
+                                     else payload, e)
                 else:
+                    self._consec_failures = 0
                     # latency bookkeeping only for rows that actually landed
                     self._on_written(payload, stamp)
             finally:
                 self._q.task_done()
+
+    def has_failed(self) -> bool:
+        with self._lock:
+            return bool(self._failed)
 
     def take_failed(self) -> list[list]:
         """Hand back batches whose write failed (clears the retention).
@@ -160,6 +234,7 @@ class _RedisWriter:
         retries — a transient Redis outage must not undercount windows."""
         with self._lock:
             failed, self._failed = self._failed, []
+            self._failed_rows = 0
         return failed
 
     def submit(self, rows, stamp: int | None) -> None:
@@ -175,6 +250,7 @@ class _RedisWriter:
         reclaimed — silent data loss at shutdown is not an option."""
         if self._thread.is_alive():
             self._q.put(None)
+            self._wake.set()  # cut short any in-progress backoff sleep
             self._thread.join()
         with self._lock:
             lost, err = len(self._failed), self._error
@@ -315,6 +391,9 @@ class AdAnalyticsEngine:
         # stage spans (SURVEY.md §5.1) + Apex-style decile accounting (§5.5)
         self.tracer = Tracer()
         self.latency_tracker = LatencyTracker(window_ms=self.divisor)
+        # fault/retry/recovery accounting (ROBUSTNESS.md): shared with the
+        # writer thread; surfaced via RunStats.faults at end of run
+        self.faults = FaultCounters()
         self._writer: _RedisWriter | None = None
         # Parallel encode pool (multi-core hosts): per-thread encoders,
         # sound only for engines whose kernel never reads the interned
@@ -1055,7 +1134,10 @@ class AdAnalyticsEngine:
             if self._writer is None:
                 self._writer = _RedisWriter(
                     self.redis, self.absolute_counts, self.tracer,
-                    self._note_written)
+                    self._note_written, faults=self.faults,
+                    retry_base_ms=self.cfg.jax_sink_retry_base_ms,
+                    retry_cap_ms=self.cfg.jax_sink_retry_cap_ms,
+                    dirty_cap_rows=self.cfg.jax_sink_dirty_cap_rows)
             if rows:
                 self._writer.submit(rows, time_updated)
             if arrays is not None:
@@ -1106,6 +1188,7 @@ class AdAnalyticsEngine:
             return
         idx = self.encoder.campaign_index
         for batch in self._writer.take_failed():
+            self.faults.inc("sink_retries", len(batch))
             for camp, ts, n in batch:
                 if self.absolute_counts:
                     # A fresher re-drained estimate already in _pending
@@ -1209,8 +1292,15 @@ class AdAnalyticsEngine:
                 self._dirty_rows.append(live)
         self.encoder.set_base_time(snap.meta["base_time_ms"])
         self._span_start = snap.meta["span_start"]
-        self._host_wm = (int(snap.meta["base_time_ms"])
-                         + int(snap.watermark)) if int(snap.watermark) else None
+        # Gate on the NEG "no events" sentinel explicitly: a truthiness
+        # check treated a legitimate relative watermark of 0 as unset
+        # (span under-measured after restore) and the NEG sentinel as set
+        # (host_wm = base - 2e9, span inflated).  A None base means the
+        # snapshot predates the first event — nothing to mirror.
+        wm = int(snap.watermark)
+        base = snap.meta["base_time_ms"]
+        self._host_wm = (int(base) + wm
+                         if base is not None and wm > wc.NEG else None)
         self.events_processed = int(snap.meta["events_processed"])
         self.windows_written = int(snap.meta["windows_written"])
         self.started_ms = int(snap.meta["started_ms"])
@@ -1237,10 +1327,24 @@ class AdAnalyticsEngine:
             watermark=jnp.int32(watermark), dropped=jnp.int32(dropped))
 
     # ------------------------------------------------------------------
+    # Bounded shutdown retry: a transient sink outage at close must not
+    # abandon the last flush's rows (the writer's backoff paces attempts;
+    # past this many the outage is treated as permanent and close raises).
+    CLOSE_RETRY_LIMIT = 8
+
     def close(self) -> None:
         """Final flush + fork-style latency dump
-        (``AdvertisingTopologyNative.java:521-532``)."""
+        (``AdvertisingTopologyNative.java:521-532``).  Retries the final
+        writeback up to ``CLOSE_RETRY_LIMIT`` times under the writer's
+        backoff before declaring the rows lost."""
         self.flush(final=True)
+        if self._writer is not None:
+            self._writer.drain()
+            for _ in range(self.CLOSE_RETRY_LIMIT):
+                if not self._writer.has_failed():
+                    break
+                self.flush(final=True)  # reclaims failed rows, resubmits
+                self._writer.drain()
         if self._encode_pool is not None:
             self._encode_pool.close()
             self._encode_pool = None

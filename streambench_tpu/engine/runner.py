@@ -16,7 +16,7 @@ The loop reproduces the operating policies of the reference engines:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from streambench_tpu.checkpoint import Checkpointer
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine
@@ -33,6 +33,10 @@ class RunStats:
     windows_written: int = 0
     started_ms: int = 0
     finished_ms: int = 0
+    # Fault/retry/recovery accounting for THIS run attempt (sink errors,
+    # retries, reconnects, skipped corrupt records, DLQ lines, injected
+    # chaos events...) — non-zero keys only; {} on a clean run.
+    faults: dict = field(default_factory=dict)
 
     @property
     def wall_s(self) -> float:
@@ -55,7 +59,8 @@ class StreamRunner:
                  buffer_timeout_ms: int | None = None,
                  flush_interval_ms: int | None = None,
                  checkpointer: Checkpointer | None = None,
-                 checkpoint_interval_ms: int | None = None):
+                 checkpoint_interval_ms: int | None = None,
+                 crash_points=None):
         cfg = engine.cfg
         self.engine = engine
         self.reader = reader
@@ -77,9 +82,41 @@ class StreamRunner:
             expected_period_ms=max(self.flush_interval_ms, 1))
         self.stats = RunStats()
         self._stop = False
+        # Chaos hook (chaos.CrashScheduler or None): ``point(kind)`` is
+        # called at every batch/flush/checkpoint boundary and may raise a
+        # simulated ``EngineCrash`` there — the documented crash surfaces
+        # the supervised-recovery contract is verified against.  None (the
+        # default) keeps the loop byte-identical to the pre-chaos runner.
+        self.crash_points = crash_points
 
     def stop(self) -> None:
         self._stop = True
+
+    def _chaos_point(self, kind: str) -> None:
+        if self.crash_points is not None:
+            self.crash_points.point(kind)
+
+    def _collect_faults(self) -> None:
+        """Surface fault/retry accounting in ``stats.faults`` (end of a
+        run attempt): engine counters (sink errors/retries/backoff) +
+        encoder reject/DLQ counts + reader corruption/chaos counters."""
+        f: dict[str, int] = dict(self.engine.faults.snapshot())
+
+        def add(key: str, n: int) -> None:
+            if n:
+                f[key] = f.get(key, 0) + n
+
+        enc = getattr(self.engine, "encoder", None)
+        if enc is not None:
+            add("bad_lines", int(getattr(enc, "bad_lines", 0)))
+            add("dlq_lines", int(getattr(enc, "dlq_lines", 0)))
+        add("journal_corrupt_skipped",
+            int(getattr(self.reader, "corrupt_records", 0)))
+        chaos_counts = getattr(self.reader, "fault_counters", None)
+        if chaos_counts is not None:
+            for k, v in chaos_counts.snapshot().items():
+                add(k, v)
+        self.stats.faults = f
 
     def _reader_position(self) -> int | list[int]:
         """Single-partition byte offset, or the per-partition offsets
@@ -107,6 +144,7 @@ class StreamRunner:
     def _checkpoint_now(self, now: float) -> None:
         self.checkpointer.save(self.engine.snapshot(self._reader_position()))
         self._last_ckpt = now
+        self._chaos_point("checkpoint")
 
     def _checkpoint_due(self, now: float) -> bool:
         return (self.checkpointer is not None and
@@ -155,6 +193,7 @@ class StreamRunner:
             pending_n = 0
             pending_since = None
             last_data = time.monotonic()  # processing isn't idleness
+            self._chaos_point("batch")
 
         while not self._stop:
             now = time.monotonic()
@@ -228,6 +267,7 @@ class StreamRunner:
                 st.flushes += 1
                 self.stall_detector.tick(int(time.monotonic() * 1000))
                 last_flush = now
+                self._chaos_point("flush")
                 if self._checkpoint_due(now):
                     self._checkpoint_now(now)
 
@@ -235,9 +275,11 @@ class StreamRunner:
             dispatch()
         st.windows_written += self.engine.flush(final=True)
         st.flushes += 1
+        self._chaos_point("flush")
         if self.checkpointer is not None:
             self._checkpoint_now(time.monotonic())
         st.finished_ms = now_ms()
+        self._collect_faults()
         return st
 
     def run_catchup(self, max_events: int | None = None) -> RunStats:
@@ -268,6 +310,7 @@ class StreamRunner:
                 self.engine.process_chunk(lines)
             st.events += self.engine.events_processed - before
             st.batches += 1
+            self._chaos_point("batch")
             if max_events and st.events >= max_events:
                 break
             now = time.monotonic()
@@ -276,11 +319,14 @@ class StreamRunner:
                 st.flushes += 1
                 self.stall_detector.tick(int(time.monotonic() * 1000))
                 last_flush = now
+                self._chaos_point("flush")
                 if self._checkpoint_due(now):
                     self._checkpoint_now(now)
         st.windows_written += self.engine.flush(final=True)
         st.flushes += 1
+        self._chaos_point("flush")
         if self.checkpointer is not None:
             self._checkpoint_now(time.monotonic())
         st.finished_ms = now_ms()
+        self._collect_faults()
         return st

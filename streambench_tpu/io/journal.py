@@ -97,12 +97,23 @@ class JournalReader:
     """
 
     def __init__(self, path: str, offset: int = 0,
-                 byte_budget: int = 4 * 1024 * 1024):
+                 byte_budget: int = 4 * 1024 * 1024,
+                 skip_corrupt: bool = False):
         self.path = path
         self.offset = offset          # consumed offset (the checkpoint unit)
         self._byte_budget = byte_budget
         self._fh = None
         self._readahead: deque[bytes] = deque()  # parsed but not delivered
+        # Torn-tail recovery: a writer that crashed mid-append can leave a
+        # NUL-padded partial page in the file (filesystems zero-fill the
+        # torn region); once a restarted writer appends past it, the NULs
+        # sit inside a "record" no parser can use.  ``skip_corrupt``
+        # consumes such records (offset still advances — checkpoints stay
+        # byte-exact) without delivering them, counting each in
+        # ``corrupt_records``.  Off by default: silently eating records
+        # is a policy the operator must opt into.
+        self.skip_corrupt = skip_corrupt
+        self.corrupt_records = 0
 
     def seek(self, offset: int) -> None:
         """Reposition to an absolute byte offset (checkpoint restore).
@@ -131,7 +142,21 @@ class JournalReader:
         buffer, so each journal byte is read and split exactly once no
         matter the poll granularity; ``offset`` only advances over
         *delivered* lines, preserving checkpoint/resume exactness.
+
+        In ``skip_corrupt`` mode, records with embedded NUL bytes (a
+        crashed writer's torn page) are consumed-but-not-delivered and
+        counted; the poll may then return fewer lines than available,
+        which every caller already tolerates.
         """
+        out = self._poll_lines(max_records)
+        if self.skip_corrupt and out:
+            kept = [l for l in out if b"\x00" not in l]
+            if len(kept) != len(out):
+                self.corrupt_records += len(out) - len(kept)
+                return kept
+        return out
+
+    def _poll_lines(self, max_records: int) -> list[bytes]:
         out: list[bytes] = []
         ra = self._readahead
         while ra and len(out) < max_records:
@@ -218,6 +243,16 @@ class JournalReader:
             self._fh.seek(self._fh.tell() - tail)
             data = data[:end + 1]
         self.offset += len(data)
+        if self.skip_corrupt and b"\x00" in data:
+            # NUL records never reach the block parser: drop the torn
+            # lines from the returned block (offset already covers the
+            # full read, so checkpoints stay byte-exact).
+            lines = data.split(b"\n")
+            if lines and not lines[-1]:
+                lines.pop()
+            kept = [l for l in lines if b"\x00" not in l]
+            self.corrupt_records += len(lines) - len(kept)
+            data = b"".join(l + b"\n" for l in kept)
         return data
 
     def close(self) -> None:
@@ -343,8 +378,9 @@ class FileBroker:
         return JournalWriter(self.topic_path(topic, partition), append=append)
 
     def reader(self, topic: str, partition: int = 0,
-               offset: int = 0) -> JournalReader:
-        return JournalReader(self.topic_path(topic, partition), offset)
+               offset: int = 0, skip_corrupt: bool = False) -> JournalReader:
+        return JournalReader(self.topic_path(topic, partition), offset,
+                             skip_corrupt=skip_corrupt)
 
     def multi_reader(self, topic: str) -> MultiReader:
         """One consumer over every existing partition of ``topic``."""

@@ -109,7 +109,21 @@ class RespClient:
 
     def __init__(self, host: str = "localhost", port: int = 6379,
                  timeout_s: float | None = 30.0):
+        self._addr = (host, port)
+        self._timeout_s = timeout_s
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = _Reader(self._sock.recv)
+
+    def reconnect(self) -> None:
+        """Drop the socket and dial again (sink-outage recovery: after a
+        half-open connection or a server restart the old socket can hang
+        every command until its timeout; a fresh dial fails fast or
+        works).  Any buffered partial reply dies with the old reader —
+        reusing it would desynchronize the RESP stream."""
+        self.close()
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._reader = _Reader(self._sock.recv)
 

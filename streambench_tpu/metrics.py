@@ -22,10 +22,62 @@ demand).  Pure host-side bookkeeping: tiny dicts, no device work.
 from __future__ import annotations
 
 import logging
+import threading
 from collections import defaultdict
 from typing import Callable
 
 logger = logging.getLogger("streambench.metrics")
+
+
+class FaultCounters:
+    """Thread-safe monotonic counters for fault/retry/recovery events.
+
+    The reference engines have no fault accounting at all — a Redis
+    outage surfaces as a Jedis stack trace and a recount-from-earliest
+    restart (PAPER.md §0).  Here every adverse event is counted so a run
+    can report *how* it survived, not just that it did:
+
+    - ``sink_errors``       — window writebacks that raised (per batch)
+    - ``sink_retries``      — rows re-merged into pending for retry
+    - ``sink_reconnects``   — reconnect attempts after a sink error
+    - ``sink_dirty_high_water`` — retained-rows cap crossings (warned)
+    - ``sink_backoff_ms``   — total writer backoff sleep
+    - ``crashes_injected``  — simulated ``EngineCrash``es raised
+    - ``restarts``          — supervised restarts performed
+    - ``journal_faults``    — injected journal read faults served
+    - ``journal_corrupt_skipped`` — torn/NUL records skipped by a reader
+    - ``dlq_lines``         — malformed lines shunted to the dead-letter
+      journal
+
+    Writers are the Redis flusher thread, the chaos injector, and the
+    supervisor — concurrent by construction, hence the lock.  ``inc`` is
+    a dict add under a lock (~100 ns); nothing here is on the device
+    path.
+    """
+
+    def __init__(self) -> None:
+        self._counts: dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def inc(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] += n
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        """Non-zero counters as a plain dict (RunStats surfacing)."""
+        with self._lock:
+            return {k: v for k, v in self._counts.items() if v}
+
+    def merge(self, other: "dict[str, int] | FaultCounters") -> None:
+        items = (other.snapshot() if isinstance(other, FaultCounters)
+                 else other)
+        with self._lock:
+            for k, v in items.items():
+                self._counts[k] += v
 
 
 class LatencyTracker:

@@ -1,0 +1,148 @@
+"""Chaos layer unit surface: deterministic plans, atomic sink faults,
+loss-free journal damage, scripted crash points.
+
+The injection contracts the recovery harness depends on are pinned here:
+same seed -> same plan; a faulted sink op applies NOTHING; a journal
+fault never loses a byte (damaged records are NUL-marked and rewound);
+an exhausted crash script never raises again.
+"""
+
+import pytest
+
+from streambench_tpu.chaos import (
+    ChaosJournalReader,
+    CrashScheduler,
+    EngineCrash,
+    FaultInjector,
+    FaultPlan,
+)
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import JournalReader, JournalWriter
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.io.resp import RespError
+
+
+def test_fault_plan_seeded_deterministic():
+    a = FaultPlan.generate(42, sink_rate=0.3, sink_ops=50,
+                           journal_rate=0.3, journal_polls=50, crashes=5)
+    b = FaultPlan.generate(42, sink_rate=0.3, sink_ops=50,
+                           journal_rate=0.3, journal_polls=50, crashes=5)
+    assert a == b
+    c = FaultPlan.generate(43, sink_rate=0.3, sink_ops=50,
+                           journal_rate=0.3, journal_polls=50, crashes=5)
+    assert a != c
+    assert FaultPlan.zeros().is_zero and not a.is_zero
+
+
+def test_sink_faults_are_atomic_and_scheduled():
+    """A faulted op raises the mapped error and forwards nothing; clean
+    ops pass through untouched."""
+    store = FakeRedisStore()
+    plan = FaultPlan(sink_faults={0: "refused", 2: "timeout", 3: "resp"})
+    proxy = FaultInjector(plan).wrap_redis(as_redis(store))
+    with pytest.raises(ConnectionRefusedError):
+        proxy.execute("SET", "k", "v")
+    assert store.get("k") is None            # nothing applied
+    assert proxy.execute("SET", "k", "v") == "OK"   # op 1: clean
+    with pytest.raises(TimeoutError):
+        proxy.pipeline_execute([("SET", "k", "w")])
+    with pytest.raises(RespError):
+        proxy.execute("GET", "k")
+    assert store.get("k") == "v"             # only the clean op landed
+    assert proxy.execute("GET", "k") == "v"
+
+
+def test_sink_proxy_hides_native_store_probe():
+    """The engine's ``redis._store`` probe must miss, or flushes would
+    bypass the faultable path through the in-C bulk writeback."""
+    proxy = FaultInjector(FaultPlan.zeros()).wrap_redis(
+        as_redis(FakeRedisStore()))
+    assert getattr(proxy, "_store", None) is None
+
+
+def _write_topic(tmp_path, n=50):
+    path = str(tmp_path / "t.jsonl")
+    lines = [f'{{"rec": {i}, "pad": "{"x" * 40}"}}'.encode()
+             for i in range(n)]
+    with JournalWriter(path) as w:
+        w.append_many(lines)
+    return path, lines
+
+
+@pytest.mark.parametrize("kind", ["truncated", "torn", "corrupt"])
+def test_journal_faults_lose_nothing(tmp_path, kind):
+    """Reading the whole topic through a faulting wrapper yields every
+    original record exactly once; injected damage is NUL-marked garbage
+    that can never parse as an event."""
+    path, lines = _write_topic(tmp_path)
+    plan = FaultPlan(journal_faults={0: kind, 2: kind, 3: kind})
+    inj = FaultInjector(plan)
+    r = inj.wrap_reader(JournalReader(path))
+    got, garbage = [], []
+    for _ in range(100):
+        batch = r.poll(8)
+        if not batch and r.offset == len(b"".join(l + b"\n" for l in lines)):
+            break
+        for line in batch:
+            (garbage if b"\x00" in line else got).append(line)
+    assert got == lines                      # every record, once, in order
+    assert inj.counters.get("journal_faults") == 3
+    if kind != "truncated":
+        assert garbage                       # damage was actually delivered
+    assert all(b"\x00" in g for g in garbage)
+
+
+@pytest.mark.parametrize("kind", ["truncated", "torn", "corrupt"])
+def test_journal_faults_block_mode_lose_nothing(tmp_path, kind):
+    path, lines = _write_topic(tmp_path)
+    inj = FaultInjector(FaultPlan(journal_faults={0: kind, 1: kind}))
+    r = inj.wrap_reader(JournalReader(path))
+    got, garbage = [], []
+    while True:
+        data = r.poll_block(512)
+        if not data:
+            break
+        for line in data.split(b"\n"):
+            if line:
+                (garbage if b"\x00" in line else got).append(line)
+    assert got == lines
+    assert all(b"\x00" in g for g in garbage)
+
+
+def test_zero_plan_wrappers_are_passthrough(tmp_path):
+    path, lines = _write_topic(tmp_path, n=10)
+    inj = FaultInjector(FaultPlan.zeros())
+    r = inj.wrap_reader(JournalReader(path))
+    assert r.poll(100) == lines
+    assert inj.counters.snapshot() == {}
+    store = FakeRedisStore()
+    proxy = inj.wrap_redis(as_redis(store))
+    assert proxy.execute("SET", "a", "1") == "OK"
+    assert store.get("a") == "1"
+
+
+def test_crash_scheduler_script_and_reset():
+    sched = CrashScheduler([("batch", 2), ("flush", 1)])
+    sched.point("batch")                     # batch #1: armed at #2
+    with pytest.raises(EngineCrash):
+        sched.point("batch")
+    assert sched.remaining == 1
+    sched.reset()                            # restart: counts restart
+    sched.point("batch")                     # not a flush: no crash
+    with pytest.raises(EngineCrash):
+        sched.point("flush")
+    assert sched.exhausted
+    for _ in range(5):                       # exhausted: never raises again
+        sched.point("batch")
+        sched.point("flush")
+    assert sched.counters.get("crashes_injected") == 2
+
+
+def test_wrap_reader_rejects_multireader(tmp_path):
+    from streambench_tpu.io.journal import FileBroker, MultiReader
+
+    broker = FileBroker(str(tmp_path / "b"))
+    broker.create_topic("t", partitions=2)
+    with pytest.raises(TypeError):
+        FaultInjector(FaultPlan.zeros()).wrap_reader(
+            MultiReader([broker.reader("t", 0), broker.reader("t", 1)]))

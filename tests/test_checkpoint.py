@@ -192,3 +192,28 @@ def test_snapshot_mid_deferral_carries_parked_cycle(tmp_path, monkeypatch):
     ref.process_chunk(lines)
     ref.close()
     assert read_seen_counts(r) == read_seen_counts(r2)
+
+
+def test_restore_watermark_sentinel_states(tmp_path):
+    """_restore_host must gate the host watermark mirror on the NEG
+    sentinel, not truthiness (ADVICE.md): a legitimate relative
+    watermark of 0 is SET (host_wm = base), the NEG 'no events' value
+    and a pre-first-event base are UNSET (None)."""
+    from streambench_tpu.ops import windowcount as wc
+
+    cfg, r, broker, mapping = setup_run(tmp_path, events=100, batch=64)
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    base = 1_000_000
+
+    def restored(watermark, base_time_ms=base):
+        snap = eng.snapshot(0)
+        snap.watermark = watermark
+        snap.meta["base_time_ms"] = base_time_ms
+        dst = AdAnalyticsEngine(cfg, mapping, redis=r)
+        dst.restore(snap)
+        return dst._host_wm
+
+    assert restored(0) == base              # legit zero watermark: SET
+    assert restored(12_345) == base + 12_345
+    assert restored(wc.NEG) is None         # 'no events' sentinel: unset
+    assert restored(0, base_time_ms=None) is None  # pre-first-event snap

@@ -1,0 +1,225 @@
+"""Supervisor behavior: seeded randomized crash sweeps + give-up policy.
+
+The property (ISSUE 1, satellite): kill the runner at randomized
+batch/flush/checkpoint boundaries and the oracle bounds of
+``chaos.verify`` hold EVERY time.  A fast seed subset runs in tier-1;
+the full >= 20-seed sweep is ``slow``/``chaos``-marked.
+"""
+
+import random
+
+import pytest
+
+from streambench_tpu.chaos import (
+    FaultInjector,
+    FaultPlan,
+    Supervisor,
+    check_at_least_once,
+)
+from streambench_tpu.chaos.plan import EngineCrash
+from streambench_tpu.checkpoint import Checkpointer
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    """One journaled topic shared by every seed (events are immutable;
+    each seed gets its own Redis + checkpoint dir)."""
+    tmp = tmp_path_factory.mktemp("sup")
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2,
+                         jax_sink_retry_base_ms=1, jax_sink_retry_cap_ms=4)
+    broker = FileBroker(str(tmp / "broker"))
+    gen.do_setup(None, cfg, broker=broker, events_num=6_000,
+                 rng=random.Random(11), workdir=str(tmp))
+    mapping = gen.load_ad_mapping_file(str(tmp / gen.AD_TO_CAMPAIGN_FILE))
+    campaigns, _ = gen.load_ids(str(tmp))
+    return tmp, cfg, broker, mapping, campaigns
+
+
+def crash_sweep_seed(dataset, tmp_path, seed: int) -> None:
+    """One randomized supervised run; asserts the oracle bounds."""
+    tmp, cfg, broker, mapping, campaigns = dataset
+    rng = random.Random(seed)
+    # randomized crash script over all three boundary kinds; batch
+    # ordinals spread across the ~12 boundaries a 6k-event catchup has,
+    # flush/checkpoint pinned to their reachable ordinals
+    crashes = []
+    for _ in range(rng.randrange(1, 5)):
+        kind = rng.choice(("batch", "batch", "flush", "checkpoint"))
+        n = rng.randrange(1, 9) if kind == "batch" else 1
+        crashes.append((kind, n))
+    plan = FaultPlan(seed=seed, crashes=tuple(crashes),
+                     sink_faults={i: "refused"
+                                  for i in range(rng.randrange(0, 4))})
+    inj = FaultInjector(plan)
+    from streambench_tpu.io.redis_schema import seed_campaigns
+
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, campaigns)
+    ckpt = Checkpointer(str(tmp_path / f"ckpt-{seed}"))
+
+    def make_runner():
+        eng = AdAnalyticsEngine(cfg, mapping, redis=inj.wrap_redis(r))
+        reader = inj.wrap_reader(broker.reader(cfg.kafka_topic))
+        return StreamRunner(eng, reader, checkpointer=ckpt,
+                            crash_points=inj.scheduler)
+
+    # the give-up ceiling must exceed the crash-script length: a script
+    # whose every crash lands before the first checkpoint makes zero
+    # DURABLE progress by design, and the sweep asserts recovery, not
+    # the give-up policy (tested separately below)
+    sup = Supervisor(make_runner, backoff_base_ms=1, backoff_cap_ms=2,
+                     seed=seed, max_no_progress_restarts=len(crashes) + 1)
+    st = sup.run(catchup=True)
+    assert st.completed and not st.gave_up, (seed, st.errors)
+    sup.runner.engine.close()
+    v = check_at_least_once(r, str(tmp), broker.topic_path(cfg.kafka_topic),
+                            st.replay_segments, st.carried)
+    assert v.ok, (seed, v.summary(), v.undercounts[:3], v.overcounts[:3])
+    assert sup.runner.engine.events_processed == 6_000, seed
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_randomized_crash_boundaries_fast(dataset, tmp_path, seed):
+    crash_sweep_seed(dataset, tmp_path, seed)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(4, 24))
+def test_randomized_crash_boundaries_sweep(dataset, tmp_path, seed):
+    crash_sweep_seed(dataset, tmp_path, seed)
+
+
+def test_supervisor_gives_up_after_no_progress(tmp_path):
+    """A crash loop that never advances the checkpoint must end in a
+    clean give-up after exactly N consecutive no-progress restarts —
+    never an infinite restart spin."""
+    calls = {"n": 0}
+
+    class _Runner:
+        crash_points = None
+
+        def resume(self):
+            return False
+
+        def _reader_position(self):
+            return 0            # never advances
+
+        def run_catchup(self, **kw):
+            calls["n"] += 1
+            raise EngineCrash("wedged at the same offset")
+
+        def run(self, **kw):
+            return self.run_catchup(**kw)
+
+    slept = []
+    sup = Supervisor(lambda: _Runner(), max_no_progress_restarts=3,
+                     backoff_base_ms=8, backoff_cap_ms=32, seed=0,
+                     sleep=slept.append)
+    st = sup.run(catchup=True)
+    assert st.gave_up and not st.completed
+    # first crash sets the baseline; 3 more at the same offset give up
+    assert calls["n"] == 4 and st.crashes == 4 and st.restarts == 3
+    # capped exponential backoff with jitter: nondecreasing cap, bounded
+    assert len(slept) == 3
+    assert all(0.004 <= s <= 0.032 for s in slept)
+
+
+def test_supervisor_progress_resets_giveup_counter(tmp_path):
+    """Crashes whose checkpoint ADVANCED reset the no-progress streak: a
+    slowly-progressing stream is never declared wedged — even when every
+    single attempt ends in a crash."""
+    class _Ckpt:
+        def __init__(self):
+            self.offset = 0
+
+        def load(self):
+            class _Snap:
+                pass
+            s = _Snap()
+            s.offset = self.offset
+            return s if self.offset else None
+
+    ckpt = _Ckpt()
+    seq = iter([10, 20, 30, 40])
+
+    class _Runner:
+        crash_points = None
+        checkpointer = ckpt
+
+        def resume(self):
+            return False
+
+        def _reader_position(self):
+            return ckpt.offset
+
+        def run_catchup(self, **kw):
+            # each attempt saves a further checkpoint, then crashes
+            ckpt.offset = next(seq)
+            raise EngineCrash("crash with progress")
+
+    made = {"n": 0}
+
+    def factory():
+        made["n"] += 1
+        r = _Runner()
+        if made["n"] == 5:                       # attempt 5 completes
+            r.run_catchup = lambda **kw: "done"
+        return r
+
+    sup = Supervisor(factory, max_no_progress_restarts=2,
+                     backoff_base_ms=0, backoff_cap_ms=0, seed=0)
+    st = sup.run(catchup=True)
+    assert st.completed and not st.gave_up
+    assert st.crashes == 4 and st.restarts == 4
+
+
+def test_supervisor_counts_checkpoint_then_crash_as_progress(tmp_path):
+    """A crash injected AT the checkpoint boundary (snapshot saved, then
+    EngineCrash) is durable progress at THAT crash — the give-up counter
+    must reset immediately, not one restart later (the seed-1234
+    acceptance scenario: three no-checkpoint crashes followed by a
+    checkpoint-boundary crash must not give up)."""
+    class _Ckpt:
+        offset = 0
+
+        def load(self):
+            if not self.offset:
+                return None
+            class _S:
+                offset = self.offset
+            return _S()
+
+    ckpt = _Ckpt()
+    attempt = {"n": 0}
+
+    class _Runner:
+        crash_points = None
+        checkpointer = ckpt
+
+        def resume(self):
+            return False
+
+        def _reader_position(self):
+            return ckpt.offset
+
+        def run_catchup(self, **kw):
+            attempt["n"] += 1
+            if attempt["n"] <= 3:
+                raise EngineCrash("before any checkpoint")
+            if attempt["n"] == 4:
+                ckpt.offset = 999           # saved, THEN crashed
+                raise EngineCrash("at the checkpoint boundary")
+            return "done"
+
+    sup = Supervisor(lambda: _Runner(), max_no_progress_restarts=3,
+                     backoff_base_ms=0, backoff_cap_ms=0, seed=0)
+    st = sup.run(catchup=True)
+    assert st.completed and not st.gave_up
+    assert st.crashes == 4

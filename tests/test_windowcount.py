@@ -314,3 +314,32 @@ def test_flush_deltas_rows_compact_matches_rows():
     assert not np.asarray(new_state.counts).any()
     # the gathered fallback block carries the real rows in order
     assert np.array_equal(np.asarray(sub)[:touched.size], counts[touched])
+
+
+def test_pack_columns_rejects_out_of_domain():
+    """pack_columns is public: an ad_idx outside [0, PACK_AD_MAX) or an
+    event_type outside {-1..2} must error instead of silently bleeding
+    into the neighboring bit fields (ADVICE.md)."""
+    import pytest
+
+    ok_ad = np.array([0, 5, wc.PACK_AD_MAX - 1], np.int32)
+    ok_et = np.array([-1, 0, 2], np.int32)
+    valid = np.array([True, True, False])
+    packed = wc.pack_columns(ok_ad, ok_et, valid)
+    import jax.numpy as jnp
+    ad, et, v = (np.asarray(x)
+                 for x in wc.unpack_columns(jnp.asarray(packed)))
+    assert np.array_equal(ad, ok_ad) and np.array_equal(et, ok_et)
+    assert np.array_equal(v, valid)
+
+    for bad_ad in (np.array([-1, 0, 0], np.int32),
+                   np.array([0, wc.PACK_AD_MAX, 0], np.int32)):
+        with pytest.raises(ValueError, match="ad_idx"):
+            wc.pack_columns(bad_ad, ok_et, valid)
+    for bad_et in (np.array([-2, 0, 0], np.int32),
+                   np.array([0, 3, 0], np.int32)):
+        with pytest.raises(ValueError, match="event_type"):
+            wc.pack_columns(ok_ad, bad_et, valid)
+    # empty batches skip the reductions entirely
+    assert wc.pack_columns(np.empty(0, np.int32), np.empty(0, np.int32),
+                           np.empty(0, bool)).size == 0
