@@ -1102,6 +1102,15 @@ def main() -> int:
             shutil.rmtree(trace_dir, ignore_errors=True)
         from streambench_tpu.trace import device_trace
 
+        # Opt-in telemetry journal per catchup rep (obs/): set
+        # STREAMBENCH_BENCH_METRICS_DIR to record each rep's live
+        # throughput/backlog/latency time series for later
+        # `python -m streambench_tpu.obs report|diff` reading — the
+        # before/after evidence channel for perf PRs.
+        metrics_dir = os.environ.get("STREAMBENCH_BENCH_METRICS_DIR")
+        if metrics_dir:
+            os.makedirs(metrics_dir, exist_ok=True)
+
         best = None  # (value, stats, engine, store, total_s)
         trace_occ = None
         rep_cost_s = 0.0
@@ -1119,7 +1128,28 @@ def main() -> int:
             seed_campaigns(r_rep, sorted(set(mapping.values())))
             engine = AdAnalyticsEngine(cfg, mapping, redis=r_rep,
                                        method=method)
-            runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+            rep_reader = broker.reader(cfg.kafka_topic)
+            runner = StreamRunner(engine, rep_reader)
+            obs_sampler = None
+            if metrics_dir:
+                from streambench_tpu.obs import (
+                    MetricsRegistry,
+                    MetricsSampler,
+                    engine_collector,
+                )
+
+                obs_reg = MetricsRegistry()
+                engine.attach_obs(obs_reg)
+                obs_sampler = MetricsSampler(
+                    os.path.join(metrics_dir,
+                                 f"bench-metrics-rep{rep + 1}.jsonl"),
+                    interval_ms=int(os.environ.get(
+                        "STREAMBENCH_BENCH_METRICS_INTERVAL_MS", "500")),
+                    registry=obs_reg)
+                obs_sampler.add_collector(engine_collector(
+                    engine, reader=rep_reader, runner=runner,
+                    registry=obs_reg))
+                obs_sampler.start()
             # The measured interval covers ingest + device folds + the
             # FULL canonical Redis writeback (engine.close drains the
             # async writer): stopping the clock at run_catchup() would
@@ -1131,6 +1161,12 @@ def main() -> int:
                 engine.close()
             total_s = max(time.monotonic() - t0, 1e-9)
             v = stats.events / total_s
+            if obs_sampler is not None:
+                obs_sampler.close(final={
+                    "events": stats.events, "wall_s": round(total_s, 2),
+                    "events_per_s": round(v, 1),
+                    "windows_written": stats.windows_written,
+                    "faults": stats.faults})
             log(f"catchup rep {rep + 1}/{reps}: {stats.events} events in "
                 f"{total_s:.2f}s (ingest {stats.wall_s:.2f}s) = "
                 f"{v:,.0f} ev/s; windows={stats.windows_written} "

@@ -82,8 +82,15 @@ class Supervisor:
                  seed: int = 0,
                  catch: tuple = (EngineCrash, ConnectionError, TimeoutError),
                  sleep=time.sleep,
-                 counters: FaultCounters | None = None):
+                 counters: FaultCounters | None = None,
+                 sampler=None):
         self.make_runner = make_runner
+        # Telemetry annotation hook (obs.MetricsSampler or anything with
+        # ``annotate(event, **fields)``): crash/restart/give-up events
+        # land in the metrics.jsonl stream between snapshots, so a
+        # supervised run's time series shows WHEN each restart happened
+        # against the throughput/backlog curves, not just how many.
+        self.sampler = sampler
         self.max_no_progress_restarts = max(int(max_no_progress_restarts), 1)
         self.backoff_base_ms = max(float(backoff_base_ms), 0.0)
         self.backoff_cap_ms = max(float(backoff_cap_ms), self.backoff_base_ms)
@@ -163,6 +170,10 @@ class Supervisor:
                 st.crashes += 1
                 st.errors.append(repr(e))
                 prev_crash_offset = runner._reader_position()
+                if self.sampler is not None:
+                    self.sampler.annotate(
+                        "crash", attempt=st.attempts, error=repr(e),
+                        crash_offset=prev_crash_offset)
                 # DURABLE progress only: the checkpoint the next attempt
                 # will resume from.  Work a crashed attempt did but never
                 # snapshotted is not progress — counting it would let a
@@ -177,11 +188,20 @@ class Supervisor:
                 last_durable_progress = progress
                 if no_progress >= self.max_no_progress_restarts:
                     st.gave_up = True
+                    if self.sampler is not None:
+                        self.sampler.annotate(
+                            "give_up", attempts=st.attempts,
+                            crashes=st.crashes, no_progress=no_progress)
                     return st
                 consecutive_crashes += 1
                 back = self._backoff(consecutive_crashes)
                 st.backoff_ms_total += back
                 st.restarts += 1
                 self.counters.inc("restarts")
+                if self.sampler is not None:
+                    self.sampler.annotate(
+                        "restart", restarts=st.restarts,
+                        backoff_ms=round(back, 1),
+                        durable_progress=progress)
                 if back > 0:
                     self._sleep(back / 1000.0)

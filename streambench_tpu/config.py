@@ -121,6 +121,13 @@ class BenchmarkConfig:
     jax_deadletter_enabled: bool = False   # journal malformed events to a
     #   <topic>-deadletter topic instead of only counting them (bad_lines);
     #   off by default: the reference drops bad tuples silently
+    # --- live telemetry (obs/; default-off: the hot path must stay
+    # byte-identical when observability is not asked for) ---
+    jax_metrics_interval_ms: int = 0       # >0 starts the MetricsSampler at
+    #   this cadence, journaling snapshot records to <workdir>/metrics.jsonl
+    jax_metrics_port: int = -1             # >=0 serves a localhost Prometheus
+    #   text-exposition endpoint (0 = OS-assigned ephemeral port, printed
+    #   at startup); <0 = no endpoint
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -225,6 +232,8 @@ class BenchmarkConfig:
             jax_supervisor_backoff_cap_ms=geti(
                 "jax.supervisor.backoff.cap.ms", 2000),
             jax_deadletter_enabled=getb("jax.deadletter.enabled", False),
+            jax_metrics_interval_ms=geti("jax.metrics.interval.ms", 0),
+            jax_metrics_port=geti("jax.metrics.port", -1),
             raw=dict(conf),
         )
 

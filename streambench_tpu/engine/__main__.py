@@ -199,6 +199,40 @@ def main(argv: list[str] | None = None) -> int:
     warm.warmup()
     warm.close()
     del warm
+
+    # Live telemetry (obs/, default-off): jax.metrics.interval.ms > 0
+    # starts the sampler journaling snapshots to <workdir>/metrics.jsonl;
+    # jax.metrics.port >= 0 serves the localhost Prometheus endpoint
+    # (0 = ephemeral, the chosen port is printed below so harnesses and
+    # the smoke test can scrape without a race).
+    sampler = metrics_server = None
+    if cfg.jax_metrics_interval_ms > 0 or cfg.jax_metrics_port >= 0:
+        from streambench_tpu.obs import (
+            MetricsRegistry,
+            MetricsSampler,
+            MetricsServer,
+            engine_collector,
+        )
+
+        registry = MetricsRegistry()
+        engine.attach_obs(registry)
+        metrics_path = os.path.join(args.workdir, "metrics.jsonl")
+        sampler = MetricsSampler(
+            metrics_path,
+            interval_ms=cfg.jax_metrics_interval_ms or 1000,
+            registry=registry)
+        sampler.add_collector(engine_collector(
+            engine, reader=reader, runner=runner, registry=registry))
+        sampler.start()
+        endpoint = ""
+        if cfg.jax_metrics_port >= 0:
+            metrics_server = MetricsServer(registry,
+                                           port=cfg.jax_metrics_port,
+                                           refresh=sampler.collect_now)
+            endpoint = f" endpoint={metrics_server.url}"
+        print(f"metrics: interval={sampler.interval_ms}ms "
+              f"jsonl={metrics_path}{endpoint}", flush=True)
+
     print(f"engine up: topic={cfg.kafka_topic} redis={cfg.redis_host}:"
           f"{cfg.redis_port} batch={engine.batch_size}", flush=True)
 
@@ -220,13 +254,21 @@ def main(argv: list[str] | None = None) -> int:
     if runner.stall_detector.stalls:
         print(f"flush stalls: {runner.stall_detector.stalls}",
               file=sys.stderr, flush=True)
-    print(json.dumps({
+    stats_line = {
         "events": stats.events, "batches": stats.batches,
         "windows_written": stats.windows_written,
         "events_per_s": round(stats.events_per_s, 1),
         "dropped": engine.dropped, "wall_s": round(stats.wall_s, 2),
         "faults": stats.faults,
-    }), flush=True)
+    }
+    if sampler is not None:
+        # final telemetry record AFTER close(): the writer has drained,
+        # so the record's cumulative counters and the run_stats it
+        # carries agree with the JSON line below record-for-record
+        sampler.close(final=stats_line)
+    if metrics_server is not None:
+        metrics_server.close()
+    print(json.dumps(stats_line), flush=True)
     return 0
 
 

@@ -228,6 +228,12 @@ class _RedisWriter:
         with self._lock:
             return bool(self._failed)
 
+    def dirty_rows(self) -> int:
+        """Retained failed-write rows awaiting reclaim (telemetry: the
+        sink-health gauge — nonzero means the sink is/was down)."""
+        with self._lock:
+            return self._failed_rows
+
     def take_failed(self) -> list[list]:
         """Hand back batches whose write failed (clears the retention).
         The engine re-merges them into ``_pending`` so the next flush
@@ -394,6 +400,10 @@ class AdAnalyticsEngine:
         # fault/retry/recovery accounting (ROBUSTNESS.md): shared with the
         # writer thread; surfaced via RunStats.faults at end of run
         self.faults = FaultCounters()
+        # live telemetry (obs/): None until attach_obs — the default
+        # engine pays nothing for the observability layer beyond this
+        # attribute and one None check per flush writeback
+        self._obs_hist = None
         self._writer: _RedisWriter | None = None
         # Parallel encode pool (multi-core hosts): per-thread encoders,
         # sound only for engines whose kernel never reads the interned
@@ -1168,11 +1178,18 @@ class AdAnalyticsEngine:
     def _note_written(self, payload, stamp: int) -> None:
         """Latency + write-count bookkeeping at actual write time (writer
         thread) — counting at submit time would double-count rows that
-        fail, get reclaimed, and are retried."""
+        fail, get reclaimed, and are retried.  When telemetry is
+        attached, each unique window's writeback latency also lands in
+        the live log-bucketed histogram (O(1) per window, writer-thread
+        cadence — never the host loop)."""
         if isinstance(payload, _ArrayRows):
             self.windows_written += len(payload)
-            for t in np.unique(payload.ts).tolist():
-                self.window_latency[int(t)] = stamp - int(t)
+            uniq = [int(t) for t in np.unique(payload.ts).tolist()]
+            for t in uniq:
+                self.window_latency[t] = stamp - t
+            if self._obs_hist is not None:
+                for t in uniq:
+                    self._obs_hist.observe(stamp - t)
             self.latency_tracker.record_bulk(
                 payload.ci, payload.ts, stamp, payload.campaigns)
             return
@@ -1180,6 +1197,9 @@ class AdAnalyticsEngine:
         for camp, ts, _ in payload:
             self.window_latency[ts] = stamp - ts
             self.latency_tracker.record(camp, ts, stamp)
+        if self._obs_hist is not None:
+            for ts in {ts for _, ts, _ in payload}:
+                self._obs_hist.observe(stamp - ts)
 
     def _reclaim_failed_writes(self) -> None:
         """Fold failed writeback batches back into ``_pending`` so the
@@ -1196,6 +1216,41 @@ class AdAnalyticsEngine:
                     self._pending.setdefault((idx[camp], ts), n)
                 else:
                     self._pending[(idx[camp], ts)] += n
+
+    # ------------------------------------------------------------------
+    # live telemetry (obs/): both hooks are pull-oriented — the sampler
+    # thread polls host-side bookkeeping; the only pushed signal is the
+    # writeback-latency histogram fed from the writer thread.
+    def attach_obs(self, registry) -> None:
+        """Opt into live telemetry: register the window-latency streaming
+        histogram on ``registry`` (obs.MetricsRegistry) so p50/p95/p99
+        writeback latency is queryable *during* the run — the live
+        complement of the exact close-time decile table.  Never called
+        on the default path; everything else the sampler needs it pulls
+        via ``telemetry()``."""
+        self._obs_hist = registry.histogram(
+            "streambench_window_latency_ms",
+            "window writeback latency (time_updated - window_ts), ms")
+
+    def telemetry(self) -> dict:
+        """Point-in-time observability snapshot of host bookkeeping.
+        Plain field reads + one wall-clock call: no device sync, no
+        drain, safe from the sampler thread at any cadence."""
+        wm = self._host_wm
+        writer = self._writer
+        return {
+            "events": self.events_processed,
+            "windows_written": self.windows_written,
+            "watermark_lag_ms": (now_ms() - wm) if wm is not None else None,
+            "sink_dirty_rows": (writer.dirty_rows()
+                                if writer is not None else 0),
+            # parked/pending flush backlog (dict rows + drained triples);
+            # tuple() snapshots the list atomically under the GIL so the
+            # host loop can append/clear concurrently
+            "pending_rows": (len(self._pending)
+                             + sum(int(t[0].shape[0])
+                                   for t in tuple(self._pending_np))),
+        }
 
     def drain_writes(self) -> None:
         """Block until every queued Redis writeback has landed.  The sync

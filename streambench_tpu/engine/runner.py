@@ -78,8 +78,12 @@ class StreamRunner:
         self._last_ckpt = time.monotonic()
         # Backpressure canary: warn when the flush cadence slips to >2x its
         # period (the Apex stall warning, ProcessTimeAwareStore.java:84-87).
+        # Stalls route into the engine's FaultCounters ("flush_stalls") so
+        # they surface in RunStats.faults and the telemetry stream next to
+        # the sink/chaos counters, not just on stderr.
         self.stall_detector = StallDetector(
-            expected_period_ms=max(self.flush_interval_ms, 1))
+            expected_period_ms=max(self.flush_interval_ms, 1),
+            counters=engine.faults)
         self.stats = RunStats()
         self._stop = False
         # Chaos hook (chaos.CrashScheduler or None): ``point(kind)`` is

@@ -115,6 +115,16 @@ class JournalReader:
         self.skip_corrupt = skip_corrupt
         self.corrupt_records = 0
 
+    def backlog_bytes(self) -> int:
+        """Bytes appended to the topic but not yet delivered (telemetry:
+        the consumer-lag gauge).  A stat + subtraction — safe to call
+        from the sampler thread at any cadence."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return 0
+        return max(size - self.offset, 0)
+
     def seek(self, offset: int) -> None:
         """Reposition to an absolute byte offset (checkpoint restore).
 
@@ -291,6 +301,10 @@ class MultiReader:
     @property
     def offsets(self) -> list[int]:
         return [r.offset for r in self._readers]
+
+    def backlog_bytes(self) -> int:
+        """Total undelivered bytes across all partitions (telemetry)."""
+        return sum(r.backlog_bytes() for r in self._readers)
 
     def seek_offsets(self, offsets: list[int]) -> None:
         if len(offsets) != len(self._readers):

@@ -48,6 +48,8 @@ class FaultCounters:
     - ``journal_corrupt_skipped`` — torn/NUL records skipped by a reader
     - ``dlq_lines``         — malformed lines shunted to the dead-letter
       journal
+    - ``flush_stalls``      — flush-cadence gaps past the stall threshold
+      (``StallDetector`` with ``counters`` wired)
 
     Writers are the Redis flusher thread, the chaos injector, and the
     supervisor — concurrent by construction, hence the lock.  ``inc`` is
@@ -148,15 +150,22 @@ class LatencyTracker:
 
 def decile_table(latencies: list[int]) -> list[tuple[str, int]]:
     """10 equal-count groups; each row is the group's upper-bound latency
-    (``outputGroupByCount``: row i = sorted[step*(i+1)], last = max)."""
+    (``outputGroupByCount``: row i = sorted[step*(i+1)], last = max).
+
+    The index is proportional (``n * (i+1) // 10``), not the reference's
+    integer ``step = n // 10`` multiple: below 10 samples the truncated
+    step is 0 and every row would repeat ``sorted[0]``.  Proportional
+    indices are identical when n divides evenly by 10, drift by at most
+    the truncation remainder otherwise, and spread small samples across
+    the order statistics instead of collapsing them.
+    """
     if not latencies:
         return []
     groups = 10
     n = len(latencies)
-    step = n // groups
     rows: list[tuple[str, int]] = []
     for i in range(groups - 1):
-        idx = min(step * (i + 1), n - 1)
+        idx = min(n * (i + 1) // groups, n - 1)
         rows.append((f"{i * 100 // groups} - {(i + 1) * 100 // groups}",
                      int(latencies[idx])))
     rows.append((f"{(groups - 1) * 100 // groups} - 100", int(latencies[-1])))
@@ -169,15 +178,29 @@ class StallDetector:
     The reference warns on an end-window gap over 2x the streaming window
     (``ProcessTimeAwareStore.java:84-87``).  ``tick()`` is called once per
     flush; returns the gap in ms when it stalled, else None.
+
+    When ``counters`` is given, every stall also bumps its
+    ``flush_stalls`` key — routing stalls into the engine's
+    ``FaultCounters`` so they surface in ``RunStats.faults`` and the
+    telemetry stream next to the sink/chaos counters, not only in a
+    log line and this object's own attribute.
     """
 
     def __init__(self, expected_period_ms: int,
                  factor: float = 2.0,
-                 warn: Callable[[str], None] | None = None):
+                 warn: Callable[[str], None] | None = None,
+                 counters: "FaultCounters | None" = None):
         self.threshold_ms = expected_period_ms * factor
         self._warn = warn or logger.warning
+        self._counters = counters
         self._last_ms: int | None = None
         self.stalls = 0
+
+    def reset(self) -> None:
+        """Drop the cadence baseline (engine restart / resumed run): the
+        next tick establishes a fresh one instead of billing the
+        downtime as a stall."""
+        self._last_ms = None
 
     def tick(self, now_ms: int) -> int | None:
         gap = None
@@ -186,6 +209,8 @@ class StallDetector:
             if period > self.threshold_ms:
                 gap = period
                 self.stalls += 1
+                if self._counters is not None:
+                    self._counters.inc("flush_stalls")
                 self._warn(
                     f"unexpected long flush period: {period} ms "
                     f"(threshold {self.threshold_ms:.0f} ms)")

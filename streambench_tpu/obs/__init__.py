@@ -1,0 +1,39 @@
+"""Live telemetry: metrics registry, sampler thread, scrape endpoint.
+
+The observability substrate for the engine (ISSUE: the run's flight
+recorder).  Four pieces, all stdlib, all default-off:
+
+- ``registry``  — counters/gauges + O(1) log-bucketed streaming
+  histograms (live p50/p95/p99 while the run is going)
+- ``sampler``   — background thread journaling one snapshot record per
+  ``jax.metrics.interval.ms`` to ``metrics.jsonl`` in the workdir
+- ``httpd``     — localhost Prometheus text-exposition endpoint
+  (``jax.metrics.port``)
+- ``report``    — ``python -m streambench_tpu.obs`` renders a run
+  report from ``metrics.jsonl`` and diffs two runs
+
+Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
+> 0 and/or ``jax.metrics.port`` >= 0); embed via::
+
+    registry = MetricsRegistry()
+    engine.attach_obs(registry)
+    sampler = MetricsSampler(path, interval_ms=1000, registry=registry)
+    sampler.add_collector(engine_collector(engine, reader=reader,
+                                           runner=runner,
+                                           registry=registry))
+    sampler.start()
+    server = MetricsServer(registry, port=0, refresh=sampler.collect_now)
+"""
+
+from streambench_tpu.obs.httpd import MetricsServer  # noqa: F401
+from streambench_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    StreamingHistogram,
+)
+from streambench_tpu.obs.sampler import (  # noqa: F401
+    MetricsSampler,
+    engine_collector,
+    rss_bytes,
+)

@@ -1,0 +1,60 @@
+"""Telemetry reporting CLI.
+
+    python -m streambench_tpu.obs report RUN/metrics.jsonl
+    python -m streambench_tpu.obs diff  A/metrics.jsonl B/metrics.jsonl
+
+``report`` renders one run's time series as a summary (throughput,
+live-latency percentiles, backlog/watermark/RSS maxima, fault counters,
+stage totals, annotations); ``diff`` lines two runs up with absolute and
+relative deltas.  ``--json`` emits the summary dict(s) instead, for
+harness consumption.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from streambench_tpu.obs.report import (
+    load_records,
+    render_diff,
+    render_report,
+    summarize,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="streambench-obs")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="summarize one metrics.jsonl")
+    rep.add_argument("path")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the summary dict instead of text")
+    dif = sub.add_parser("diff", help="diff two metrics.jsonl runs (B vs A)")
+    dif.add_argument("path_a")
+    dif.add_argument("path_b")
+    dif.add_argument("--json", action="store_true",
+                     help="emit both summary dicts instead of text")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.cmd == "report":
+            s = summarize(load_records(args.path), path=args.path)
+            print(json.dumps(s) if args.json else render_report(s))
+        else:
+            a = summarize(load_records(args.path_a), path=args.path_a)
+            b = summarize(load_records(args.path_b), path=args.path_b)
+            print(json.dumps({"a": a, "b": b}) if args.json
+                  else render_diff(a, b))
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

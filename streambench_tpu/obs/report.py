@@ -1,0 +1,178 @@
+"""Run reports from ``metrics.jsonl`` time series: summarize and diff.
+
+The reporting half of the telemetry subsystem: ``summarize`` folds one
+run's snapshot stream into a scalar summary, ``render_report`` prints it
+human-readable, and ``render_diff`` lines two runs up column-for-column
+with absolute and relative deltas — the before/after reading every perf
+PR needs (the reference has nothing like it; its numbers are read off
+scattered engine logs by hand).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse a metrics.jsonl file, skipping torn/blank lines (a killed
+    run can leave a partial last record; the series before it is still
+    a valid report)."""
+    out: list[dict] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def summarize(records: list[dict], path: str = "") -> dict:
+    """Fold one run's records into the scalar summary the renderers use."""
+    snaps = [r for r in records if r.get("kind") in ("snapshot", "final")]
+    final = next((r for r in reversed(records)
+                  if r.get("kind") == "final"), None)
+    last = final or (snaps[-1] if snaps else {})
+    events_ann = [r for r in records if r.get("kind") == "event"]
+    rates = [r["events_per_s"] for r in snaps
+             if isinstance(r.get("events_per_s"), (int, float))
+             and r["events_per_s"] > 0]
+
+    def col_max(key):
+        vals = [r[key] for r in snaps
+                if isinstance(r.get(key), (int, float))]
+        return max(vals) if vals else None
+
+    stages: dict[str, dict] = {}
+    for r in snaps:
+        for name, d in (r.get("stages") or {}).items():
+            agg = stages.setdefault(name, {"calls": 0, "ms": 0.0})
+            agg["calls"] += d.get("calls", 0)
+            agg["ms"] = round(agg["ms"] + d.get("ms", 0.0), 3)
+    latency = None
+    for r in reversed(snaps):
+        if r.get("latency_ms"):
+            latency = r["latency_ms"]
+            break
+    return {
+        "path": path,
+        "snapshots": len(snaps),
+        "duration_s": round(last.get("uptime_ms", 0) / 1000.0, 1),
+        "events": last.get("events"),
+        "windows_written": last.get("windows_written"),
+        "events_per_s_mean": (round(sum(rates) / len(rates), 1)
+                              if rates else None),
+        "events_per_s_max": max(rates) if rates else None,
+        "backlog_bytes_max": col_max("backlog_bytes"),
+        "watermark_lag_ms_max": col_max("watermark_lag_ms"),
+        "sink_dirty_rows_max": col_max("sink_dirty_rows"),
+        "rss_bytes_max": col_max("rss_bytes"),
+        "latency_ms": latency,
+        "faults": last.get("faults") or {},
+        "stages": stages,
+        "annotations": [{k: r.get(k) for k in ("event", "uptime_ms")}
+                        | {k: v for k, v in r.items()
+                           if k not in ("kind", "ts_ms")}
+                        for r in events_ann],
+        "run_stats": (final or {}).get("run_stats"),
+    }
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.1f}"
+    if isinstance(v, int):
+        return f"{v:,}"
+    return str(v)
+
+
+# (label, summary key) rows shared by report and diff so the two views
+# never drift apart
+_SCALAR_ROWS = (
+    ("duration_s", "duration_s"),
+    ("snapshots", "snapshots"),
+    ("events", "events"),
+    ("events/s mean", "events_per_s_mean"),
+    ("events/s max", "events_per_s_max"),
+    ("windows written", "windows_written"),
+    ("backlog bytes max", "backlog_bytes_max"),
+    ("watermark lag ms max", "watermark_lag_ms_max"),
+    ("sink dirty rows max", "sink_dirty_rows_max"),
+    ("rss bytes max", "rss_bytes_max"),
+)
+
+
+def _latency_rows(s: dict) -> list[tuple[str, object]]:
+    lat = s.get("latency_ms") or {}
+    return [(f"latency {k}", lat.get(k))
+            for k in ("p50", "p95", "p99", "max", "count")]
+
+
+def render_report(s: dict) -> str:
+    lines = [f"telemetry report: {s['path'] or '(records)'}"]
+    for label, key in _SCALAR_ROWS:
+        lines.append(f"  {label:<22} {_fmt(s.get(key))}")
+    for label, v in _latency_rows(s):
+        lines.append(f"  {label:<22} {_fmt(v)}")
+    if s["faults"]:
+        lines.append("  faults:")
+        for k in sorted(s["faults"]):
+            lines.append(f"    {k:<26} {_fmt(s['faults'][k])}")
+    if s["stages"]:
+        lines.append("  stages (calls, total_ms):")
+        width = max(len(n) for n in s["stages"])
+        for name, agg in sorted(s["stages"].items(),
+                                key=lambda kv: -kv[1]["ms"]):
+            lines.append(f"    {name:<{width}}  {agg['calls']:>8}  "
+                         f"{agg['ms']:>12.1f}")
+    if s["annotations"]:
+        lines.append("  events:")
+        for a in s["annotations"]:
+            extras = {k: v for k, v in a.items()
+                      if k not in ("event", "uptime_ms")}
+            lines.append(f"    +{(a.get('uptime_ms') or 0) / 1000.0:.1f}s "
+                         f"{a.get('event')} {extras or ''}".rstrip())
+    if s.get("run_stats"):
+        lines.append(f"  run_stats: {json.dumps(s['run_stats'])}")
+    return "\n".join(lines)
+
+
+def render_diff(a: dict, b: dict) -> str:
+    """Two runs side-by-side with absolute + relative deltas (B vs A)."""
+    rows = list(_SCALAR_ROWS)
+    lines = ["telemetry diff:",
+             f"  A: {a['path']}",
+             f"  B: {b['path']}",
+             f"  {'metric':<22} {'A':>14} {'B':>14} "
+             f"{'delta':>14} {'pct':>8}"]
+
+    def emit(label, va, vb):
+        delta = pct = None
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+            delta = round(vb - va, 3)
+            if va:
+                pct = f"{(vb - va) / va * 100:+.1f}%"
+        lines.append(f"  {label:<22} {_fmt(va):>14} {_fmt(vb):>14} "
+                     f"{_fmt(delta):>14} {pct or '-':>8}")
+
+    for label, key in rows:
+        emit(label, a.get(key), b.get(key))
+    la = dict(_latency_rows(a))
+    lb = dict(_latency_rows(b))
+    for label in la:
+        emit(label, la[label], lb.get(label))
+    fault_keys = sorted(set(a["faults"]) | set(b["faults"]))
+    for k in fault_keys:
+        emit(f"fault {k}", a["faults"].get(k, 0), b["faults"].get(k, 0))
+    stage_keys = sorted(set(a["stages"]) | set(b["stages"]))
+    for k in stage_keys:
+        emit(f"stage {k} ms", (a["stages"].get(k) or {}).get("ms", 0),
+             (b["stages"].get(k) or {}).get("ms", 0))
+    return "\n".join(lines)

@@ -18,6 +18,7 @@ reference's JVM GC logging (``META-INF/properties.xml:10-12``).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -39,10 +40,29 @@ class StageStats:
 
 @dataclass
 class Tracer:
-    """Per-stage span accounting.  ``with tracer.span("encode"): ...``"""
+    """Per-stage span accounting.  ``with tracer.span("encode"): ...``
+
+    Thread-safe: the Redis flusher thread records ``redis_flush`` spans
+    concurrently with the host loop's ``encode``/``device_step`` spans
+    (and the telemetry sampler reads the table mid-run), so the
+    ``StageStats`` read-modify-write happens under one lock.  The span
+    overhead stays ~two ``perf_counter_ns`` calls plus the locked dict
+    update — timing runs outside the lock.
+    """
 
     stages: dict[str, StageStats] = field(default_factory=dict)
     enabled: bool = True
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
+
+    def _record(self, stage: str, duration_ns: int) -> None:
+        with self._lock:
+            st = self.stages.get(stage)
+            if st is None:
+                st = self.stages[stage] = StageStats()
+            st.calls += 1
+            st.total_ns += duration_ns
+            st.max_ns = max(st.max_ns, duration_ns)
 
     @contextlib.contextmanager
     def span(self, stage: str):
@@ -53,38 +73,38 @@ class Tracer:
         try:
             yield
         finally:
-            dt = time.perf_counter_ns() - t0
-            st = self.stages.get(stage)
-            if st is None:
-                st = self.stages[stage] = StageStats()
-            st.calls += 1
-            st.total_ns += dt
-            st.max_ns = max(st.max_ns, dt)
+            self._record(stage, time.perf_counter_ns() - t0)
 
     def add(self, stage: str, duration_ns: int) -> None:
-        st = self.stages.get(stage)
-        if st is None:
-            st = self.stages[stage] = StageStats()
-        st.calls += 1
-        st.total_ns += duration_ns
-        st.max_ns = max(st.max_ns, duration_ns)
+        self._record(stage, duration_ns)
+
+    def snapshot(self) -> dict[str, tuple[int, int, int]]:
+        """Consistent ``{stage: (calls, total_ns, max_ns)}`` copy — the
+        delta source for the telemetry sampler."""
+        with self._lock:
+            return {name: (st.calls, st.total_ns, st.max_ns)
+                    for name, st in self.stages.items()}
 
     def report(self) -> str:
-        if not self.stages:
+        snap = self.snapshot()
+        if not snap:
             return "trace: no spans recorded"
-        width = max(len(s) for s in self.stages)
+        width = max(len(s) for s in snap)
         lines = ["trace (stage: calls total_ms mean_ms max_ms):"]
-        for name, st in sorted(self.stages.items(),
-                               key=lambda kv: -kv[1].total_ns):
+        for name, (calls, total_ns, max_ns) in sorted(
+                snap.items(), key=lambda kv: -kv[1][1]):
             lines.append(
-                f"  {name:<{width}}  {st.calls:>8}  {st.total_ms:>10.1f}  "
-                f"{st.mean_ms:>8.3f}  {st.max_ns / 1e6:>8.3f}")
+                f"  {name:<{width}}  {calls:>8}  {total_ns / 1e6:>10.1f}  "
+                f"{total_ns / 1e6 / max(calls, 1):>8.3f}  "
+                f"{max_ns / 1e6:>8.3f}")
         return "\n".join(lines)
 
     def as_dict(self) -> dict[str, dict[str, float]]:
-        return {name: {"calls": st.calls, "total_ms": st.total_ms,
-                       "mean_ms": st.mean_ms, "max_ms": st.max_ns / 1e6}
-                for name, st in self.stages.items()}
+        return {name: {"calls": calls, "total_ms": total_ns / 1e6,
+                       "mean_ms": total_ns / 1e6 / max(calls, 1),
+                       "max_ms": max_ns / 1e6}
+                for name, (calls, total_ns, max_ns)
+                in self.snapshot().items()}
 
 
 @contextlib.contextmanager

@@ -51,6 +51,19 @@ def test_decile_table_matches_reference_grouping():
     assert len(single) == 10 and all(v == 7 for _, v in single)
 
 
+def test_decile_table_small_samples_spread():
+    # Under 10 samples the old integer step (n // 10 == 0) repeated
+    # sorted[0] across the first nine rows; proportional indices must
+    # spread the order statistics instead.
+    rows = decile_table([1, 2, 3, 4, 5])
+    assert [v for _, v in rows] == [1, 2, 2, 3, 3, 4, 4, 5, 5, 5]
+    rows7 = decile_table([10, 20, 30, 40, 50, 60, 70])
+    vals7 = [v for _, v in rows7]
+    assert vals7[0] == 10 and vals7[-1] == 70
+    assert len(set(vals7)) >= 5          # not collapsed onto the min
+    assert vals7 == sorted(vals7)        # monotone non-decreasing
+
+
 def test_stall_detector_warns_on_gap():
     warnings = []
     sd = StallDetector(expected_period_ms=1000, warn=warnings.append)

@@ -1,0 +1,478 @@
+"""Live telemetry subsystem (ISSUE 2 tentpole): registry instruments,
+log-bucketed streaming histograms, the metrics.jsonl sampler, the
+Prometheus endpoint, the report/diff CLI, supervisor annotations — and
+the tier-1 CLI smoke test: a brief engine run with ``jax.metrics.*``
+set must journal well-formed snapshots whose final cumulative counters
+agree with the exit RunStats JSON line, and serve one good scrape."""
+
+import json
+import math
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from streambench_tpu.chaos.plan import EngineCrash
+from streambench_tpu.chaos.supervisor import Supervisor
+from streambench_tpu.config import default_config, write_local_conf
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker, JournalReader
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.metrics import FaultCounters
+from streambench_tpu.obs import (
+    MetricsRegistry,
+    MetricsSampler,
+    MetricsServer,
+    StreamingHistogram,
+    engine_collector,
+)
+from streambench_tpu.obs.report import (
+    load_records,
+    render_diff,
+    render_report,
+    summarize,
+)
+from streambench_tpu.trace import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# registry + histogram
+def test_counter_monotonic_and_gauge():
+    reg = MetricsRegistry()
+    c = reg.counter("streambench_events_total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    c.set_total(100)
+    c.set_total(50)       # lower total ignored: counters are monotonic
+    assert c.value == 100
+    g = reg.gauge("streambench_backlog_bytes")
+    g.set(10)
+    g.set(3)              # gauges move both ways
+    assert g.value == 3
+    # get-or-create: same (name, labels) returns the same instrument
+    assert reg.counter("streambench_events_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("streambench_events_total")
+
+
+def test_histogram_observe_is_bucketed_not_stored():
+    h = StreamingHistogram("h", lo=1.0, hi=1e6)
+    for v in (1, 10, 100, 1000, 10_000):
+        h.observe(v)
+    # O(1) space: only fixed bucket counts, no sample list anywhere
+    assert not any(isinstance(x, list) and len(x) > len(h._counts)
+                   for x in vars(h).values())
+    assert h.count == 5
+    s = h.summary()
+    assert s["min"] == 1 and s["max"] == 10_000
+    assert s["sum"] == 11_111
+
+
+def test_histogram_quantiles_within_one_bucket():
+    growth = 2 ** 0.25
+    h = StreamingHistogram("h", lo=1.0, hi=1e7, growth=growth)
+    n = 10_000
+    for i in range(1, n + 1):
+        h.observe(i)
+    p50, p95, p99 = h.quantiles((0.5, 0.95, 0.99))
+    # log-bucketing guarantees bounded RELATIVE error: one bucket
+    assert 0.5 * n / growth <= p50 <= 0.5 * n * growth
+    assert 0.95 * n / growth <= p95 <= n
+    assert 0.99 * n / growth <= p99 <= n
+    # quantiles clamp to the observed max, never a bucket bound past it
+    assert p99 <= n
+
+
+def test_histogram_edges_and_empty():
+    h = StreamingHistogram("h", lo=1.0, hi=100.0)
+    assert all(math.isnan(q) for q in h.quantiles((0.5, 0.99)))
+    h.observe(0.001)   # below lo -> bucket 0
+    h.observe(1e9)     # above hi -> overflow bucket
+    assert h.count == 2
+    p50, p100 = h.quantiles((0.5, 1.0))
+    assert p50 == 1.0          # bucket-0 upper bound
+    assert p100 == 1e9         # overflow clamped to observed max
+
+
+def test_prometheus_rendering_families_and_labels():
+    reg = MetricsRegistry()
+    reg.counter("streambench_faults_total", "faults",
+                labels={"kind": "sink_errors"}).inc(2)
+    reg.counter("streambench_faults_total", "faults",
+                labels={"kind": "restarts"}).inc(1)
+    reg.gauge("streambench_rss_bytes").set(12345)
+    h = reg.histogram("streambench_window_latency_ms", lo=1, hi=100)
+    h.observe(5)
+    text = reg.render_prometheus()
+    assert '# TYPE streambench_faults_total counter' in text
+    assert 'streambench_faults_total{kind="sink_errors"} 2' in text
+    assert 'streambench_faults_total{kind="restarts"} 1' in text
+    assert "streambench_rss_bytes 12345" in text
+    assert "# TYPE streambench_window_latency_ms histogram" in text
+    assert 'streambench_window_latency_ms_bucket{le="+Inf"} 1' in text
+    assert "streambench_window_latency_ms_count 1" in text
+    # one TYPE header per family, not per labeled child
+    assert text.count("# TYPE streambench_faults_total") == 1
+
+
+# ----------------------------------------------------------------------
+# sampler
+class _StubEngine:
+    """Duck-typed engine surface the collector reads."""
+
+    def __init__(self):
+        self.tracer = Tracer()
+        self.faults = FaultCounters()
+        self.events_processed = 0
+        self.windows_written = 0
+        self._obs_hist = None
+
+    def telemetry(self):
+        return {"events": self.events_processed,
+                "windows_written": self.windows_written,
+                "watermark_lag_ms": 42,
+                "sink_dirty_rows": 0,
+                "pending_rows": 0}
+
+
+def test_sampler_snapshots_deltas_and_final(tmp_path):
+    eng = _StubEngine()
+    reg = MetricsRegistry()
+    hist = reg.histogram("streambench_window_latency_ms")
+    eng._obs_hist = hist
+    path = str(tmp_path / "metrics.jsonl")
+    s = MetricsSampler(path, interval_ms=10, registry=reg)
+    s.add_collector(engine_collector(eng, registry=reg))
+    s.start()
+    eng.events_processed = 1000
+    eng.windows_written = 3
+    eng.faults.inc("sink_errors")
+    with eng.tracer.span("encode"):
+        pass
+    hist.observe(250)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        recs = [json.loads(l) for l in open(path)] if os.path.exists(path) \
+            else []
+        if any(r.get("events") == 1000 for r in recs):
+            break
+        time.sleep(0.01)
+    s.annotate("restart", restarts=1)
+    s.close(final={"events": 1000, "wall_s": 0.1})
+    recs = [json.loads(l) for l in open(path)]
+    kinds = [r["kind"] for r in recs]
+    assert "snapshot" in kinds and "event" in kinds
+    assert kinds[-1] == "final"
+    snap = next(r for r in recs if r.get("events") == 1000)
+    assert snap["windows_written"] == 3
+    assert snap["watermark_lag_ms"] == 42
+    assert snap["faults"] == {"sink_errors": 1}
+    assert snap["events_per_s"] > 0
+    assert snap["latency_ms"]["count"] == 1
+    assert snap["latency_ms"]["p50"] >= 200
+    # the first snapshot seeing the counters carries them as deltas too
+    first = next(r for r in recs if r.get("faults"))
+    assert first["fault_deltas"].get("sink_errors") == 1
+    ev = next(r for r in recs if r["kind"] == "event")
+    assert ev["event"] == "restart" and ev["restarts"] == 1
+    final = recs[-1]
+    assert final["run_stats"] == {"events": 1000, "wall_s": 0.1}
+    assert final["events"] == 1000
+    # registry mirrored the same story for a scrape
+    assert reg.counter("streambench_events_total").value == 1000
+    text = reg.render_prometheus()
+    assert 'streambench_faults_total{kind="sink_errors"} 1' in text
+
+
+def test_sampler_no_thread_until_started(tmp_path):
+    before = {t.name for t in threading.enumerate()}
+    s = MetricsSampler(str(tmp_path / "m.jsonl"), interval_ms=10)
+    assert "metrics-sampler" not in {t.name for t in threading.enumerate()
+                                     } - before
+    s.close()
+    assert not any(t.name == "metrics-sampler"
+                   for t in threading.enumerate())
+
+
+def test_journal_backlog_bytes(tmp_path):
+    broker = FileBroker(str(tmp_path / "broker"))
+    broker.create_topic("t")
+    w = broker.writer("t")
+    w.append_many([b"x" * 9] * 10)   # 10 lines x 10 bytes
+    w.flush()
+    r = broker.reader("t")
+    assert r.backlog_bytes() == 100
+    r.poll(max_records=5)
+    assert r.backlog_bytes() == 50
+    r.poll()
+    assert r.backlog_bytes() == 0
+    missing = JournalReader(str(tmp_path / "nope.jsonl"))
+    assert missing.backlog_bytes() == 0
+    multi = broker.multi_reader("t")   # fresh readers start at offset 0
+    assert multi.backlog_bytes() == 100
+    multi.poll()
+    assert multi.backlog_bytes() == 0
+
+
+def test_metrics_server_scrape_and_refresh():
+    reg = MetricsRegistry()
+    reg.counter("streambench_events_total").set_total(7)
+    refreshed = []
+    srv = MetricsServer(reg, port=0, refresh=lambda: refreshed.append(1))
+    try:
+        body = urllib.request.urlopen(srv.url, timeout=10).read().decode()
+        assert "streambench_events_total 7" in body
+        assert refreshed  # pre-scrape refresh ran
+        health = urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/healthz", timeout=10)
+        assert health.status == 200
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------------------
+# supervisor annotations
+def test_supervisor_annotates_crash_restart_giveup():
+    events = []
+
+    class Recorder:
+        def annotate(self, event, **fields):
+            events.append((event, fields))
+
+    class CrashingRunner:
+        checkpointer = None
+        crash_points = None
+
+        def resume(self):
+            return False
+
+        def _reader_position(self):
+            return 10
+
+        def run(self, **kw):
+            raise EngineCrash("boom")
+
+    sup = Supervisor(CrashingRunner, max_no_progress_restarts=1,
+                     backoff_base_ms=0, sleep=lambda s: None,
+                     sampler=Recorder())
+    st = sup.run()
+    assert st.gave_up
+    names = [e for e, _ in events]
+    assert names == ["crash", "restart", "crash", "give_up"]
+    assert events[0][1]["crash_offset"] == 10
+
+
+# ----------------------------------------------------------------------
+# report CLI
+def _write_series(path, rates, faults=None, run_stats=None):
+    with open(path, "w") as f:
+        for i, rate in enumerate(rates):
+            f.write(json.dumps({
+                "kind": "snapshot", "seq": i, "ts_ms": 1000 + i * 100,
+                "uptime_ms": (i + 1) * 100, "events": (i + 1) * 1000,
+                "events_per_s": rate, "windows_written": i,
+                "backlog_bytes": 10 * i, "watermark_lag_ms": 5,
+                "rss_bytes": 1 << 20,
+                "latency_ms": {"count": 4, "p50": 11, "p95": 12,
+                               "p99": 13, "min": 10, "max": 14, "sum": 46},
+                "stages": {"encode": {"calls": 2, "ms": 1.5}},
+                "faults": faults or {}, "fault_deltas": {},
+            }) + "\n")
+        f.write(json.dumps({
+            "kind": "event", "event": "restart", "ts_ms": 2000,
+            "uptime_ms": 250, "restarts": 1}) + "\n")
+        f.write(json.dumps({
+            "kind": "final", "seq": len(rates), "ts_ms": 9000,
+            "uptime_ms": (len(rates) + 1) * 100,
+            "events": len(rates) * 1000, "events_per_s": 0.0,
+            "windows_written": len(rates), "faults": faults or {},
+            "fault_deltas": {}, "stages": {},
+            "run_stats": run_stats or {"events": len(rates) * 1000},
+        }) + "\n")
+
+
+def test_report_summarize_and_render(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    _write_series(path, [100.0, 200.0, 300.0],
+                  faults={"sink_errors": 2})
+    # torn tail from a killed run must not poison the report
+    with open(path, "a") as f:
+        f.write('{"kind": "snapsho')
+    s = summarize(load_records(path), path=path)
+    assert s["events"] == 3000
+    assert s["events_per_s_mean"] == 200.0
+    assert s["events_per_s_max"] == 300.0
+    assert s["backlog_bytes_max"] == 20
+    assert s["latency_ms"]["p99"] == 13
+    assert s["faults"] == {"sink_errors": 2}
+    assert s["stages"]["encode"]["calls"] == 6
+    assert len(s["annotations"]) == 1
+    text = render_report(s)
+    assert "events/s max" in text and "300.0" in text
+    assert "sink_errors" in text and "restart" in text
+
+
+def test_report_cli_and_diff(tmp_path, capsys):
+    from streambench_tpu.obs.__main__ import main as obs_main
+
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _write_series(a, [100.0, 100.0])
+    _write_series(b, [150.0, 250.0], faults={"flush_stalls": 1})
+    assert obs_main(["report", a]) == 0
+    out = capsys.readouterr().out
+    assert "telemetry report" in out
+    assert obs_main(["diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "telemetry diff" in out
+    assert "+100.0%" in out          # events/s mean 100 -> 200
+    assert "fault flush_stalls" in out
+    assert obs_main(["report", a, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["events"] == 2000
+
+
+# ----------------------------------------------------------------------
+# engine integration: histogram fed at writeback; CLI smoke test
+def test_engine_attach_obs_feeds_live_histogram(tmp_path):
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+
+    cfg = default_config(jax_batch_size=256)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=4000,
+                 rng=random.Random(3), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+    reg = MetricsRegistry()
+    engine.attach_obs(reg)
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+    runner.run_catchup()
+    engine.close()
+    hist = reg.histogram("streambench_window_latency_ms")
+    # every unique written window observed exactly once per writeback
+    assert hist.count >= len(engine.window_latency) > 0
+    tel = engine.telemetry()
+    assert tel["events"] == runner.stats.events
+    assert tel["windows_written"] == engine.windows_written
+    assert tel["watermark_lag_ms"] is not None
+
+
+def _read_lines_async(stream, sink):
+    for line in iter(stream.readline, ""):
+        sink.append(line)
+
+
+def test_cli_metrics_jsonl_and_prometheus_scrape(tmp_path):
+    """The ISSUE's smoke test: engine CLI with jax.metrics.interval.ms
+    low journals well-formed snapshots, serves one scrape on an
+    ephemeral port, and the final record agrees with the RunStats JSON
+    line.  No fixed sleeps: everything is deadline-polled."""
+    wd = str(tmp_path)
+    conf = os.path.join(wd, "conf.yaml")
+    write_local_conf(conf, {
+        "redis.host": ":inprocess:",
+        "kafka.topic": "ad-events",
+        "jax.batch.size": 256,
+        "jax.scan.batches": 2,
+        "jax.flush.interval.ms": 100,
+        "jax.metrics.interval.ms": 25,
+        "jax.metrics.port": 0,          # ephemeral, printed at startup
+    })
+    cfg = default_config()
+    broker = FileBroker(os.path.join(wd, "broker"))
+    gen.do_setup(as_redis(FakeRedisStore()), cfg, broker=broker,
+                 events_num=20_000, rng=random.Random(17), workdir=wd,
+                 topic="ad-events")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONUNBUFFERED": "1"}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "streambench_tpu.engine",
+         "--confPath", conf, "--workdir", wd,
+         "--brokerDir", os.path.join(wd, "broker"),
+         "--duration", "120"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    lines: list[str] = []
+    reader = threading.Thread(target=_read_lines_async,
+                              args=(p.stdout, lines), daemon=True)
+    reader.start()
+    try:
+        deadline = time.monotonic() + 180
+        url = None
+        while time.monotonic() < deadline and url is None:
+            for line in list(lines):
+                if line.startswith("metrics: ") and "endpoint=" in line:
+                    url = line.split("endpoint=", 1)[1].strip()
+                    break
+            if p.poll() is not None:
+                raise AssertionError(
+                    f"engine exited early: {''.join(lines)[-800:]}")
+            time.sleep(0.01)
+        assert url, f"no metrics endpoint line: {''.join(lines)[-800:]}"
+
+        # scrape once (retry until the deadline — the server is up
+        # before the line prints, but be tolerant of a slow host)
+        body = None
+        while time.monotonic() < deadline:
+            try:
+                body = urllib.request.urlopen(url, timeout=5).read().decode()
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert body and "# TYPE streambench_events_total counter" in body
+        assert "streambench_window_latency_ms_bucket" in body
+        assert "streambench_windows_written_total" in body
+
+        # wait until the journal shows consumed events, then stop
+        metrics_path = os.path.join(wd, "metrics.jsonl")
+        while time.monotonic() < deadline:
+            if os.path.exists(metrics_path):
+                recs = [json.loads(l) for l in open(metrics_path)
+                        if l.rstrip().endswith("}")]
+                if any(r.get("events") for r in recs):
+                    break
+            time.sleep(0.02)
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=120)
+        reader.join(timeout=10)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert p.returncode == 0, "".join(lines)[-800:]
+
+    stats_line = json.loads(
+        next(l for l in reversed(lines) if l.startswith("{")))
+    recs = [json.loads(l) for l in open(os.path.join(wd, "metrics.jsonl"))]
+    snaps = [r for r in recs if r["kind"] == "snapshot"]
+    assert snaps, "no snapshot records"
+    for r in snaps:  # well-formed: the advertised schema keys exist
+        for key in ("seq", "ts_ms", "events", "events_per_s",
+                    "windows_written", "backlog_bytes", "stages",
+                    "faults", "fault_deltas"):
+            assert key in r, (key, r)
+    # live latency percentiles appeared once windows were written
+    lat = [r for r in recs if r.get("latency_ms")]
+    assert lat and all(k in lat[-1]["latency_ms"]
+                       for k in ("p50", "p95", "p99"))
+    final = recs[-1]
+    assert final["kind"] == "final"
+    # the time series' last word and the exit stats line agree
+    assert final["run_stats"] == stats_line
+    assert final["events"] == stats_line["events"]
+    assert final["windows_written"] == stats_line["windows_written"]
+    # engine-level fault counters agree (RunStats.faults additionally
+    # folds encoder/reader counters on top of the engine's)
+    for k, v in final["faults"].items():
+        assert stats_line["faults"].get(k) == v, (k, v, stats_line)

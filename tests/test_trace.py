@@ -1,0 +1,146 @@
+"""Tracer + StallDetector coverage (ISSUE 2 satellites): span
+aggregation, thread-safety of concurrent span recording (the Redis
+flusher thread records ``redis_flush`` spans while the host loop records
+``encode``/``device_step``), report rendering, and the stall detector's
+threshold/reset/fault-counter behavior."""
+
+import threading
+
+from streambench_tpu.metrics import FaultCounters, StallDetector
+from streambench_tpu.trace import Tracer, device_trace
+
+
+def test_span_aggregation_calls_total_max():
+    tr = Tracer()
+    tr.add("a", 1_000_000)
+    tr.add("a", 3_000_000)
+    tr.add("b", 2_000_000)
+    st = tr.stages["a"]
+    assert st.calls == 2
+    assert st.total_ns == 4_000_000
+    assert st.max_ns == 3_000_000
+    assert st.total_ms == 4.0
+    assert st.mean_ms == 2.0
+    with tr.span("a"):
+        pass
+    assert tr.stages["a"].calls == 3
+
+
+def test_add_and_span_share_one_table():
+    tr = Tracer()
+    with tr.span("encode"):
+        pass
+    tr.add("encode", 5_000_000)
+    assert tr.stages["encode"].calls == 2
+    assert tr.stages["encode"].total_ns >= 5_000_000
+
+
+def test_report_orders_by_total_and_aligns_width():
+    tr = Tracer()
+    tr.add("tiny", 1_000)
+    tr.add("a_much_longer_stage_name", 9_000_000)
+    rep = tr.report()
+    lines = rep.splitlines()
+    assert lines[0].startswith("trace (stage:")
+    # descending by total time: the 9 ms stage precedes the 1 us one
+    assert lines[1].lstrip().startswith("a_much_longer_stage_name")
+    assert lines[2].lstrip().startswith("tiny")
+    # both stage-name columns are padded to the longest name
+    w = len("a_much_longer_stage_name")
+    assert lines[2].lstrip()[:w].rstrip() == "tiny"
+    assert len(lines[2].lstrip()[:w]) == w
+
+
+def test_report_empty_and_as_dict():
+    tr = Tracer()
+    assert tr.report() == "trace: no spans recorded"
+    tr.add("x", 2_000_000)
+    d = tr.as_dict()
+    assert d["x"]["calls"] == 1
+    assert d["x"]["total_ms"] == 2.0
+    assert d["x"]["mean_ms"] == 2.0
+    assert d["x"]["max_ms"] == 2.0
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer(enabled=False)
+    with tr.span("encode"):
+        pass
+    assert tr.stages == {}
+
+
+def test_snapshot_is_a_consistent_copy():
+    tr = Tracer()
+    tr.add("s", 1_000)
+    snap = tr.snapshot()
+    assert snap == {"s": (1, 1_000, 1_000)}
+    tr.add("s", 1_000)
+    assert snap["s"][0] == 1  # the copy does not alias live state
+
+
+def test_concurrent_spans_lose_no_updates():
+    """The satellite's actual bug surface: StageStats read-modify-write
+    from the writer thread racing the host loop.  With the lock, N
+    threads x M spans must land exactly N*M calls."""
+    tr = Tracer()
+    N, M = 8, 500
+
+    def work():
+        for _ in range(M):
+            with tr.span("shared"):
+                pass
+            tr.add("added", 10)
+
+    threads = [threading.Thread(target=work) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tr.stages["shared"].calls == N * M
+    assert tr.stages["added"].calls == N * M
+    assert tr.stages["added"].total_ns == N * M * 10
+
+
+def test_device_trace_noop_without_logdir():
+    # must not touch jax.profiler at all when logdir is falsy
+    with device_trace(None):
+        pass
+    with device_trace(""):
+        pass
+
+
+# ----------------------------------------------------------------------
+def test_stall_detector_threshold_boundary():
+    sd = StallDetector(expected_period_ms=1000, factor=2.0)
+    assert sd.threshold_ms == 2000
+    assert sd.tick(0) is None          # baseline
+    assert sd.tick(2000) is None       # exactly at threshold: not a stall
+    assert sd.tick(4001) == 2001       # one past: stall
+    assert sd.stalls == 1
+
+
+def test_stall_detector_reset_clears_baseline():
+    sd = StallDetector(expected_period_ms=1000)
+    sd.tick(0)
+    sd.reset()
+    # a huge gap after reset is a fresh baseline, not a stall (restart
+    # downtime must not be billed as a flush stall)
+    assert sd.tick(100_000) is None
+    assert sd.stalls == 0
+    assert sd.tick(103_000) == 3000
+    assert sd.stalls == 1
+
+
+def test_stall_detector_bumps_fault_counters():
+    fc = FaultCounters()
+    warnings = []
+    sd = StallDetector(expected_period_ms=1000, warn=warnings.append,
+                       counters=fc)
+    sd.tick(0)
+    sd.tick(5000)
+    sd.tick(6000)
+    sd.tick(20_000)
+    assert sd.stalls == 2
+    assert fc.get("flush_stalls") == 2
+    assert fc.snapshot()["flush_stalls"] == 2
+    assert len(warnings) == 2
