@@ -1,0 +1,142 @@
+"""Ingest-pipeline A/B bench: catchup with the staged pipeline off vs on.
+
+The ISSUE 3 acceptance artifact: both runs land in ONE committed file
+(``bench_ingest_pipeline.json``) together with the serial per-stage
+``read_ms``/``encode_ms``/``dispatch_ms`` breakdown from the bench's
+device probe, so the overlap the pipeline buys — and what it costs on a
+host that cannot overlap — is on the record:
+
+- ``dispatch_ms`` is what the pipelined host loop pays per chunk once
+  read + encode are off its critical path (the ISSUE's "toward the
+  device floor" claim, measured);
+- ``off``/``on`` are best-of-N catchup runs over the same journal with
+  fresh engine + store per rep, the "on" run oracle-verified and its
+  stage telemetry (queue depths, stall counters) recorded;
+- ``host_cores`` qualifies the comparison: on a single-core host the
+  three stages timeslice one CPU, so the thread handoffs are pure
+  overhead and "off" wins — which is exactly why the runner's "auto"
+  mode gates on a multi-core host (see StreamRunner._pipeline_on).
+
+Env knobs: STREAMBENCH_INGEST_BENCH_EVENTS (default 400000),
+STREAMBENCH_INGEST_BENCH_REPS (default 3).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+
+def _load_bench():
+    """Import bench.py as a module (its probe is the ONE stage-timing
+    implementation; duplicating it here would let the two drift)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_ingest", os.path.join(here, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from streambench_tpu.config import default_config
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+    from streambench_tpu.io.fakeredis import make_store
+    from streambench_tpu.io.journal import FileBroker
+    from streambench_tpu.io.redis_schema import as_redis, seed_campaigns
+
+    import jax
+
+    n_events = int(os.environ.get("STREAMBENCH_INGEST_BENCH_EVENTS",
+                                  "400000"))
+    reps = max(int(os.environ.get("STREAMBENCH_INGEST_BENCH_REPS", "3")), 1)
+    bench = _load_bench()
+    tmp_base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+
+    out: dict = {
+        "metric": "staged ingest pipeline catchup A/B",
+        "platform": jax.default_backend(),
+        "host_cores": os.cpu_count() or 1,
+        "events": n_events,
+    }
+    with tempfile.TemporaryDirectory(dir=tmp_base) as wd:
+        cfg = default_config(jax_window_slots=2048, jax_scan_batches=8,
+                             jax_batch_size=8192)
+        broker = FileBroker(os.path.join(wd, "broker"))
+        r = as_redis(make_store())
+        gen.do_setup(r, cfg, broker=broker, events_num=n_events,
+                     rng=random.Random(42), workdir=wd)
+        mapping = gen.load_ad_mapping_file(
+            os.path.join(wd, gen.AD_TO_CAMPAIGN_FILE))
+        camps = sorted(set(mapping.values()))
+
+        # serial per-stage breakdown (bench.py's device probe, shared)
+        out["stage_ms"] = bench._measure_device_time(cfg, mapping, broker)
+
+        def measure(mode: str) -> dict:
+            row: dict = {"reps_events_per_s": []}
+            best = None
+            for _ in range(reps):
+                r_rep = as_redis(make_store())
+                seed_campaigns(r_rep, camps)
+                eng = AdAnalyticsEngine(cfg, mapping, redis=r_rep)
+                eng.warmup()
+                runner = StreamRunner(eng, broker.reader(cfg.kafka_topic),
+                                      ingest_pipeline=mode)
+                t0 = time.monotonic()
+                stats = runner.run_catchup()
+                eng.close()
+                dt = max(time.monotonic() - t0, 1e-9)
+                v = round(stats.events / dt, 1)
+                row["reps_events_per_s"].append(v)
+                if best is None or v > best[0]:
+                    best = (v, stats, runner, r_rep)
+            v, stats, runner, r_rep = best
+            row["best_events_per_s"] = v
+            row["events"] = stats.events
+            row["batches"] = stats.batches
+            if runner._pipeline is not None:
+                row["telemetry"] = runner._pipeline.telemetry()
+            row["_store"] = r_rep
+            return row
+
+        off = measure("off")
+        on = measure("on")
+        # oracle-verify the pipelined run: overlap must not cost a count
+        correct, differ, missing = gen.check_correct(
+            on.pop("_store"), workdir=wd, log=lambda s: None,
+            time_divisor_ms=cfg.jax_time_divisor_ms)
+        off.pop("_store")
+        on["oracle"] = ("exact" if not differ and not missing
+                        else f"INVALID differ={differ} missing={missing}")
+        out["off"] = off
+        out["on"] = on
+        out["speedup_on_vs_off"] = round(
+            on["best_events_per_s"] / off["best_events_per_s"], 4)
+        if out["host_cores"] <= 1:
+            out["note"] = (
+                "single-core host: the three stages timeslice one CPU, so "
+                "thread handoffs are pure overhead and 'off' wins — the "
+                "runner's 'auto' mode therefore gates the pipeline on a "
+                "multi-core host; dispatch_ms in stage_ms is what the "
+                "pipelined host loop pays per chunk once read+encode are "
+                "off its critical path (the overlap headroom)")
+
+    path = os.path.join(here, "bench_ingest_pipeline.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if on["oracle"] == "exact" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,8 @@ KAFKA_BROKERS = os.environ.get("KAFKA_BROKERS", "")
 SCAN_BATCHES = int(os.environ.get("SCAN_BATCHES", "8"))
 WINDOW_SLOTS = int(os.environ.get("WINDOW_SLOTS", "16"))
 ENCODE_WORKERS = int(os.environ.get("ENCODE_WORKERS", "1"))
+# Staged ingest pipeline (engine/ingest.py): off | on | auto
+INGEST_PIPELINE = os.environ.get("INGEST_PIPELINE", "off")
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -228,6 +230,7 @@ def op_setup() -> None:
         "jax.scan.batches": SCAN_BATCHES,
         "jax.window.slots": WINDOW_SLOTS,
         "jax.encode.workers": ENCODE_WORKERS,
+        "jax.ingest.pipeline": INGEST_PIPELINE,
     })
     log(f"wrote {CONF_FILE}")
     try:

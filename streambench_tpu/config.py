@@ -99,6 +99,18 @@ class BenchmarkConfig:
     jax_checkpoint_interval_ms: int = 0
     jax_mesh_shape: tuple[int, ...] = (1,)  # device mesh (batch axis first)
     jax_mesh_axes: tuple[str, ...] = ("data",)
+    # --- staged ingest pipeline (engine.ingest; ISSUE 3) ---
+    # "off" (default) keeps the serial read->encode->dispatch loop
+    # byte-identical; "on" always overlaps the three stages on threads
+    # with bounded queues; "auto" enables the overlap only where it can
+    # pay — block-mode ingest (native encoder + poll_block reader) on a
+    # multi-core host (one core just timeslices the stages).
+    jax_ingest_pipeline: str = "off"
+    jax_ingest_block_queue: int = 4    # bounded read-ahead: raw journal
+    #   blocks the reader thread may buffer ahead of the encode stage
+    #   (backpressure bound; each block is <= one scan chunk of bytes)
+    jax_ingest_batch_queue: int = 4    # encoded-batch groups the encode
+    #   stage may buffer ahead of device dispatch
     jax_use_native_encoder: bool = True    # C++ fast-path when the .so is built
     # --- robustness knobs (ROBUSTNESS.md; the reference has none of these:
     # a Redis outage is a Jedis stack trace and enableCheckpointing is
@@ -178,6 +190,11 @@ class BenchmarkConfig:
                 return bool(v)
             raise ConfigError(f"config key {key!r} is not a bool: {v!r}")
 
+        ingest_mode = gets("jax.ingest.pipeline", "off").strip().lower()
+        if ingest_mode not in ("off", "on", "auto"):
+            raise ConfigError(
+                f"config key 'jax.ingest.pipeline' must be one of "
+                f"off/on/auto: {ingest_mode!r}")
         mesh_shape = conf.get("jax.mesh.shape", (1,))
         mesh_axes = conf.get("jax.mesh.axes", ("data",))
         try:
@@ -222,6 +239,9 @@ class BenchmarkConfig:
             jax_checkpoint_interval_ms=geti("jax.checkpoint.interval.ms", 0),
             jax_mesh_shape=mesh_shape_t,
             jax_mesh_axes=tuple(_as_list(mesh_axes)) or ("data",),
+            jax_ingest_pipeline=ingest_mode,
+            jax_ingest_block_queue=max(geti("jax.ingest.block.queue", 4), 1),
+            jax_ingest_batch_queue=max(geti("jax.ingest.batch.queue", 4), 1),
             jax_use_native_encoder=getb("jax.use.native.encoder", True),
             jax_sink_retry_base_ms=geti("jax.sink.retry.base.ms", 100),
             jax_sink_retry_cap_ms=geti("jax.sink.retry.cap.ms", 5000),

@@ -1,2 +1,3 @@
+from streambench_tpu.engine.ingest import IngestPipeline  # noqa: F401
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine  # noqa: F401
 from streambench_tpu.engine.runner import StreamRunner  # noqa: F401

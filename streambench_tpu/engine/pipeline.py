@@ -536,28 +536,44 @@ class AdAnalyticsEngine:
         per-batch path when the engine's kernel has no scanned form or
         the chunk's event-time span doesn't fit the ring in one piece.
         """
-        K = self.scan_batches
+        self.fold_batches(self.encode_chunk_lines(lines))
+        return len(lines)
+
+    def encode_chunk_lines(self, lines: list[bytes]) -> list:
+        """Encode-only half of ``process_chunk``: batch-sized slices
+        through the encode pool (or the primary encoder), empty batches
+        dropped.  The ingest pipeline's encode stage calls this from its
+        own thread; nothing here touches device state."""
         B = self.batch_size
         if self._encode_pool is not None:
             with self.tracer.span("encode"):
                 encoded = self._encode_pool.encode_chunks(
                     [lines[off:off + B] for off in range(0, len(lines), B)],
                     B)
-            batches = [b for b in encoded if b.n]
-        else:
-            batches = []
-            for off in range(0, len(lines), B):
-                with self.tracer.span("encode"):
-                    b = self._encode(lines[off:off + B], B)
-                if b.n:
-                    batches.append(b)
+            return [b for b in encoded if b.n]
+        batches = []
+        for off in range(0, len(lines), B):
+            with self.tracer.span("encode"):
+                b = self._encode(lines[off:off + B], B)
+            if b.n:
+                batches.append(b)
+        return batches
+
+    def fold_batches(self, batches: list) -> int:
+        """Dispatch-only half of the ingest paths: fold already-encoded
+        batches into device state IN ORDER (scan-grouped when the kernel
+        supports it).  Returns parsed events folded.  The ingest
+        pipeline's host loop calls this with batches its encode stage
+        produced; the serial paths compose it with the encode halves."""
+        before = self.events_processed
+        K = self.scan_batches
         if not self.SCAN_SUPPORTED or K <= 1:
             for b in batches:
                 self._fold(b)
-            return len(lines)
-        for g in range(0, len(batches), K):
-            self._fold_group(batches[g:g + K])
-        return len(lines)
+        else:
+            for g in range(0, len(batches), K):
+                self._fold_group(batches[g:g + K])
+        return self.events_processed - before
 
     def _fold_group(self, batches: list) -> None:
         """Fold up to ``scan_batches`` encoded batches in one dispatch."""
@@ -674,13 +690,21 @@ class AdAnalyticsEngine:
         """
         if not data:
             return 0
+        return self.fold_batches(self.encode_raw_block(data))
+
+    def encode_raw_block(self, data: bytes) -> list:
+        """Encode-only half of ``process_block``: carve + parse one raw
+        journal block into ``EncodedBatch`` groups without folding (the
+        ingest pipeline's encode stage).  Engines without block ingest
+        fall back to splitting lines through ``encode_chunk_lines``, so
+        both ingest modes see identical events."""
+        if not data:
+            return []
         if not self.supports_block_ingest:
             lines = data.split(b"\n")
             if lines and not lines[-1]:
                 lines.pop()
-            before = self.events_processed
-            self.process_chunk(lines)
-            return self.events_processed - before
+            return self.encode_chunk_lines(lines)
         B = self.batch_size
         with self.tracer.span("encode"):
             if self._encode_pool is not None:
@@ -695,13 +719,7 @@ class AdAnalyticsEngine:
                 b = self._encode([data[start:]], B)
                 if b.n:
                     batches.append(b)
-        if not self.SCAN_SUPPORTED or self.scan_batches <= 1:
-            for b in batches:
-                self._fold(b)
-        else:
-            for g in range(0, len(batches), self.scan_batches):
-                self._fold_group(batches[g:g + self.scan_batches])
-        return sum(b.n for b in batches)
+        return batches
 
     def _fold(self, batch) -> None:
         """Ring-guarded fold of one encoded batch, splitting when needed.

@@ -60,7 +60,8 @@ class StreamRunner:
                  flush_interval_ms: int | None = None,
                  checkpointer: Checkpointer | None = None,
                  checkpoint_interval_ms: int | None = None,
-                 crash_points=None):
+                 crash_points=None,
+                 ingest_pipeline: str | None = None):
         cfg = engine.cfg
         self.engine = engine
         self.reader = reader
@@ -92,6 +93,13 @@ class StreamRunner:
         # the supervised-recovery contract is verified against.  None (the
         # default) keeps the loop byte-identical to the pre-chaos runner.
         self.crash_points = crash_points
+        # Staged ingest pipeline (engine.ingest): "off" keeps the serial
+        # loops byte-identical, "on" forces the overlapped stages, "auto"
+        # enables them where block-mode ingest makes the overlap pay.
+        mode = (ingest_pipeline if ingest_pipeline is not None
+                else getattr(cfg, "jax_ingest_pipeline", "off"))
+        self.ingest_mode = (mode or "off").strip().lower()
+        self._pipeline = None   # the live IngestPipeline during a run
 
     def stop(self) -> None:
         self._stop = True
@@ -124,7 +132,13 @@ class StreamRunner:
 
     def _reader_position(self) -> int | list[int]:
         """Single-partition byte offset, or the per-partition offsets
-        vector of a ``MultiReader`` (whose scalar ``.offset`` raises)."""
+        vector of a ``MultiReader`` (whose scalar ``.offset`` raises).
+        With the ingest pipeline active this is the FOLDED position —
+        the offset covering exactly the dispatched blocks, never the
+        reader thread's read-ahead — so checkpoints and crash offsets
+        (the supervisor's replay segments) stay consistent."""
+        if self._pipeline is not None:
+            return self._pipeline.position()
         try:
             return self.reader.offset
         except AttributeError:
@@ -146,7 +160,21 @@ class StreamRunner:
         return True
 
     def _checkpoint_now(self, now: float) -> None:
-        self.checkpointer.save(self.engine.snapshot(self._reader_position()))
+        pipe = self._pipeline
+        if pipe is not None and not pipe.closed:
+            # Quiesce the stages at a work-item boundary so the snapshot
+            # can serialize encoder state (base time, intern tables)
+            # without racing the encode thread; the returned offset
+            # covers exactly the folded blocks (in-flight prefetched
+            # blocks stay replayable, never skippable).
+            off = pipe.quiesce()
+            try:
+                self.checkpointer.save(self.engine.snapshot(off))
+            finally:
+                pipe.resume()
+        else:
+            self.checkpointer.save(
+                self.engine.snapshot(self._reader_position()))
         self._last_ckpt = now
         self._chaos_point("checkpoint")
 
@@ -154,10 +182,164 @@ class StreamRunner:
         return (self.checkpointer is not None and
                 (now - self._last_ckpt) * 1000 >= self.checkpoint_interval_ms)
 
+    # ------------------------------------------------------------------
+    # staged ingest pipeline (engine.ingest)
+    def _pipeline_on(self) -> bool:
+        """Resolve the ingest mode: "on" always pipelines, "auto" only
+        where the overlap can actually pay — block-mode ingest (native
+        encoder + a ``poll_block`` reader) AND more than one host core
+        (on a single core the stages just timeslice one CPU and the
+        thread handoffs are pure overhead — measured ~25% slower, see
+        ``bench_ingest_pipeline.json``), "off" (default) never — the
+        serial loops below stay byte-identical."""
+        if self.ingest_mode == "on":
+            return True
+        if self.ingest_mode == "auto":
+            import os
+
+            return ((os.cpu_count() or 1) > 1
+                    and getattr(self.engine, "supports_block_ingest",
+                                False)
+                    and hasattr(self.reader, "poll_block"))
+        return False
+
+    def _make_pipeline(self, catchup: bool):
+        from streambench_tpu.engine.ingest import IngestPipeline
+
+        cfg = self.engine.cfg
+        chunk = self.batch_size * max(
+            getattr(self.engine, "scan_batches", 1), 1)
+        pipe = IngestPipeline(
+            self.engine, self.reader,
+            batch_size=self.batch_size,
+            chunk_records=chunk,
+            buffer_timeout_ms=self.buffer_timeout_ms,
+            catchup=catchup,
+            est_event_bytes=self.EST_EVENT_BYTES,
+            block_queue=getattr(cfg, "jax_ingest_block_queue", 4),
+            batch_queue=getattr(cfg, "jax_ingest_batch_queue", 4))
+        self._pipeline = pipe
+        return pipe
+
+    def _fold_item(self, item) -> None:
+        """Dispatch one ready group: fold in journal order, then publish
+        its offset as folded (strictly after — a crash between the two
+        replays the block instead of skipping it)."""
+        st = self.stats
+        st.events += self.engine.fold_batches(item.batches)
+        st.batches += 1
+        self._pipeline.commit(item)
+        self._chaos_point("batch")
+
+    def _flush_cycle(self, now: float, last_flush: float) -> float:
+        """Shared 1 Hz flush + stall tick + checkpoint cadence for the
+        pipelined loops.  Returns the new ``last_flush``."""
+        st = self.stats
+        if (now - last_flush) * 1000 >= self.flush_interval_ms:
+            st.windows_written += self.engine.flush()
+            st.flushes += 1
+            self.stall_detector.tick(int(time.monotonic() * 1000))
+            last_flush = now
+            self._chaos_point("flush")
+            if self._checkpoint_due(now):
+                self._checkpoint_now(now)
+        return last_flush
+
+    def _finish_run(self) -> None:
+        """Final flush + checkpoint shared by every loop's exit path."""
+        st = self.stats
+        st.windows_written += self.engine.flush(final=True)
+        st.flushes += 1
+        self._chaos_point("flush")
+        if self.checkpointer is not None:
+            self._checkpoint_now(time.monotonic())
+
+    def _run_pipelined(self, duration_s: float | None,
+                       idle_timeout_s: float | None,
+                       max_events: int | None) -> RunStats:
+        """Streaming loop over the staged pipeline: the reader thread
+        owns polling + batching (buffer_timeout semantics included), the
+        encode thread owns encoding, and this loop does only device
+        dispatch + flush — the stages overlap instead of taking turns."""
+        from streambench_tpu.engine import ingest
+
+        st = self.stats
+        st.started_ms = now_ms()
+        deadline = (time.monotonic() + duration_s) if duration_s else None
+        last_flush = time.monotonic()
+        pipe = self._make_pipeline(catchup=False)
+        try:
+            while not self._stop:
+                now = time.monotonic()
+                if deadline and now >= deadline:
+                    break
+                if max_events and st.events >= max_events:
+                    break
+                item = pipe.get(timeout_s=0.02)
+                if item is not None and item is not ingest.EOF:
+                    self._fold_item(item)
+                elif (idle_timeout_s and pipe.drained()
+                        and pipe.idle_for() >= idle_timeout_s):
+                    # idle means the READER polled and found nothing for
+                    # a while AND everything it did read was folded
+                    break
+                last_flush = self._flush_cycle(time.monotonic(),
+                                               last_flush)
+            # Drain what the stages already read (the serial loop's
+            # trailing ``if pending: dispatch()``) — unless the cutoff
+            # was max_events, where uncommitted blocks stay replayable.
+            pipe.finish()
+            drain_deadline = time.monotonic() + 10.0
+            while time.monotonic() < drain_deadline:
+                if max_events and st.events >= max_events:
+                    break
+                item = pipe.get(timeout_s=0.1)
+                if item is ingest.EOF:
+                    break
+                if item is not None:
+                    self._fold_item(item)
+            self._finish_run()
+        finally:
+            pipe.close()
+        st.finished_ms = now_ms()
+        self._collect_faults()
+        return st
+
+    def _run_catchup_pipelined(self, max_events: int | None) -> RunStats:
+        """Catchup over the staged pipeline: chunk-sized reads + encode
+        run ahead on their threads; this loop pays only device dispatch
+        and flush, so the chunk cost drops toward the device floor."""
+        from streambench_tpu.engine import ingest
+
+        st = self.stats
+        st.started_ms = now_ms()
+        last_flush = time.monotonic()
+        pipe = self._make_pipeline(catchup=True)
+        try:
+            while not self._stop:
+                item = pipe.get(timeout_s=0.05)
+                if item is ingest.EOF:
+                    break
+                if item is not None:
+                    self._fold_item(item)
+                    if max_events and st.events >= max_events:
+                        break
+                last_flush = self._flush_cycle(time.monotonic(),
+                                               last_flush)
+            self._finish_run()
+        finally:
+            pipe.close()
+        st.finished_ms = now_ms()
+        self._collect_faults()
+        return st
+
     def run(self, duration_s: float | None = None,
             idle_timeout_s: float | None = None,
             max_events: int | None = None) -> RunStats:
         """Consume until stopped / duration / idle-timeout / max_events."""
+        if self._pipeline_on():
+            return self._run_pipelined(duration_s, idle_timeout_s,
+                                       max_events)
         st = self.stats
         st.started_ms = now_ms()
         deadline = (time.monotonic() + duration_s) if duration_s else None
@@ -290,6 +472,8 @@ class StreamRunner:
         """Drain the journal as fast as possible (catchup/throughput mode):
         scan-chunked batches, no buffer timeout, flush only on ring-span
         guard + once per second of wall clock."""
+        if self._pipeline_on():
+            return self._run_catchup_pipelined(max_events)
         st = self.stats
         st.started_ms = now_ms()
         last_flush = time.monotonic()

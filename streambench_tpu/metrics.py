@@ -195,6 +195,10 @@ class StallDetector:
         self._counters = counters
         self._last_ms: int | None = None
         self.stalls = 0
+        # Largest observed gap (ms) across the run: the bench's
+        # independent wall-clock stall evidence — its one-shot retry must
+        # not fire on the percentile shape alone (ADVICE r5).
+        self.max_gap_ms = 0
 
     def reset(self) -> None:
         """Drop the cadence baseline (engine restart / resumed run): the
@@ -209,6 +213,7 @@ class StallDetector:
             if period > self.threshold_ms:
                 gap = period
                 self.stalls += 1
+                self.max_gap_ms = max(self.max_gap_ms, period)
                 if self._counters is not None:
                     self._counters.inc("flush_stalls")
                 self._warn(

@@ -39,7 +39,25 @@ struct SvEq {
     return a == b;
   }
 };
+#if defined(__cpp_lib_generic_unordered_lookup) && \
+    __cpp_lib_generic_unordered_lookup >= 201811L
 using SvMap = std::unordered_map<std::string, int32_t, SvHash, SvEq>;
+#else
+// Pre-C++20-library toolchains (GCC 10's libstdc++ has no heterogeneous
+// unordered lookup): emulate find(string_view) with a key copy on the
+// probe.  The hit path pays one short-string allocation; semantics are
+// identical, and newer toolchains keep the alloc-free path above.
+struct SvMap : std::unordered_map<std::string, int32_t, SvHash, SvEq> {
+  using Base = std::unordered_map<std::string, int32_t, SvHash, SvEq>;
+  using Base::find;
+  Base::iterator find(std::string_view k) {
+    return Base::find(std::string(k));
+  }
+  Base::const_iterator find(std::string_view k) const {
+    return Base::find(std::string(k));
+  }
+};
+#endif
 
 struct StringInterner {
   SvMap map;

@@ -78,8 +78,42 @@ struct SvEq {
   using is_transparent = void;
   bool operator()(string_view a, string_view b) const { return a == b; }
 };
+#if defined(__cpp_lib_generic_unordered_lookup) && \
+    __cpp_lib_generic_unordered_lookup >= 201811L
 template <typename V>
 using SvMap = std::unordered_map<string, V, SvHash, SvEq>;
+using SvSet = std::unordered_set<string, SvHash, SvEq>;
+#else
+// Pre-C++20-library toolchains (GCC 10's libstdc++ has no heterogeneous
+// unordered lookup): emulate find/count(string_view) with a key copy on
+// the probe.  One short-string allocation per probe, identical
+// semantics; newer toolchains keep the alloc-free path above.  The
+// const char* overloads keep literal keys (e.g. find("windows"))
+// unambiguous between the string and string_view conversions.
+template <typename V>
+struct SvMap : std::unordered_map<string, V, SvHash, SvEq> {
+  using Base = std::unordered_map<string, V, SvHash, SvEq>;
+  using Base::count;
+  using Base::find;
+  typename Base::iterator find(string_view k) {
+    return Base::find(string(k));
+  }
+  typename Base::const_iterator find(string_view k) const {
+    return Base::find(string(k));
+  }
+  typename Base::iterator find(const char* k) {
+    return Base::find(string(k));
+  }
+  size_t count(string_view k) const { return Base::count(string(k)); }
+};
+struct SvSet : std::unordered_set<string, SvHash, SvEq> {
+  using Base = std::unordered_set<string, SvHash, SvEq>;
+  using Base::count;
+  using Base::find;
+  Base::iterator find(string_view k) { return Base::find(string(k)); }
+  size_t count(string_view k) const { return Base::count(string(k)); }
+};
+#endif
 
 // Specialized value for window hashes — every row the bulk writeback
 // creates is exactly {seen_count: int, time_updated: ms-string}, and the
@@ -110,7 +144,7 @@ struct Store {
   SvMap<string> strings;
   SvMap<SvMap<string>> hashes;
   SvMap<WinVal> windows;  // hash-kind, specialized (see WinVal)
-  SvMap<std::unordered_set<string, SvHash, SvEq>> sets;
+  SvMap<SvSet> sets;
   SvMap<std::deque<string>> lists;
   std::mutex mu;
   // native id generation for the bulk writeback

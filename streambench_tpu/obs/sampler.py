@@ -16,7 +16,9 @@ Record schema (one JSON object per line):
   ``"rss_bytes": ..., "latency_ms": {count,p50,p95,p99,min,max,sum},``
   ``"stages": {name: {"calls": Δ, "ms": Δ}}, "faults": cum,``
   ``"fault_deltas": Δ}`` — per-tick state; deltas are since the
-  previous record.
+  previous record.  When the staged ingest pipeline is live the record
+  also carries ``"ingest": {block_queue_depth, batch_queue_depth,``
+  ``reader_stalls, encode_stalls, ...}`` (``IngestPipeline.telemetry``).
 - ``{"kind": "event", "event": "...", ...}`` — out-of-band annotations
   (supervisor restarts, give-ups) injected between snapshots.
 - ``{"kind": "final", ..., "run_stats": {...}}`` — one last snapshot at
@@ -92,6 +94,28 @@ def engine_collector(engine, reader=None, runner=None, registry=None):
         if runner is not None:
             rec["batches"] = runner.stats.batches
             rec["flushes"] = runner.stats.flushes
+            # staged ingest pipeline (engine.ingest): stage queue depths
+            # + stall/starvation counters, present only while a pipeline
+            # is live (looked up per tick — the runner builds it inside
+            # run(), after this collector was wired)
+            pipe = getattr(runner, "_pipeline", None)
+            if pipe is not None:
+                ing = pipe.telemetry()
+                rec["ingest"] = ing
+                if reg is not None:
+                    reg.gauge("streambench_ingest_block_queue_depth",
+                              "raw journal blocks queued ahead of encode"
+                              ).set(ing["block_queue_depth"])
+                    reg.gauge("streambench_ingest_batch_queue_depth",
+                              "encoded batch groups queued ahead of "
+                              "device dispatch"
+                              ).set(ing["batch_queue_depth"])
+                    reg.counter("streambench_ingest_reader_stalls_total",
+                                "reader blocked on a full block queue"
+                                ).set_total(ing["reader_stalls"])
+                    reg.counter("streambench_ingest_encode_stalls_total",
+                                "encode blocked on a full batch queue"
+                                ).set_total(ing["encode_stalls"])
         # per-stage span deltas (thread-safe Tracer snapshot)
         stages = {}
         for name, (calls, total_ns, _mx) in engine.tracer.snapshot().items():

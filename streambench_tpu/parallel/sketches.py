@@ -538,7 +538,11 @@ class ShardedSlidingTDigestEngine(SlidingTDigestEngine):
 
 def _shard_index():
     """Linearized shard id over the flattened (data, campaign) mesh."""
-    nc = jax.lax.axis_size(CAMPAIGN_AXIS)
+    # jax.lax.axis_size is missing from older jax releases; psum(1) over
+    # the named axis is the portable spelling of the same quantity
+    axis_size = getattr(jax.lax, "axis_size", None)
+    nc = (axis_size(CAMPAIGN_AXIS) if axis_size is not None
+          else jax.lax.psum(1, CAMPAIGN_AXIS))
     return jax.lax.axis_index(DATA_AXIS) * nc + jax.lax.axis_index(
         CAMPAIGN_AXIS)
 

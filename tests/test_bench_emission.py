@@ -159,6 +159,10 @@ def test_ladder_retries_stall_signature_once(monkeypatch):
             # the signature is judged on the MEDIAN, not p90
             row["p90_ms"] = 18_700
             row["p99_ms"] = 27_000
+            # independent evidence: the engine's own flush loop ALSO
+            # recorded a multi-second wall-clock gap (required since
+            # the ADVICE r5 gating — shape alone no longer retries)
+            row["flush_stall_max_ms"] = 8_000
         return row
 
     monkeypatch.setattr(bench, "_paced_latency_phase", fake_phase)
@@ -172,6 +176,31 @@ def test_ladder_retries_stall_signature_once(monkeypatch):
     assert sum(1 for r in sweep["rates"] if r.get("stall_retried")) == 1
 
 
+def test_stall_signature_requires_independent_evidence():
+    """ADVICE r5: the percentile shape (processed==sent, p50<=SLA,
+    p99>SLA) can be produced by a REAL engine-side tail regression, so
+    it must not be retried away on its own — only when the generator
+    also fell behind or the flush loop recorded a wall-clock gap."""
+    bench = _load_bench("bench_mod3")
+    shape = {"rate": 10_000, "sent": 100, "processed": 100,
+             "p50_ms": 11_000, "p99_ms": 27_000}
+    # shape alone: NOT a stall signature (a real tail regression)
+    assert not bench._stall_signature(dict(shape), 15_000)
+    # generator gap corroborates
+    assert bench._stall_signature(
+        dict(shape, generator_behind_max_ms=1_500), 15_000)
+    # flush-loop wall-clock gap corroborates
+    assert bench._stall_signature(
+        dict(shape, flush_stall_max_ms=4_000), 15_000)
+    # evidence below the thresholds does not
+    assert not bench._stall_signature(
+        dict(shape, generator_behind_max_ms=200, flush_stall_max_ms=2_000),
+        15_000)
+    # evidence without the shape (median blown too) never retries
+    assert not bench._stall_signature(
+        dict(shape, p50_ms=16_000, flush_stall_max_ms=9_000), 15_000)
+
+
 def test_config_row_stall_retry_parks_first_attempt():
     """The config-row paced retry must stamp the ladder's stall_retried
     key on the first attempt, hand it to on_first BEFORE re-running (a
@@ -182,7 +211,9 @@ def test_config_row_stall_retry_parks_first_attempt():
     def make_row(p50, p99):
         return {"rate": 20_000, "sent": 100, "processed": 100,
                 "sustained": p99 <= 15_000, "invalid_producer": False,
-                "p50_ms": p50, "p90_ms": p50, "p99_ms": p99}
+                "p50_ms": p50, "p90_ms": p50, "p99_ms": p99,
+                # independent stall evidence (required by the gating)
+                "flush_stall_max_ms": 8_000}
 
     # stall shape: retried, first attempt parked before attempt 2 runs
     parked = []

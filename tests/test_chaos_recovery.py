@@ -89,6 +89,37 @@ def test_all_three_surfaces_within_oracle_bounds(tmp_path):
     assert sup.runner.engine.events_processed == 12_000
 
 
+def test_all_three_surfaces_with_ingest_pipeline(tmp_path):
+    """The headline acceptance run again with the staged ingest pipeline
+    ON (ISSUE 3): crashes land while the reader/encode stages hold
+    prefetched blocks in flight, and the at-least-once bound must still
+    verify — quiesce()/folded offsets never skip an unfolded block, and
+    read-ahead past the crash offset is replayed, not lost."""
+    cfg, r, broker, mapping = setup_run(tmp_path,
+                                        jax_ingest_pipeline="on")
+    plan = FaultPlan.generate(
+        1234,
+        sink_rate=0.25, sink_ops=30, sink_outage=(5, 6),
+        journal_rate=0.4, journal_polls=12,
+        crashes=0)
+    plan = FaultPlan(seed=plan.seed, sink_faults=plan.sink_faults,
+                     journal_faults=plan.journal_faults,
+                     crashes=(("batch", 5), ("flush", 1), ("batch", 2),
+                              ("checkpoint", 1)))
+    st, inj, sup = supervise(tmp_path, cfg, r, broker, mapping, plan)
+    assert st.crashes >= 3
+    assert inj.counters.get("chaos_sink_faults") > 0
+    assert inj.counters.get("journal_faults") > 0
+    v = check_at_least_once(r, str(tmp_path),
+                            broker.topic_path(cfg.kafka_topic),
+                            st.replay_segments, st.carried)
+    assert v.ok, (v.summary(), v.undercounts[:3], v.overcounts[:3])
+    assert v.windows > 0
+    assert sup.runner.engine.events_processed == 12_000
+    # the final attempt really ran the staged pipeline
+    assert sup.runner._pipeline is not None
+
+
 def test_crash_between_flush_and_checkpoint_overcounts_within_bound(
         tmp_path):
     """The documented replay window, hit on purpose: crash right after a

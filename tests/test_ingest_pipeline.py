@@ -1,0 +1,214 @@
+"""Staged ingest pipeline (engine.ingest): equivalence + quiesce contract.
+
+The acceptance properties of ISSUE 3:
+
+- pipeline ON produces byte-identical results to the serial loops (same
+  events, same Redis window state, oracle-exact) in both catchup and
+  paced mode, block mode and line mode, single- and multi-partition;
+- ``quiesce()`` returns an offset covering exactly the FOLDED blocks —
+  never read-ahead — so checkpoint/resume replays in-flight prefetched
+  blocks instead of skipping them;
+- pipeline OFF is the default and leaves the serial byte-path untouched
+  (pinned implicitly by every pre-existing runner test).
+"""
+
+import os
+import random
+
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.checkpoint import Checkpointer
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+from streambench_tpu.engine.ingest import EOF, IngestPipeline
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import (
+    as_redis,
+    read_seen_counts,
+    seed_campaigns,
+)
+
+
+def setup_run(tmp_path, events=20_000, partitions=1, **cfg_over):
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2, **cfg_over)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=events,
+                 rng=random.Random(7), workdir=str(tmp_path),
+                 partitions=partitions)
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    return cfg, r, broker, mapping
+
+
+def fresh_store(tmp_path):
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, gen.load_ids(str(tmp_path))[0])
+    return r
+
+
+def run_mode(cfg, mapping, broker, r, mode, catchup=True, reader=None):
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    if reader is None:
+        reader = broker.reader(cfg.kafka_topic)
+    runner = StreamRunner(eng, reader, ingest_pipeline=mode)
+    if catchup:
+        stats = runner.run_catchup()
+    else:
+        stats = runner.run(idle_timeout_s=0.5)
+    eng.close()
+    return stats, runner
+
+
+def test_catchup_pipelined_matches_serial_and_oracle(tmp_path):
+    cfg, r, broker, mapping = setup_run(tmp_path)
+    base_stats, _ = run_mode(cfg, mapping, broker, r, "off")
+    baseline = read_seen_counts(r)
+
+    r2 = fresh_store(tmp_path)
+    stats, runner = run_mode(cfg, mapping, broker, r2, "on")
+    assert stats.events == base_stats.events
+    assert read_seen_counts(r2) == baseline
+    correct, differ, missing = gen.check_correct(
+        r2, workdir=str(tmp_path), log=lambda s: None)
+    assert differ == 0 and missing == 0 and correct > 0
+    tel = runner._pipeline.telemetry()
+    assert tel["records_read"] == tel["records_folded"] == stats.events
+
+
+def test_streaming_pipelined_matches_serial(tmp_path):
+    """run() with the pipeline: buffer-timeout batching lives in the
+    reader stage; an idle journal ends the run via the idle timeout."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=8_000)
+    run_mode(cfg, mapping, broker, r, "off", catchup=False)
+    baseline = read_seen_counts(r)
+    r2 = fresh_store(tmp_path)
+    stats, _ = run_mode(cfg, mapping, broker, r2, "on", catchup=False)
+    assert stats.events == 8_000
+    assert read_seen_counts(r2) == baseline
+
+
+def test_line_mode_pipeline_without_native_encoder(tmp_path):
+    """Engines without block ingest (pure-Python encoder) take the
+    pipeline's line mode; results stay identical."""
+    cfg, r, broker, mapping = setup_run(
+        tmp_path, events=8_000, jax_use_native_encoder=False)
+    run_mode(cfg, mapping, broker, r, "off")
+    baseline = read_seen_counts(r)
+    r2 = fresh_store(tmp_path)
+    stats, runner = run_mode(cfg, mapping, broker, r2, "on")
+    assert not runner._pipeline.block_mode
+    assert stats.events == 8_000
+    assert read_seen_counts(r2) == baseline
+
+
+def test_multi_partition_pipeline_line_mode(tmp_path):
+    """MultiReader has no poll_block, so the pipeline runs line mode and
+    tracks the per-partition offsets VECTOR as its folded position."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=8_000,
+                                        partitions=3)
+    reader = broker.multi_reader(cfg.kafka_topic)
+    stats, runner = run_mode(cfg, mapping, broker, r, "on", reader=reader)
+    assert stats.events == 8_000
+    pos = runner._pipeline.position()
+    assert isinstance(pos, list) and len(pos) == 3
+    # every partition fully consumed: folded position == file sizes
+    sizes = [os.path.getsize(broker.topic_path(cfg.kafka_topic, p))
+             for p in range(3)]
+    assert pos == sizes
+
+
+def test_quiesce_returns_only_folded_offsets(tmp_path):
+    """The checkpoint contract, driven by hand: quiesce() must return
+    the offset of the LAST COMMITTED block — read-ahead and encoded but
+    unfolded items never advance it."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=4_000)
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    reader = broker.reader(cfg.kafka_topic)
+    pipe = IngestPipeline(eng, reader, batch_size=256, chunk_records=512,
+                          catchup=True, block_queue=2, batch_queue=2)
+    try:
+        # before anything folds, the folded position is the start
+        assert pipe.quiesce() == 0
+        pipe.resume()
+        item = None
+        while item is None:
+            item = pipe.get(timeout_s=0.2)
+        assert item is not EOF
+        # got an encoded item but did NOT fold/commit it: still 0
+        assert pipe.quiesce() == 0
+        pipe.resume()
+        eng.fold_batches(item.batches)
+        pipe.commit(item)
+        off = pipe.quiesce()
+        pipe.resume()
+        assert off == item.end_pos > 0
+        # the offset covers exactly the folded block: re-reading from it
+        # yields the REMAINING events (nothing skipped, nothing doubled)
+        with broker.reader(cfg.kafka_topic, offset=off) as check:
+            rest = sum(len(check.poll()) for _ in range(50))
+        assert item.records + rest == 4_000
+    finally:
+        pipe.close()
+        eng.close()
+
+
+def test_checkpoint_resume_with_pipeline_is_exact(tmp_path):
+    """Cut a pipelined run short (max_events), resume a fresh runner
+    from its checkpoint, finish — totals exact, oracle-exact: quiesce
+    offsets never skip an unfolded block."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=12_000,
+                                        jax_ingest_pipeline="on")
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    runner = StreamRunner(eng, broker.reader(cfg.kafka_topic),
+                          checkpointer=ckpt)
+    runner.run_catchup(max_events=6_000)
+    eng.close()
+
+    eng2 = AdAnalyticsEngine(cfg, mapping, redis=r)
+    runner2 = StreamRunner(eng2, broker.reader(cfg.kafka_topic),
+                           checkpointer=ckpt)
+    assert runner2.resume()
+    runner2.run_catchup()
+    eng2.close()
+    assert eng2.events_processed == 12_000
+    correct, differ, missing = gen.check_correct(
+        r, workdir=str(tmp_path), log=lambda s: None)
+    assert differ == 0 and missing == 0 and correct > 0
+
+
+def test_stage_error_propagates_to_host(tmp_path):
+    """A reader-thread failure must surface on the host thread from
+    get(), preserving its type (the supervisor's catch surface)."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=2_000)
+
+    class FailingReader:
+        offset = 0
+
+        def poll(self, max_records=65536):
+            raise ConnectionError("broker gone")
+
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    runner = StreamRunner(eng, FailingReader(), ingest_pipeline="on")
+    with pytest.raises(ConnectionError):
+        runner.run_catchup()
+    eng.close()
+
+
+def test_auto_mode_gates_on_block_mode_and_cores(tmp_path, monkeypatch):
+    """"auto" resolves to the serial loop unless block-mode ingest is
+    available AND the host has more than one core."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=2_000)
+    eng = AdAnalyticsEngine(cfg, mapping, redis=r)
+    runner = StreamRunner(eng, broker.reader(cfg.kafka_topic),
+                          ingest_pipeline="auto")
+    import os as os_mod
+
+    monkeypatch.setattr(os_mod, "cpu_count", lambda: 1)
+    assert not runner._pipeline_on()
+    monkeypatch.setattr(os_mod, "cpu_count", lambda: 8)
+    assert runner._pipeline_on() == eng.supports_block_ingest
+    eng.close()

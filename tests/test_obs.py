@@ -145,6 +145,48 @@ class _StubEngine:
                 "pending_rows": 0}
 
 
+def test_collector_reports_ingest_pipeline_telemetry():
+    """With a live ingest pipeline on the runner, each snapshot carries
+    the stage-health block and mirrors it into streambench_ingest_*
+    registry instruments (ISSUE 3 telemetry wiring)."""
+
+    class _StubPipeline:
+        def telemetry(self):
+            return {"block_queue_depth": 2, "batch_queue_depth": 1,
+                    "reader_stalls": 3, "encode_stalls": 0,
+                    "encode_starved": 5, "dispatch_starved": 1,
+                    "records_read": 100, "records_folded": 90,
+                    "read_ms_total": 1.0, "encode_ms_total": 2.0}
+
+    class _StubStats:
+        batches = 4
+        flushes = 2
+
+    class _StubRunner:
+        _pipeline = _StubPipeline()
+        stats = _StubStats()
+
+    eng = _StubEngine()
+    reg = MetricsRegistry()
+    collect = engine_collector(eng, runner=_StubRunner(), registry=reg)
+    rec: dict = {}
+    collect(rec, 1.0)
+    assert rec["ingest"]["block_queue_depth"] == 2
+    assert rec["ingest"]["reader_stalls"] == 3
+    rendered = reg.render_prometheus()
+    assert "streambench_ingest_block_queue_depth 2" in rendered
+    assert "streambench_ingest_reader_stalls_total 3" in rendered
+    # no pipeline -> no ingest block (the default surface is unchanged)
+    class _PlainRunner:
+        _pipeline = None
+        stats = _StubStats()
+
+    rec2: dict = {}
+    engine_collector(_StubEngine(), runner=_PlainRunner(),
+                     registry=MetricsRegistry())(rec2, 1.0)
+    assert "ingest" not in rec2
+
+
 def test_sampler_snapshots_deltas_and_final(tmp_path):
     eng = _StubEngine()
     reg = MetricsRegistry()
