@@ -150,6 +150,10 @@ class HeadlineEmitter:
             # — the README's evidence contract says every quoted number
             # lives here, and occupancy was stdout-only until r5
             "device": self.headline.get("device"),
+            # per-window latency attribution of the best catchup rep
+            # (obs.lifecycle; STREAMBENCH_BENCH_ATTRIBUTION=1 or a
+            # metrics dir opts in) — the per-stage ms, per WINDOW
+            "attribution": self.headline.get("attribution"),
             "device_occupancy_meas": self.headline.get(
                 "device_occupancy_meas"),
             "trace": self.headline.get("trace"),
@@ -1168,8 +1172,15 @@ def main() -> int:
         metrics_dir = os.environ.get("STREAMBENCH_BENCH_METRICS_DIR")
         if metrics_dir:
             os.makedirs(metrics_dir, exist_ok=True)
+        # Per-window latency attribution (obs.lifecycle): on whenever
+        # telemetry is already journaling, or alone via
+        # STREAMBENCH_BENCH_ATTRIBUTION=1.  Off by default — the stamp
+        # upkeep (np.unique per fold) is small but nonzero, and the
+        # headline throughput must not carry silent instrumentation.
+        want_attr = bool(metrics_dir) or os.environ.get(
+            "STREAMBENCH_BENCH_ATTRIBUTION", "0") == "1"
 
-        best = None  # (value, stats, engine, store, total_s)
+        best = None  # (value, stats, engine, store, total_s, attribution)
         trace_occ = None
         rep_cost_s = 0.0
         for rep in range(reps):
@@ -1194,6 +1205,11 @@ def main() -> int:
                 ingest_pipeline=os.environ.get(
                     "STREAMBENCH_BENCH_INGEST", "").strip().lower() or None)
             obs_sampler = None
+            if want_attr and not metrics_dir:
+                # attribution without a journal: registry only
+                from streambench_tpu.obs import MetricsRegistry
+
+                engine.attach_obs(MetricsRegistry(), lifecycle=True)
             if metrics_dir:
                 from streambench_tpu.obs import (
                     MetricsRegistry,
@@ -1202,7 +1218,7 @@ def main() -> int:
                 )
 
                 obs_reg = MetricsRegistry()
-                engine.attach_obs(obs_reg)
+                engine.attach_obs(obs_reg, lifecycle=want_attr)
                 obs_sampler = MetricsSampler(
                     os.path.join(metrics_dir,
                                  f"bench-metrics-rep{rep + 1}.jsonl"),
@@ -1250,9 +1266,11 @@ def main() -> int:
                         f"{total_s*1e3:.0f} ms wall = "
                         f"{trace_occ['occupancy']:.1%} occupancy")
             rep_cost_s = max(rep_cost_s, total_s)
+            lc = getattr(engine, "_obs_lifecycle", None)
             if best is None or v > best[0]:
-                best = (v, stats, engine, r_rep, total_s)
-        value, stats, engine, r_best, total_s = best
+                best = (v, stats, engine, r_rep, total_s,
+                        lc.summary() if lc is not None else None)
+        value, stats, engine, r_best, total_s, attribution = best
         value = round(value, 1)
         log(f"engine: method={engine.method} W={engine.W} "
             f"B={engine.batch_size} K={engine.scan_batches} "
@@ -1286,6 +1304,7 @@ def main() -> int:
             vs_baseline=round(value / BASELINE_EVENTS_PER_S, 4),
             platform=backend,
             device=device or None,
+            attribution=attribution,
             device_occupancy_meas=round(util, 4) if util else None,
             trace=trace_occ,
             latency_sweep=None,

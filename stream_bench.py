@@ -63,6 +63,15 @@ WINDOW_SLOTS = int(os.environ.get("WINDOW_SLOTS", "16"))
 ENCODE_WORKERS = int(os.environ.get("ENCODE_WORKERS", "1"))
 # Staged ingest pipeline (engine/ingest.py): off | on | auto
 INGEST_PIPELINE = os.environ.get("INGEST_PIPELINE", "off")
+# Observability knobs (obs/; README "Observability") — all default-off:
+# METRICS_INTERVAL_MS>0 journals <workdir>/metrics.jsonl at that cadence,
+# OBS_LIFECYCLE=1 adds per-window latency attribution to it (read with
+# `python -m streambench_tpu.obs attribution`), FLIGHTREC=1 arms the
+# crash flight recorder (<workdir>/flight_<reason>.jsonl on failure).
+METRICS_INTERVAL_MS = int(os.environ.get("METRICS_INTERVAL_MS", "0"))
+OBS_LIFECYCLE = os.environ.get("OBS_LIFECYCLE", "") not in (
+    "", "0", "false", "no")
+FLIGHTREC = os.environ.get("FLIGHTREC", "") not in ("", "0", "false", "no")
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -231,6 +240,9 @@ def op_setup() -> None:
         "jax.window.slots": WINDOW_SLOTS,
         "jax.encode.workers": ENCODE_WORKERS,
         "jax.ingest.pipeline": INGEST_PIPELINE,
+        "jax.metrics.interval.ms": METRICS_INTERVAL_MS,
+        "jax.obs.lifecycle": OBS_LIFECYCLE,
+        "jax.obs.flightrec.enabled": FLIGHTREC,
     })
     log(f"wrote {CONF_FILE}")
     try:

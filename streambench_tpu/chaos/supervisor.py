@@ -83,7 +83,8 @@ class Supervisor:
                  catch: tuple = (EngineCrash, ConnectionError, TimeoutError),
                  sleep=time.sleep,
                  counters: FaultCounters | None = None,
-                 sampler=None):
+                 sampler=None,
+                 flightrec=None):
         self.make_runner = make_runner
         # Telemetry annotation hook (obs.MetricsSampler or anything with
         # ``annotate(event, **fields)``): crash/restart/give-up events
@@ -91,6 +92,13 @@ class Supervisor:
         # supervised run's time series shows WHEN each restart happened
         # against the throughput/backlog curves, not just how many.
         self.sampler = sampler
+        # Crash flight recorder (obs.flightrec or None): crash/restart
+        # annotations land in the postmortem ring next to the runners'
+        # tick records (share ONE recorder with make_runner's runners so
+        # the sequence numbers interleave in true order), and a give-up
+        # dumps ``flight_give_up.jsonl`` with the terminal fault last —
+        # the black box of a chaos sweep that died for good.
+        self.flightrec = flightrec
         self.max_no_progress_restarts = max(int(max_no_progress_restarts), 1)
         self.backoff_base_ms = max(float(backoff_base_ms), 0.0)
         self.backoff_cap_ms = max(float(backoff_cap_ms), self.backoff_base_ms)
@@ -174,6 +182,11 @@ class Supervisor:
                     self.sampler.annotate(
                         "crash", attempt=st.attempts, error=repr(e),
                         crash_offset=prev_crash_offset)
+                if self.flightrec is not None:
+                    self.flightrec.record(
+                        "supervisor", event="crash",
+                        attempt=st.attempts, error=repr(e),
+                        crash_offset=prev_crash_offset)
                 # DURABLE progress only: the checkpoint the next attempt
                 # will resume from.  Work a crashed attempt did but never
                 # snapshotted is not progress — counting it would let a
@@ -192,6 +205,14 @@ class Supervisor:
                         self.sampler.annotate(
                             "give_up", attempts=st.attempts,
                             crashes=st.crashes, no_progress=no_progress)
+                    if self.flightrec is not None:
+                        self.flightrec.dump("give_up", terminal={
+                            "kind": "fault", "event": "give_up",
+                            "error": st.errors[-1] if st.errors else None,
+                            "attempts": st.attempts,
+                            "crashes": st.crashes,
+                            "no_progress": no_progress,
+                            "durable_progress": progress})
                     return st
                 consecutive_crashes += 1
                 back = self._backoff(consecutive_crashes)
@@ -202,6 +223,11 @@ class Supervisor:
                     self.sampler.annotate(
                         "restart", restarts=st.restarts,
                         backoff_ms=round(back, 1),
+                        durable_progress=progress)
+                if self.flightrec is not None:
+                    self.flightrec.record(
+                        "supervisor", event="restart",
+                        restarts=st.restarts, backoff_ms=round(back, 1),
                         durable_progress=progress)
                 if back > 0:
                     self._sleep(back / 1000.0)

@@ -140,6 +140,22 @@ class BenchmarkConfig:
     jax_metrics_port: int = -1             # >=0 serves a localhost Prometheus
     #   text-exposition endpoint (0 = OS-assigned ephemeral port, printed
     #   at startup); <0 = no endpoint
+    jax_metrics_max_bytes: int = 0         # >0 caps metrics.jsonl: a record
+    #   that would push past it rotates the file to metrics.jsonl.1 first,
+    #   so a week-long chaos sweep holds <= ~2x this on disk (0 = unbounded)
+    # --- window-lifecycle attribution + crash flight recorder (obs/;
+    # ISSUE 4 — both default-off: the serial hot path stays byte-identical
+    # when neither is asked for) ---
+    jax_obs_lifecycle: bool = False        # stamp each window's journey
+    #   (first read, last encode, fold, flush submit, sink ack) and
+    #   decompose its YSB latency into ingest/encode/fold/flush/sink
+    #   segment histograms ("attribution" in metrics.jsonl;
+    #   `python -m streambench_tpu.obs attribution` renders them)
+    jax_obs_flightrec: bool = False        # feed a bounded postmortem ring
+    #   (runner ticks, checkpoint offsets, ingest stalls, supervisor
+    #   annotations) dumped to <workdir>/flight_<reason>.jsonl on crash,
+    #   give_up, fatal exception, or SIGTERM
+    jax_obs_flightrec_capacity: int = 512  # flight-ring record capacity
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -254,6 +270,11 @@ class BenchmarkConfig:
             jax_deadletter_enabled=getb("jax.deadletter.enabled", False),
             jax_metrics_interval_ms=geti("jax.metrics.interval.ms", 0),
             jax_metrics_port=geti("jax.metrics.port", -1),
+            jax_metrics_max_bytes=geti("jax.metrics.max.bytes", 0),
+            jax_obs_lifecycle=getb("jax.obs.lifecycle", False),
+            jax_obs_flightrec=getb("jax.obs.flightrec.enabled", False),
+            jax_obs_flightrec_capacity=max(
+                geti("jax.obs.flightrec.capacity", 512), 8),
             raw=dict(conf),
         )
 

@@ -181,12 +181,29 @@ def main(argv: list[str] | None = None) -> int:
     # reference likewise subscribe to all of ad-events)
     reader = (broker.multi_reader(cfg.kafka_topic) if n_parts > 1
               else broker.reader(cfg.kafka_topic))
-    runner = StreamRunner(engine, reader, checkpointer=checkpointer)
+    # Crash flight recorder (obs.flightrec, default-off): a bounded ring
+    # the runner/ingest stages feed at flush cadence, dumped to
+    # <workdir>/flight_<reason>.jsonl on crash, fatal exception, or
+    # SIGTERM — the run's black box when there is no exit stats line.
+    flightrec = None
+    if cfg.jax_obs_flightrec:
+        from streambench_tpu.obs import FlightRecorder
+
+        flightrec = FlightRecorder(
+            args.workdir, capacity=cfg.jax_obs_flightrec_capacity)
+    runner = StreamRunner(engine, reader, checkpointer=checkpointer,
+                          flightrec=flightrec)
     if runner.resume():
         print(f"resumed from checkpoint: offset={runner._reader_position()} "
               f"events={engine.events_processed}", flush=True)
 
-    signal.signal(signal.SIGTERM, lambda *_: runner.stop())
+    def _on_sigterm(*_):
+        if flightrec is not None:
+            flightrec.record("signal", event="sigterm")
+            flightrec.dump("sigterm")
+        runner.stop()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     signal.signal(signal.SIGINT, lambda *_: runner.stop())
 
     # Pre-compile every device program (single step, all scan group
@@ -206,7 +223,8 @@ def main(argv: list[str] | None = None) -> int:
     # (0 = ephemeral, the chosen port is printed below so harnesses and
     # the smoke test can scrape without a race).
     sampler = metrics_server = None
-    if cfg.jax_metrics_interval_ms > 0 or cfg.jax_metrics_port >= 0:
+    if (cfg.jax_metrics_interval_ms > 0 or cfg.jax_metrics_port >= 0
+            or cfg.jax_obs_lifecycle):
         from streambench_tpu.obs import (
             MetricsRegistry,
             MetricsSampler,
@@ -215,12 +233,17 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         registry = MetricsRegistry()
-        engine.attach_obs(registry)
+        # jax.obs.lifecycle additionally attaches the per-window
+        # attribution tracker (and, set alone, turns the sampler on at
+        # its default cadence — attribution with no journal to land in
+        # would be pointless)
+        engine.attach_obs(registry, lifecycle=cfg.jax_obs_lifecycle)
         metrics_path = os.path.join(args.workdir, "metrics.jsonl")
         sampler = MetricsSampler(
             metrics_path,
             interval_ms=cfg.jax_metrics_interval_ms or 1000,
-            registry=registry)
+            registry=registry,
+            max_bytes=cfg.jax_metrics_max_bytes)
         sampler.add_collector(engine_collector(
             engine, reader=reader, runner=runner, registry=registry))
         sampler.start()

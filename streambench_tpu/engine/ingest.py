@@ -45,6 +45,8 @@ import queue
 import threading
 import time
 
+from streambench_tpu.utils.ids import now_ms
+
 
 class _Sentinel:
     def __init__(self, name: str) -> None:
@@ -67,15 +69,20 @@ class IngestItem:
     ``end_pos`` is the reader position (scalar offset, or the offsets
     vector of a ``MultiReader``) immediately after the reads that formed
     this item — the value ``commit`` publishes as the folded position.
+    ``read_ms`` is the wall stamp of the FIRST read that contributed
+    (obs.lifecycle attribution: with read-ahead the gap between reading
+    and encoding is real, so the stamp must travel with the item).
     """
 
-    __slots__ = ("payload", "records", "end_pos", "batches")
+    __slots__ = ("payload", "records", "end_pos", "batches", "read_ms")
 
-    def __init__(self, payload, records: int, end_pos) -> None:
+    def __init__(self, payload, records: int, end_pos,
+                 read_ms: "int | None" = None) -> None:
         self.payload = payload
         self.records = records
         self.end_pos = end_pos
         self.batches: list = []
+        self.read_ms = read_ms
 
 
 class IngestPipeline:
@@ -104,9 +111,13 @@ class IngestPipeline:
                  est_event_bytes: int = 256,
                  block_queue: int = 4,
                  batch_queue: int = 4,
-                 poll_interval_s: float = 0.001) -> None:
+                 poll_interval_s: float = 0.001,
+                 flightrec=None) -> None:
         self.engine = engine
         self.reader = reader
+        # crash flight recorder (obs.flightrec or None): stage errors
+        # and first-stall events land in the postmortem ring
+        self.flightrec = flightrec
         self.batch_size = max(int(batch_size), 1)
         self.chunk_records = max(int(chunk_records), self.batch_size)
         self.buffer_timeout_ms = buffer_timeout_ms
@@ -159,6 +170,10 @@ class IngestPipeline:
         """Record a stage failure for the host to re-raise from get()."""
         if self._error is None:
             self._error = err
+        if self.flightrec is not None:
+            self.flightrec.record("ingest_error", error=repr(err),
+                                  block_queue=self._block_q.qsize(),
+                                  batch_queue=self._batch_q.qsize())
         self._stop.set()
 
     def _put(self, q: queue.Queue, item, counter: str | None) -> bool:
@@ -173,6 +188,11 @@ class IngestPipeline:
                 if not stalled and counter is not None:
                     stalled = True
                     setattr(self, counter, getattr(self, counter) + 1)
+                    if self.flightrec is not None:
+                        self.flightrec.record(
+                            "ingest_stall", stage=counter,
+                            block_queue=self._block_q.qsize(),
+                            batch_queue=self._batch_q.qsize())
         return False
 
     # -- stage 1: reader ----------------------------------------------
@@ -218,7 +238,8 @@ class IngestPipeline:
             pos = self._position()
             self.records_read += got
             self.last_data_ts = time.monotonic()
-            if not self._put(self._block_q, IngestItem(data, got, pos),
+            if not self._put(self._block_q,
+                             IngestItem(data, got, pos, read_ms=now_ms()),
                              "reader_stalls"):
                 return
 
@@ -230,6 +251,7 @@ class IngestPipeline:
         pending: list = []
         pending_n = 0
         pending_since: float | None = None
+        pending_read_ms: int | None = None   # first-read wall stamp
         pending_end = self._folded_pos
         target = self.batch_size
         while not self._stop.is_set():
@@ -245,6 +267,7 @@ class IngestPipeline:
                     self.last_data_ts = now
                     if pending_since is None:
                         pending_since = now
+                        pending_read_ms = now_ms()
                     pending_n += got
                     if self.block_mode:
                         pending.append(data)
@@ -266,8 +289,10 @@ class IngestPipeline:
                             or finishing):
                 payload = (b"".join(pending) if self.block_mode
                            else pending)
-                item = IngestItem(payload, pending_n, pending_end)
+                item = IngestItem(payload, pending_n, pending_end,
+                                  read_ms=pending_read_ms)
                 pending, pending_n, pending_since = [], 0, None
+                pending_read_ms = None
                 if not self._put(self._block_q, item, "reader_stalls"):
                     return
             elif finishing:
@@ -298,6 +323,16 @@ class IngestPipeline:
                             item.payload)
                 item.payload = None   # free the raw bytes early
                 self.encode_ms_total += (time.perf_counter() - t0) * 1e3
+                if item.read_ms is not None and item.batches:
+                    # attribution stamps (obs.lifecycle): the engine's
+                    # encode halves default the read stamp to encode
+                    # time; with read-ahead the TRUE read time is the
+                    # item's — override so ingest_ms/encode_ms split at
+                    # the real boundary
+                    lc = getattr(self.engine, "_obs_lifecycle", None)
+                    if lc is not None:
+                        for b in item.batches:
+                            b._lc_read_ms = item.read_ms
                 if not self._put(self._batch_q, item, "encode_stalls"):
                     return
         except BaseException as e:

@@ -402,8 +402,11 @@ class AdAnalyticsEngine:
         self.faults = FaultCounters()
         # live telemetry (obs/): None until attach_obs — the default
         # engine pays nothing for the observability layer beyond this
-        # attribute and one None check per flush writeback
+        # attribute and one None check per flush writeback.  The
+        # lifecycle tracker (obs.lifecycle, per-window latency
+        # attribution) is likewise None unless attach_obs opted in.
         self._obs_hist = None
+        self._obs_lifecycle = None
         self._writer: _RedisWriter | None = None
         # Parallel encode pool (multi-core hosts): per-thread encoders,
         # sound only for engines whose kernel never reads the interned
@@ -550,13 +553,16 @@ class AdAnalyticsEngine:
                 encoded = self._encode_pool.encode_chunks(
                     [lines[off:off + B] for off in range(0, len(lines), B)],
                     B)
-            return [b for b in encoded if b.n]
-        batches = []
-        for off in range(0, len(lines), B):
-            with self.tracer.span("encode"):
-                b = self._encode(lines[off:off + B], B)
-            if b.n:
-                batches.append(b)
+            batches = [b for b in encoded if b.n]
+        else:
+            batches = []
+            for off in range(0, len(lines), B):
+                with self.tracer.span("encode"):
+                    b = self._encode(lines[off:off + B], B)
+                if b.n:
+                    batches.append(b)
+        if self._obs_lifecycle is not None:
+            self._obs_lifecycle.stamp_encoded(batches)
         return batches
 
     def fold_batches(self, batches: list) -> int:
@@ -719,6 +725,8 @@ class AdAnalyticsEngine:
                 b = self._encode([data[start:]], B)
                 if b.n:
                     batches.append(b)
+        if self._obs_lifecycle is not None:
+            self._obs_lifecycle.stamp_encoded(batches)
         return batches
 
     def _fold(self, batch) -> None:
@@ -767,6 +775,10 @@ class AdAnalyticsEngine:
         Updating before dispatch let the host run ahead of the device
         and a drain's span recompute treat still-open ring slots as
         closed."""
+        if self._obs_lifecycle is not None:
+            # attribution hook (obs.lifecycle): this batch's windows
+            # just folded — record its read/encode stamps + fold time
+            self._obs_lifecycle.note_fold(batch)
         v = batch.valid[:batch.n]
         if not v.any():
             return
@@ -1157,6 +1169,14 @@ class AdAnalyticsEngine:
                 rows.extend(zip((campaigns[c] for c in ci.tolist()),
                                 ts_a.tolist(), cnt.tolist()))
         self._pending_np.clear()
+        if self._obs_lifecycle is not None:
+            # attribution hook: these windows' rows are leaving for the
+            # sink writer NOW — everything before this stamp is device/
+            # pending residency (flush_ms), everything after is sink_ms
+            ts_out = [ts for _, ts, _ in rows]
+            if arrays is not None:
+                ts_out.extend(np.unique(arrays.ts).tolist())
+            self._obs_lifecycle.note_flush(ts_out)
         total = len(rows) + (len(arrays) if arrays is not None else 0)
         if self.redis is not None:
             if self._writer is None:
@@ -1208,6 +1228,8 @@ class AdAnalyticsEngine:
             if self._obs_hist is not None:
                 for t in uniq:
                     self._obs_hist.observe(stamp - t)
+            if self._obs_lifecycle is not None:
+                self._obs_lifecycle.note_written(uniq, stamp)
             self.latency_tracker.record_bulk(
                 payload.ci, payload.ts, stamp, payload.campaigns)
             return
@@ -1215,9 +1237,13 @@ class AdAnalyticsEngine:
         for camp, ts, _ in payload:
             self.window_latency[ts] = stamp - ts
             self.latency_tracker.record(camp, ts, stamp)
-        if self._obs_hist is not None:
-            for ts in {ts for _, ts, _ in payload}:
-                self._obs_hist.observe(stamp - ts)
+        if self._obs_hist is not None or self._obs_lifecycle is not None:
+            uniq = {ts for _, ts, _ in payload}
+            if self._obs_hist is not None:
+                for ts in uniq:
+                    self._obs_hist.observe(stamp - ts)
+            if self._obs_lifecycle is not None:
+                self._obs_lifecycle.note_written(uniq, stamp)
 
     def _reclaim_failed_writes(self) -> None:
         """Fold failed writeback batches back into ``_pending`` so the
@@ -1239,16 +1265,29 @@ class AdAnalyticsEngine:
     # live telemetry (obs/): both hooks are pull-oriented — the sampler
     # thread polls host-side bookkeeping; the only pushed signal is the
     # writeback-latency histogram fed from the writer thread.
-    def attach_obs(self, registry) -> None:
+    def attach_obs(self, registry, lifecycle: bool = False) -> None:
         """Opt into live telemetry: register the window-latency streaming
         histogram on ``registry`` (obs.MetricsRegistry) so p50/p95/p99
         writeback latency is queryable *during* the run — the live
         complement of the exact close-time decile table.  Never called
         on the default path; everything else the sampler needs it pulls
-        via ``telemetry()``."""
+        via ``telemetry()``.
+
+        ``lifecycle=True`` additionally attaches the per-window
+        attribution tracker (obs.lifecycle): encode stamps ride the
+        batches, the watermark-note hook records folds, and each
+        writeback decomposes its latency into
+        ingest/encode/fold/flush/sink segment histograms on the same
+        registry."""
         self._obs_hist = registry.histogram(
             "streambench_window_latency_ms",
             "window writeback latency (time_updated - window_ts), ms")
+        if lifecycle:
+            from streambench_tpu.obs.lifecycle import WindowLifecycle
+
+            self._obs_lifecycle = WindowLifecycle(
+                registry, divisor_ms=self.divisor,
+                lateness_ms=self.lateness)
 
     def telemetry(self) -> dict:
         """Point-in-time observability snapshot of host bookkeeping.

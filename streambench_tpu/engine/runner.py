@@ -61,7 +61,8 @@ class StreamRunner:
                  checkpointer: Checkpointer | None = None,
                  checkpoint_interval_ms: int | None = None,
                  crash_points=None,
-                 ingest_pipeline: str | None = None):
+                 ingest_pipeline: str | None = None,
+                 flightrec=None):
         cfg = engine.cfg
         self.engine = engine
         self.reader = reader
@@ -100,6 +101,12 @@ class StreamRunner:
                 else getattr(cfg, "jax_ingest_pipeline", "off"))
         self.ingest_mode = (mode or "off").strip().lower()
         self._pipeline = None   # the live IngestPipeline during a run
+        # Crash flight recorder (obs.flightrec or None): fed a "tick"
+        # record at every flush cycle + checkpoint offsets, and dumped
+        # with the terminal fault when a run loop dies.  None (the
+        # default) costs one attribute check per flush.
+        self.flightrec = flightrec
+        self._flight_prev_faults: dict = {}
 
     def stop(self) -> None:
         self._stop = True
@@ -107,6 +114,55 @@ class StreamRunner:
     def _chaos_point(self, kind: str) -> None:
         if self.crash_points is not None:
             self.crash_points.point(kind)
+
+    # ------------------------------------------------------------------
+    # crash flight recorder (obs.flightrec)
+    def _flight_tick(self) -> None:
+        """One structured sample into the flight ring (flush cadence):
+        progress counters, watermark lag, sink health, fault deltas,
+        and — when the staged pipeline is live — its queue depths."""
+        fr = self.flightrec
+        if fr is None:
+            return
+        tel = self.engine.telemetry()
+        rec = {"events": tel["events"],
+               "windows_written": tel["windows_written"],
+               "watermark_lag_ms": tel["watermark_lag_ms"],
+               "pending_rows": tel["pending_rows"],
+               "sink_dirty_rows": tel["sink_dirty_rows"],
+               "batches": self.stats.batches,
+               "flushes": self.stats.flushes}
+        faults = self.engine.faults.snapshot()
+        deltas = {k: v - self._flight_prev_faults.get(k, 0)
+                  for k, v in faults.items()
+                  if v != self._flight_prev_faults.get(k, 0)}
+        self._flight_prev_faults = faults
+        if deltas:
+            rec["fault_deltas"] = deltas
+        pipe = self._pipeline
+        if pipe is not None and not pipe.closed:
+            ing = pipe.telemetry()
+            rec["ingest"] = {k: ing[k] for k in
+                             ("block_queue_depth", "batch_queue_depth",
+                              "reader_stalls", "encode_stalls")}
+        fr.record("tick", **rec)
+
+    def _flight_crash(self, err: BaseException) -> None:
+        """A run loop died: freeze the ring with the terminal fault as
+        the last record — the black box every chaos-sweep failure
+        leaves behind instead of a bare traceback."""
+        fr = self.flightrec
+        if fr is None:
+            return
+        try:
+            offset = self._reader_position()
+        except Exception:
+            offset = None
+        fr.dump("crash", terminal={
+            "kind": "fault", "event": "crash", "error": repr(err),
+            "offset": offset, "events": self.stats.events,
+            "batches": self.stats.batches,
+            "flushes": self.stats.flushes})
 
     def _collect_faults(self) -> None:
         """Surface fault/retry accounting in ``stats.faults`` (end of a
@@ -173,8 +229,11 @@ class StreamRunner:
             finally:
                 pipe.resume()
         else:
-            self.checkpointer.save(
-                self.engine.snapshot(self._reader_position()))
+            off = self._reader_position()
+            self.checkpointer.save(self.engine.snapshot(off))
+        if self.flightrec is not None:
+            self.flightrec.record("checkpoint", offset=off,
+                                  events=self.engine.events_processed)
         self._last_ckpt = now
         self._chaos_point("checkpoint")
 
@@ -217,7 +276,8 @@ class StreamRunner:
             catchup=catchup,
             est_event_bytes=self.EST_EVENT_BYTES,
             block_queue=getattr(cfg, "jax_ingest_block_queue", 4),
-            batch_queue=getattr(cfg, "jax_ingest_batch_queue", 4))
+            batch_queue=getattr(cfg, "jax_ingest_batch_queue", 4),
+            flightrec=self.flightrec)
         self._pipeline = pipe
         return pipe
 
@@ -239,6 +299,7 @@ class StreamRunner:
             st.windows_written += self.engine.flush()
             st.flushes += 1
             self.stall_detector.tick(int(time.monotonic() * 1000))
+            self._flight_tick()
             last_flush = now
             self._chaos_point("flush")
             if self._checkpoint_due(now):
@@ -250,6 +311,7 @@ class StreamRunner:
         st = self.stats
         st.windows_written += self.engine.flush(final=True)
         st.flushes += 1
+        self._flight_tick()   # short runs still leave ring context
         self._chaos_point("flush")
         if self.checkpointer is not None:
             self._checkpoint_now(time.monotonic())
@@ -336,7 +398,18 @@ class StreamRunner:
     def run(self, duration_s: float | None = None,
             idle_timeout_s: float | None = None,
             max_events: int | None = None) -> RunStats:
-        """Consume until stopped / duration / idle-timeout / max_events."""
+        """Consume until stopped / duration / idle-timeout / max_events.
+        A loop that dies leaves its flight-recorder black box (when one
+        is attached) before the exception propagates."""
+        try:
+            return self._run(duration_s, idle_timeout_s, max_events)
+        except BaseException as e:
+            self._flight_crash(e)
+            raise
+
+    def _run(self, duration_s: float | None,
+             idle_timeout_s: float | None,
+             max_events: int | None) -> RunStats:
         if self._pipeline_on():
             return self._run_pipelined(duration_s, idle_timeout_s,
                                        max_events)
@@ -452,6 +525,7 @@ class StreamRunner:
                 st.windows_written += self.engine.flush()
                 st.flushes += 1
                 self.stall_detector.tick(int(time.monotonic() * 1000))
+                self._flight_tick()
                 last_flush = now
                 self._chaos_point("flush")
                 if self._checkpoint_due(now):
@@ -461,6 +535,7 @@ class StreamRunner:
             dispatch()
         st.windows_written += self.engine.flush(final=True)
         st.flushes += 1
+        self._flight_tick()   # short runs still leave ring context
         self._chaos_point("flush")
         if self.checkpointer is not None:
             self._checkpoint_now(time.monotonic())
@@ -472,6 +547,13 @@ class StreamRunner:
         """Drain the journal as fast as possible (catchup/throughput mode):
         scan-chunked batches, no buffer timeout, flush only on ring-span
         guard + once per second of wall clock."""
+        try:
+            return self._run_catchup(max_events)
+        except BaseException as e:
+            self._flight_crash(e)
+            raise
+
+    def _run_catchup(self, max_events: int | None) -> RunStats:
         if self._pipeline_on():
             return self._run_catchup_pipelined(max_events)
         st = self.stats
@@ -506,12 +588,14 @@ class StreamRunner:
                 st.windows_written += self.engine.flush()
                 st.flushes += 1
                 self.stall_detector.tick(int(time.monotonic() * 1000))
+                self._flight_tick()
                 last_flush = now
                 self._chaos_point("flush")
                 if self._checkpoint_due(now):
                     self._checkpoint_now(now)
         st.windows_written += self.engine.flush(final=True)
         st.flushes += 1
+        self._flight_tick()   # short runs still leave ring context
         self._chaos_point("flush")
         if self.checkpointer is not None:
             self._checkpoint_now(time.monotonic())

@@ -11,6 +11,12 @@ recorder).  Four pieces, all stdlib, all default-off:
   (``jax.metrics.port``)
 - ``report``    — ``python -m streambench_tpu.obs`` renders a run
   report from ``metrics.jsonl`` and diffs two runs
+- ``lifecycle`` — per-window latency attribution: the YSB latency
+  decomposed into ingest/encode/fold/flush/sink segments
+  (``jax.obs.lifecycle``; ``python -m streambench_tpu.obs attribution``)
+- ``flightrec`` — bounded crash flight recorder dumping
+  ``flight_<reason>.jsonl`` on crash/give_up/SIGTERM
+  (``jax.obs.flightrec.enabled``)
 
 Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
 > 0 and/or ``jax.metrics.port`` >= 0); embed via::
@@ -25,7 +31,9 @@ Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
     server = MetricsServer(registry, port=0, refresh=sampler.collect_now)
 """
 
+from streambench_tpu.obs.flightrec import FlightRecorder  # noqa: F401
 from streambench_tpu.obs.httpd import MetricsServer  # noqa: F401
+from streambench_tpu.obs.lifecycle import WindowLifecycle  # noqa: F401
 from streambench_tpu.obs.registry import (  # noqa: F401
     Counter,
     Gauge,
@@ -36,4 +44,5 @@ from streambench_tpu.obs.sampler import (  # noqa: F401
     MetricsSampler,
     engine_collector,
     rss_bytes,
+    rss_sample,
 )

@@ -2,12 +2,15 @@
 
     python -m streambench_tpu.obs report RUN/metrics.jsonl
     python -m streambench_tpu.obs diff  A/metrics.jsonl B/metrics.jsonl
+    python -m streambench_tpu.obs attribution RUN/metrics.jsonl [B/metrics.jsonl]
 
 ``report`` renders one run's time series as a summary (throughput,
 live-latency percentiles, backlog/watermark/RSS maxima, fault counters,
 stage totals, annotations); ``diff`` lines two runs up with absolute and
-relative deltas.  ``--json`` emits the summary dict(s) instead, for
-harness consumption.
+relative deltas; ``attribution`` renders the per-window latency
+attribution (obs.lifecycle: ingest/encode/fold/flush/sink segment
+percentiles and shares), diffing A/B when a second path is given.
+``--json`` emits the summary dict(s) instead, for harness consumption.
 """
 
 from __future__ import annotations
@@ -18,9 +21,12 @@ import sys
 
 from streambench_tpu.obs.report import (
     load_records,
+    render_attribution,
+    render_attribution_diff,
     render_diff,
     render_report,
     summarize,
+    summarize_attribution,
 )
 
 
@@ -36,6 +42,14 @@ def build_parser() -> argparse.ArgumentParser:
     dif.add_argument("path_b")
     dif.add_argument("--json", action="store_true",
                      help="emit both summary dicts instead of text")
+    att = sub.add_parser(
+        "attribution",
+        help="per-window latency attribution (segment table; give a "
+             "second path to diff B vs A)")
+    att.add_argument("path")
+    att.add_argument("path_b", nargs="?", default=None)
+    att.add_argument("--json", action="store_true",
+                     help="emit the attribution dict(s) instead of text")
     return p
 
 
@@ -45,6 +59,17 @@ def main(argv: list[str] | None = None) -> int:
         if args.cmd == "report":
             s = summarize(load_records(args.path), path=args.path)
             print(json.dumps(s) if args.json else render_report(s))
+        elif args.cmd == "attribution":
+            a = summarize_attribution(load_records(args.path),
+                                      path=args.path)
+            if args.path_b:
+                b = summarize_attribution(load_records(args.path_b),
+                                          path=args.path_b)
+                print(json.dumps({"a": a, "b": b}) if args.json
+                      else render_attribution_diff(a, b))
+            else:
+                print(json.dumps(a) if args.json
+                      else render_attribution(a))
         else:
             a = summarize(load_records(args.path_a), path=args.path_a)
             b = summarize(load_records(args.path_b), path=args.path_b)

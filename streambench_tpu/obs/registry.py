@@ -200,7 +200,13 @@ class StreamingHistogram:
 
     def summary(self) -> dict:
         """Point-in-time {count, sum, min, max, p50, p95, p99} dict —
-        the shape the sampler journals every tick."""
+        the shape the sampler journals every tick.  Empty histograms
+        emit ``{"count": 0}`` alone: NaN percentiles would round-trip
+        through JSON as the non-standard ``NaN`` token (or crash strict
+        parsers), and a reader must not mistake "no samples" for "zero
+        latency"."""
+        if self.count == 0:
+            return {"count": 0}
         p50, p95, p99 = self.quantiles((0.5, 0.95, 0.99))
         with self._lock:
             return {"count": self._count, "sum": round(self._sum, 3),

@@ -72,6 +72,9 @@ def summarize(records: list[dict], path: str = "") -> dict:
         "watermark_lag_ms_max": col_max("watermark_lag_ms"),
         "sink_dirty_rows_max": col_max("sink_dirty_rows"),
         "rss_bytes_max": col_max("rss_bytes"),
+        # the ru_maxrss fallback path journals PEAK rss under its own
+        # key (obs.sampler.rss_sample) — keep the two apart here too
+        "rss_peak_bytes_max": col_max("rss_peak_bytes"),
         "latency_ms": latency,
         "faults": last.get("faults") or {},
         "stages": stages,
@@ -106,6 +109,7 @@ _SCALAR_ROWS = (
     ("watermark lag ms max", "watermark_lag_ms_max"),
     ("sink dirty rows max", "sink_dirty_rows_max"),
     ("rss bytes max", "rss_bytes_max"),
+    ("rss PEAK bytes max", "rss_peak_bytes_max"),
 )
 
 
@@ -141,6 +145,94 @@ def render_report(s: dict) -> str:
                          f"{a.get('event')} {extras or ''}".rstrip())
     if s.get("run_stats"):
         lines.append(f"  run_stats: {json.dumps(s['run_stats'])}")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------------
+# per-window latency attribution (obs.lifecycle): the "attribution"
+# block each snapshot carries, summarized from the run's last word
+def summarize_attribution(records: list[dict], path: str = "") -> dict:
+    """Pull the newest ``attribution`` block out of one run's records
+    (the final record normally carries the complete picture; a torn
+    tail falls back to the last intact snapshot)."""
+    att = None
+    for r in reversed(records):
+        if isinstance(r.get("attribution"), dict):
+            att = r["attribution"]
+            break
+    return {"path": path, "attribution": att}
+
+
+def _p50(summary: "dict | None") -> float:
+    v = (summary or {}).get("p50")
+    return float(v) if isinstance(v, (int, float)) else 0.0
+
+
+def render_attribution(s: dict) -> str:
+    """One run's segment table: counts, percentiles, and each segment's
+    share of the summed p50 — where the window latency actually went."""
+    att = s.get("attribution")
+    lines = [f"window latency attribution: {s['path'] or '(records)'}"]
+    if not att:
+        lines.append("  no attribution records "
+                     "(run with jax.obs.lifecycle: true)")
+        return "\n".join(lines)
+    lines.append(f"  writes observed        {_fmt(att.get('writes_observed'))}")
+    lines.append(f"  writes untracked       {_fmt(att.get('writes_untracked'))}")
+    lines.append(f"  open windows           {_fmt(att.get('open_windows'))}")
+    if att.get("windows_evicted"):
+        lines.append(f"  windows evicted        "
+                     f"{_fmt(att['windows_evicted'])}")
+    segs = att.get("segments") or {}
+    p50_sum = sum(_p50(v) for v in segs.values())
+    lines.append(f"  {'segment':<10} {'count':>8} {'p50_ms':>12} "
+                 f"{'p95_ms':>12} {'p99_ms':>12} {'share':>7}")
+    for name, summ in segs.items():
+        share = (f"{_p50(summ) / p50_sum * 100:.1f}%" if p50_sum else "-")
+        lines.append(
+            f"  {name:<10} {_fmt(summ.get('count') or 0):>8} "
+            f"{_fmt(summ.get('p50')):>12} {_fmt(summ.get('p95')):>12} "
+            f"{_fmt(summ.get('p99')):>12} {share:>7}")
+    e2e = att.get("e2e_ms") or {}
+    lines.append(f"  {'e2e':<10} {_fmt(e2e.get('count') or 0):>8} "
+                 f"{_fmt(e2e.get('p50')):>12} {_fmt(e2e.get('p95')):>12} "
+                 f"{_fmt(e2e.get('p99')):>12}")
+    if _p50(e2e):
+        cov = p50_sum / _p50(e2e) * 100
+        lines.append(f"  segment p50 sum {p50_sum:,.1f} ms = {cov:.1f}% "
+                     "of e2e p50")
+    return "\n".join(lines)
+
+
+def render_attribution_diff(a: dict, b: dict) -> str:
+    """Two runs' segment p50/p99 side by side (B vs A) — which stage a
+    perf change actually moved."""
+    lines = ["attribution diff:",
+             f"  A: {a['path']}",
+             f"  B: {b['path']}"]
+    aa, ab = a.get("attribution") or {}, b.get("attribution") or {}
+    if not aa or not ab:
+        lines.append("  missing attribution records in "
+                     + ("both runs" if not (aa or ab)
+                        else ("A" if not aa else "B")))
+        return "\n".join(lines)
+    lines.append(f"  {'segment':<10} {'A p50':>12} {'B p50':>12} "
+                 f"{'delta':>12} {'A p99':>12} {'B p99':>12}")
+    segs = list((aa.get("segments") or {}).keys())
+    for extra in (ab.get("segments") or {}):
+        if extra not in segs:
+            segs.append(extra)
+    rows = [(name, (aa.get("segments") or {}).get(name),
+             (ab.get("segments") or {}).get(name)) for name in segs]
+    rows.append(("e2e", aa.get("e2e_ms"), ab.get("e2e_ms")))
+    for name, sa, sb in rows:
+        pa, pb = _p50(sa), _p50(sb)
+        lines.append(
+            f"  {name:<10} {_fmt((sa or {}).get('p50')):>12} "
+            f"{_fmt((sb or {}).get('p50')):>12} "
+            f"{_fmt(round(pb - pa, 3)):>12} "
+            f"{_fmt((sa or {}).get('p99')):>12} "
+            f"{_fmt((sb or {}).get('p99')):>12}")
     return "\n".join(lines)
 
 

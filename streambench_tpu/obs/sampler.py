@@ -36,18 +36,34 @@ import time
 from streambench_tpu.utils.ids import now_ms
 
 
-def rss_bytes() -> int | None:
-    """Resident set size of this process, or None when unreadable."""
+def rss_sample() -> "tuple[int | None, str]":
+    """``(bytes, field_name)`` resident-set reading for this process.
+
+    The primary ``/proc/self/statm`` path reads CURRENT RSS and labels
+    it ``rss_bytes``; the portability fallback only has ``ru_maxrss`` —
+    the PEAK, which never goes down — so it is labeled
+    ``rss_peak_bytes`` instead of being passed off as current (a report
+    reading a flat "rss" line would otherwise conclude memory is stable
+    while the process leaks toward its peak)."""
     try:
         with open("/proc/self/statm", "rb") as f:
-            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+            return (int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE"),
+                    "rss_bytes")
     except (OSError, ValueError, IndexError):
         try:
             import resource
 
-            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+            return (resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                    * 1024, "rss_peak_bytes")
         except Exception:
-            return None
+            return None, "rss_bytes"
+
+
+def rss_bytes() -> int | None:
+    """Resident set size of this process, or None when unreadable.
+    NOTE: on hosts without ``/proc`` this is the peak, not current —
+    use :func:`rss_sample` when the distinction matters."""
+    return rss_sample()[0]
 
 
 def engine_collector(engine, reader=None, runner=None, registry=None):
@@ -75,8 +91,6 @@ def engine_collector(engine, reader=None, runner=None, registry=None):
                          "now - max folded event time")
         g_dirty = reg.gauge("streambench_sink_dirty_rows",
                             "failed-writeback rows retained for retry")
-        g_rss = reg.gauge("streambench_rss_bytes",
-                          "resident set size of the engine process")
 
     def collect(rec: dict, dt_s: float) -> None:
         tel = engine.telemetry()
@@ -135,7 +149,14 @@ def engine_collector(engine, reader=None, runner=None, registry=None):
         hist = getattr(engine, "_obs_hist", None)
         if hist is not None and hist.count:
             rec["latency_ms"] = hist.summary()
-        rec["rss_bytes"] = rss_bytes()
+        # window-lifecycle attribution (obs.lifecycle): the per-segment
+        # decomposition of the latency histogram above, present only
+        # when the engine was attached with lifecycle=True
+        lc = getattr(engine, "_obs_lifecycle", None)
+        if lc is not None:
+            rec["attribution"] = lc.summary()
+        rss, rss_label = rss_sample()
+        rec[rss_label] = rss
         if reg is not None:
             c_events.set_total(events)
             c_windows.set_total(rec["windows_written"])
@@ -145,8 +166,14 @@ def engine_collector(engine, reader=None, runner=None, registry=None):
             if rec.get("watermark_lag_ms") is not None:
                 g_wm.set(rec["watermark_lag_ms"])
             g_dirty.set(rec["sink_dirty_rows"])
-            if rec["rss_bytes"] is not None:
-                g_rss.set(rec["rss_bytes"])
+            if rss is not None:
+                # gauge name follows the sample's semantics (current vs
+                # peak) — get-or-create, so only the taken path exists
+                reg.gauge(f"streambench_{rss_label}",
+                          "resident set size of the engine process"
+                          if rss_label == "rss_bytes" else
+                          "peak resident set size (ru_maxrss fallback)"
+                          ).set(rss)
             for name, d in stages.items():
                 reg.counter("streambench_stage_calls_total",
                             "tracer span calls per stage",
@@ -175,10 +202,17 @@ class MetricsSampler:
     """
 
     def __init__(self, path: str, interval_ms: int = 1000,
-                 registry=None):
+                 registry=None, max_bytes: int = 0):
         self.path = path
         self.interval_ms = max(int(interval_ms), 1)
         self.registry = registry
+        # journal size cap (``jax.metrics.max.bytes``; 0 = unbounded):
+        # a record that would push past it rotates metrics.jsonl to
+        # metrics.jsonl.1 (replacing any previous .1) — a week-long
+        # chaos sweep keeps at most ~2x the cap on disk, never an
+        # unbounded time series
+        self.max_bytes = max(int(max_bytes or 0), 0)
+        self.rotations = 0
         self._collectors: list = []
         self._seq = 0
         self._t0 = time.monotonic()
@@ -190,6 +224,7 @@ class MetricsSampler:
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
+        self._bytes = self._f.tell()   # append mode: existing size
 
     def add_collector(self, fn) -> None:
         self._collectors.append(fn)
@@ -198,8 +233,18 @@ class MetricsSampler:
     def _write(self, rec: dict) -> None:
         line = json.dumps(rec) + "\n"
         with self._io_lock:
+            if (self.max_bytes and self._bytes
+                    and self._bytes + len(line) > self.max_bytes):
+                # rotate BEFORE the write, so no single file ever
+                # exceeds the cap and the newest record is never split
+                self._f.close()
+                os.replace(self.path, self.path + ".1")
+                self._f = open(self.path, "a", encoding="utf-8")
+                self._bytes = 0
+                self.rotations += 1
             self._f.write(line)
             self._f.flush()
+            self._bytes += len(line)
 
     def _snapshot_record(self, kind: str = "snapshot") -> dict:
         with self._collect_lock:

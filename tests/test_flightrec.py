@@ -1,0 +1,164 @@
+"""Crash flight recorder (ISSUE 4 tentpole, obs.flightrec): bounded
+ordered ring, atomic dumps with the terminal fault last, the runner's
+crash dump under an injected FaultPlan crash, and the supervisor's
+give-up black box."""
+
+import glob
+import json
+import os
+import random
+
+import pytest
+
+from streambench_tpu.chaos import (
+    CrashScheduler,
+    EngineCrash,
+    FaultPlan,
+    Supervisor,
+)
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.obs import FlightRecorder
+
+
+def _load(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def _assert_monotonic(recs):
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    ts = [r["ts_ms"] for r in recs]
+    assert ts == sorted(ts)
+
+
+# ----------------------------------------------------------------------
+def test_ring_is_bounded_and_ordered(tmp_path):
+    fr = FlightRecorder(str(tmp_path), capacity=16)
+    for i in range(100):
+        fr.record("tick", i=i)
+    assert len(fr) == 16
+    recs = fr.snapshot()
+    _assert_monotonic(recs)
+    assert recs[0]["i"] == 84 and recs[-1]["i"] == 99  # oldest dropped
+
+
+def test_dump_terminal_record_last_and_unique_paths(tmp_path):
+    fr = FlightRecorder(str(tmp_path), capacity=16)
+    fr.record("tick", events=10)
+    p1 = fr.dump("crash", terminal={"kind": "fault", "event": "crash",
+                                    "error": "boom"})
+    assert os.path.basename(p1) == "flight_crash.jsonl"
+    recs = _load(p1)
+    _assert_monotonic(recs)
+    assert recs[0]["kind"] == "tick"
+    assert recs[-1] == recs[-1] | {"kind": "fault", "event": "crash",
+                                   "error": "boom"}
+    # a second dump for the same reason never clobbers the first
+    p2 = fr.dump("crash", terminal={"event": "crash", "error": "again"})
+    assert p2 != p1 and os.path.exists(p1) and os.path.exists(p2)
+    assert fr.dumps == [p1, p2]
+    # hostile reason strings become safe filenames
+    p3 = fr.dump("../../etc x")
+    assert os.path.dirname(p3) == str(tmp_path)
+    assert "/" not in os.path.basename(p3)[len("flight_"):]
+
+
+# ----------------------------------------------------------------------
+def test_runner_crash_via_fault_plan_leaves_black_box(tmp_path):
+    """The ISSUE's satellite: inject a crash via the existing FaultPlan
+    machinery and assert a ``flight_*.jsonl`` appears, records in
+    monotonic order, terminal fault last."""
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=4000,
+                 rng=random.Random(5), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+    fr = FlightRecorder(str(tmp_path), capacity=64)
+    plan = FaultPlan(crashes=(("batch", 2),))
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic),
+                          crash_points=CrashScheduler(plan.crashes),
+                          flightrec=fr)
+    with pytest.raises(EngineCrash):
+        runner.run_catchup()
+    files = glob.glob(str(tmp_path / "flight_*.jsonl"))
+    assert len(files) == 1 and files[0].endswith("flight_crash.jsonl")
+    recs = _load(files[0])
+    _assert_monotonic(recs)
+    last = recs[-1]
+    assert last["kind"] == "fault" and last["event"] == "crash"
+    assert "EngineCrash" in last["error"]
+    assert last["offset"] > 0 and last["events"] > 0
+
+
+def test_runner_feeds_ticks_and_checkpoints(tmp_path):
+    """A surviving run leaves flush-cadence ticks + checkpoint offsets
+    in the ring (no dump: nothing terminal happened)."""
+    from streambench_tpu.checkpoint import Checkpointer
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=4000,
+                 rng=random.Random(5), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+    fr = FlightRecorder(str(tmp_path), capacity=64)
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic),
+                          checkpointer=Checkpointer(str(tmp_path / "ck")),
+                          flightrec=fr)
+    runner.run_catchup()
+    engine.close()
+    kinds = [rec["kind"] for rec in fr.snapshot()]
+    assert "tick" in kinds and "checkpoint" in kinds
+    tick = next(rec for rec in fr.snapshot() if rec["kind"] == "tick")
+    assert "events" in tick and "watermark_lag_ms" in tick
+    assert not glob.glob(str(tmp_path / "flight_*.jsonl"))
+
+
+# ----------------------------------------------------------------------
+def test_supervisor_give_up_dumps_terminal_fault(tmp_path):
+    """A supervised run that dies for good (no durable progress) leaves
+    ``flight_give_up.jsonl`` whose last record is the give-up fault,
+    with the crash/restart history before it."""
+
+    class CrashingRunner:
+        checkpointer = None
+        crash_points = None
+
+        def resume(self):
+            return False
+
+        def _reader_position(self):
+            return 10
+
+        def run(self, **kw):
+            raise EngineCrash("boom")
+
+    fr = FlightRecorder(str(tmp_path), capacity=32)
+    sup = Supervisor(CrashingRunner, max_no_progress_restarts=2,
+                     backoff_base_ms=0, sleep=lambda s: None,
+                     flightrec=fr)
+    st = sup.run()
+    assert st.gave_up
+    files = glob.glob(str(tmp_path / "flight_*.jsonl"))
+    assert files == [str(tmp_path / "flight_give_up.jsonl")]
+    recs = _load(files[0])
+    _assert_monotonic(recs)
+    events = [(r["kind"], r.get("event")) for r in recs]
+    assert ("supervisor", "crash") in events
+    assert ("supervisor", "restart") in events
+    last = recs[-1]
+    assert last["kind"] == "fault" and last["event"] == "give_up"
+    assert "EngineCrash" in last["error"]
+    assert last["crashes"] == st.crashes

@@ -1,0 +1,270 @@
+"""Per-window latency attribution (ISSUE 4 tentpole, obs.lifecycle):
+the five stamped segments partition each write's end-to-end latency,
+stamps merge min/max across folds, the table stays bounded, the engine
+integration journals an ``attribution`` block whose segment sums match
+the e2e histogram, and the ``attribution`` CLI renders/diffs it."""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+import streambench_tpu.obs.lifecycle as lcmod
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.obs import MetricsRegistry
+from streambench_tpu.obs.lifecycle import SEGMENTS, WindowLifecycle
+
+
+class _Batch:
+    """Duck-typed EncodedBatch surface note_fold reads."""
+
+    def __init__(self, times, base=0, valid=None):
+        t = np.asarray(times, np.int64)
+        self.event_time = t
+        self.valid = (np.ones(len(t), bool) if valid is None
+                      else np.asarray(valid, bool))
+        self.n = len(t)
+        self.base_time_ms = base
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    """Deterministic wall clock for the lifecycle module."""
+    state = {"t": 1_000}
+    monkeypatch.setattr(lcmod, "now_ms", lambda: state["t"])
+    return state
+
+
+def test_segments_partition_e2e_exactly(clock):
+    reg = MetricsRegistry()
+    lc = WindowLifecycle(reg, divisor_ms=100)
+    b = _Batch([10, 50, 150])          # windows ts=0 and ts=100
+    b._lc_read_ms = 1_000
+    b._lc_encode_ms = 1_005
+    clock["t"] = 1_010
+    lc.note_fold(b)
+    clock["t"] = 1_020
+    lc.note_flush([0, 100])
+    lc.note_written([0, 100], 1_025)
+    s = lc.summary()
+    assert s["writes_observed"] == 2 and s["writes_untracked"] == 0
+    segs = s["segments"]
+    # per write the five segments sum to exactly stamp - window_ts, so
+    # the histogram SUMS (exact, unlike bucketed percentiles) partition:
+    # e2e = (1025-0) + (1025-100) = 1950
+    assert s["e2e_ms"]["sum"] == 1_950
+    assert sum(segs[k]["sum"] for k in SEGMENTS) == 1_950
+    # and each segment carries the intended boundary
+    assert segs["encode"]["sum"] == 10     # 5 per window
+    assert segs["fold"]["sum"] == 10
+    assert segs["flush"]["sum"] == 20
+    assert segs["sink"]["sum"] == 10
+    assert segs["ingest"]["sum"] == 1_900  # 1000 + 900
+    for k in SEGMENTS:
+        assert segs[k]["count"] == 2
+
+
+def test_stamps_merge_across_folds(clock):
+    """A window fed by several batches keeps min-first-read /
+    max-last-read / max-encode / last-fold, so ``ingest`` covers the
+    whole arrival wait and ``encode`` only the final batch's encode
+    residency — the arrival span itself is its own histogram."""
+    reg = MetricsRegistry()
+    lc = WindowLifecycle(reg, divisor_ms=10_000)
+    b1 = _Batch([10])
+    b1._lc_read_ms, b1._lc_encode_ms = 1_000, 1_001
+    clock["t"] = 1_002
+    lc.note_fold(b1)
+    b2 = _Batch([20])                     # same window, later stamps
+    b2._lc_read_ms, b2._lc_encode_ms = 1_100, 1_101
+    clock["t"] = 1_102
+    lc.note_fold(b2)
+    clock["t"] = 1_110
+    lc.note_flush([0])
+    lc.note_written([0], 1_120)
+    s = lc.summary()
+    segs = s["segments"]
+    assert segs["ingest"]["sum"] == 1_100   # LAST read - window start
+    assert segs["encode"]["sum"] == 1       # 1101 - 1100 (last read)
+    assert segs["fold"]["sum"] == 1         # 1102 - 1101
+    assert segs["flush"]["sum"] == 8        # 1110 - 1102
+    assert segs["sink"]["sum"] == 10        # 1120 - 1110
+    assert s["arrival_span_ms"]["sum"] == 100  # 1100 - 1000
+
+
+def test_invalid_rows_masked_and_untracked_writes_counted(clock):
+    reg = MetricsRegistry()
+    lc = WindowLifecycle(reg, divisor_ms=100)
+    b = _Batch([10, 950], valid=[True, False])   # window 900 never folds
+    lc.note_fold(b)
+    lc.note_written([0, 900], 1_050)
+    s = lc.summary()
+    assert s["writes_observed"] == 1
+    assert s["writes_untracked"] == 1            # window 900 unseen
+
+
+def test_window_table_bounded_by_cap_and_retirement(clock):
+    reg = MetricsRegistry()
+    lc = WindowLifecycle(reg, divisor_ms=100, lateness_ms=0,
+                         max_windows=16)
+    for i in range(64):
+        lc.note_fold(_Batch([i * 100 + 1]))
+    s = lc.summary()
+    assert s["open_windows"] <= 16
+    assert s["windows_evicted"] == 48
+    # a written window far behind the newest one is retired outright
+    lc.note_written([5_000], 7_000)              # tracked, old
+    assert 5_000 not in lc._windows
+
+
+def test_engine_integration_attribution_matches_e2e(tmp_path):
+    """Catchup run with lifecycle attached: every observed write lands
+    one sample per segment, and the segment sums partition the matched
+    e2e histogram (within clamping of future-skewed events)."""
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=6000,
+                 rng=random.Random(3), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+    reg = MetricsRegistry()
+    engine.attach_obs(reg, lifecycle=True)
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+    runner.run_catchup()
+    engine.close()
+    s = engine._obs_lifecycle.summary()
+    assert s["writes_observed"] > 0
+    segs = s["segments"]
+    for k in SEGMENTS:
+        assert segs[k]["count"] == s["writes_observed"]
+    assert s["e2e_ms"]["count"] == s["writes_observed"]
+    total = sum(segs[k]["sum"] for k in SEGMENTS)
+    e2e = s["e2e_ms"]["sum"]
+    # negative-clamped jitter aside, the partition is exact
+    assert abs(total - e2e) <= max(0.1 * max(e2e, 1), 100)
+    # the registry carries the same data for a scrape
+    text = reg.render_prometheus()
+    assert 'streambench_window_segment_ms_bucket{le=' in text
+    assert 'segment="ingest"' in text
+    # default path untouched: no lifecycle without the opt-in
+    assert AdAnalyticsEngine(cfg, mapping)._obs_lifecycle is None
+
+
+def test_collector_journals_attribution_block():
+    """engine_collector puts the lifecycle summary on each snapshot so
+    the final metrics.jsonl record carries the full attribution."""
+    from streambench_tpu.metrics import FaultCounters
+    from streambench_tpu.obs import engine_collector
+    from streambench_tpu.trace import Tracer
+
+    class _Eng:
+        tracer = Tracer()
+        faults = FaultCounters()
+        events_processed = 0
+        _obs_hist = None
+
+        def telemetry(self):
+            return {"events": 0, "windows_written": 0,
+                    "watermark_lag_ms": None, "sink_dirty_rows": 0,
+                    "pending_rows": 0}
+
+    eng = _Eng()
+    reg = MetricsRegistry()
+    eng._obs_lifecycle = WindowLifecycle(reg, divisor_ms=100)
+    rec: dict = {}
+    engine_collector(eng, registry=reg)(rec, 1.0)
+    assert rec["attribution"]["writes_observed"] == 0
+    assert set(rec["attribution"]["segments"]) == set(SEGMENTS)
+    # without the tracker the key is absent — old journals unchanged
+    eng2 = _Eng()
+    rec2: dict = {}
+    engine_collector(eng2, registry=MetricsRegistry())(rec2, 1.0)
+    assert "attribution" not in rec2
+
+
+def _attribution_block(scale=1.0):
+    def h(p50):
+        p50 *= scale
+        return {"count": 4, "sum": p50 * 4, "min": p50 / 2,
+                "max": p50 * 2, "p50": p50, "p95": p50 * 1.5,
+                "p99": p50 * 2}
+    return {
+        "writes_observed": 4, "writes_untracked": 0,
+        "open_windows": 2, "windows_evicted": 0,
+        "e2e_ms": h(10_000),
+        "segments": {"ingest": h(9_000), "encode": h(200),
+                     "fold": h(100), "flush": h(500), "sink": h(200)},
+    }
+
+
+def _write_attr_series(path, scale=1.0):
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "snapshot", "seq": 0, "ts_ms": 1,
+                            "uptime_ms": 100}) + "\n")
+        f.write(json.dumps({"kind": "final", "seq": 1, "ts_ms": 2,
+                            "uptime_ms": 200,
+                            "attribution": _attribution_block(scale)})
+                + "\n")
+
+
+def test_attribution_cli_report_and_diff(tmp_path, capsys):
+    from streambench_tpu.obs.__main__ import main as obs_main
+
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _write_attr_series(a)
+    _write_attr_series(b, scale=2.0)
+    assert obs_main(["attribution", a]) == 0
+    out = capsys.readouterr().out
+    assert "window latency attribution" in out
+    assert "ingest" in out and "sink" in out
+    assert "segment p50 sum" in out and "% of e2e p50" in out
+    # A/B diff: second path
+    assert obs_main(["attribution", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "attribution diff" in out and "e2e" in out
+    assert "9,000" in out and "18,000" in out
+    # --json round-trips the dict
+    assert obs_main(["attribution", a, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["attribution"]["segments"]["ingest"]["p50"] == 9_000
+    # a run without attribution renders a pointer, not a crash
+    c = str(tmp_path / "c.jsonl")
+    with open(c, "w") as f:
+        f.write(json.dumps({"kind": "snapshot", "seq": 0}) + "\n")
+    assert obs_main(["attribution", c]) == 0
+    assert "no attribution records" in capsys.readouterr().out
+
+
+def test_ingest_pipeline_carries_true_read_stamps(tmp_path):
+    """With the staged ingest pipeline on, the reader's wall stamp rides
+    the item into the encoded batches, so ingest/encode split at the
+    real read boundary (not at encode time)."""
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2,
+                         jax_ingest_pipeline="on")
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=5000,
+                 rng=random.Random(11), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+    engine.attach_obs(MetricsRegistry(), lifecycle=True)
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+    runner.run_catchup()
+    engine.close()
+    s = engine._obs_lifecycle.summary()
+    assert s["writes_observed"] > 0
+    assert s["segments"]["encode"]["count"] == s["writes_observed"]

@@ -104,6 +104,23 @@ def test_histogram_edges_and_empty():
     assert p100 == 1e9         # overflow clamped to observed max
 
 
+def test_empty_histogram_quantiles_nan_summary_count_only():
+    """No samples: quantiles are NaN (never a raise), and summary is
+    ``{"count": 0}`` alone — no percentile keys, so the JSON journal
+    never carries a non-standard NaN token and a reader can't mistake
+    'no samples' for 'zero latency'."""
+    h = StreamingHistogram("h")
+    qs = h.quantiles((0.0, 0.5, 0.99, 1.0))
+    assert len(qs) == 4 and all(math.isnan(q) for q in qs)
+    assert h.summary() == {"count": 0}
+    # json-safe as-is
+    assert json.loads(json.dumps(h.summary())) == {"count": 0}
+    # one sample later the full shape comes back
+    h.observe(5)
+    s = h.summary()
+    assert s["count"] == 1 and "p50" in s and "p99" in s
+
+
 def test_prometheus_rendering_families_and_labels():
     reg = MetricsRegistry()
     reg.counter("streambench_faults_total", "faults",
@@ -234,6 +251,59 @@ def test_sampler_snapshots_deltas_and_final(tmp_path):
     assert reg.counter("streambench_events_total").value == 1000
     text = reg.render_prometheus()
     assert 'streambench_faults_total{kind="sink_errors"} 1' in text
+
+
+def test_sampler_rotates_at_max_bytes(tmp_path):
+    """jax.metrics.max.bytes: the journal rotates to metrics.jsonl.1
+    instead of growing unboundedly; no file exceeds the cap and no
+    record is lost across the rotation."""
+    path = str(tmp_path / "metrics.jsonl")
+    s = MetricsSampler(path, interval_ms=60_000, max_bytes=512)
+    for i in range(40):
+        s.annotate("spin", i=i)
+    s.close()
+    rotated = path + ".1"
+    assert os.path.exists(rotated) and s.rotations >= 1
+    assert os.path.getsize(rotated) <= 512
+    recs = ([json.loads(l) for l in open(rotated)]
+            + [json.loads(l) for l in open(path)])
+    spins = [r["i"] for r in recs if r.get("event") == "spin"]
+    # the newest cap-worth of records survives contiguously, newest last
+    assert spins == list(range(spins[0], 40))
+    assert recs[-1]["kind"] == "final"
+
+
+def test_sampler_unbounded_by_default(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    s = MetricsSampler(path, interval_ms=60_000)
+    for i in range(40):
+        s.annotate("spin", i=i)
+    s.close()
+    assert not os.path.exists(path + ".1") and s.rotations == 0
+    assert len([json.loads(l) for l in open(path)]) == 41  # + final
+
+
+def test_rss_sample_labels_peak_fallback(monkeypatch):
+    """The /proc path reports CURRENT rss as ``rss_bytes``; the
+    ru_maxrss fallback is PEAK and must say so (``rss_peak_bytes``),
+    not masquerade as current."""
+    from streambench_tpu.obs import rss_sample
+    from streambench_tpu.obs import sampler as sampler_mod
+
+    v, label = rss_sample()
+    assert label == "rss_bytes" and v and v > 0   # Linux CI: /proc
+    monkeypatch.setattr(sampler_mod.os, "sysconf",
+                        lambda *_: (_ for _ in ()).throw(ValueError()))
+    v2, label2 = rss_sample()
+    assert label2 == "rss_peak_bytes" and v2 and v2 > 0
+    # the collector journals under the sample's own label and mirrors
+    # the matching gauge only
+    eng = _StubEngine()
+    reg = MetricsRegistry()
+    rec: dict = {}
+    engine_collector(eng, registry=reg)(rec, 1.0)
+    assert "rss_peak_bytes" in rec and "rss_bytes" not in rec
+    assert "streambench_rss_peak_bytes" in reg.render_prometheus()
 
 
 def test_sampler_no_thread_until_started(tmp_path):
@@ -431,6 +501,7 @@ def test_cli_metrics_jsonl_and_prometheus_scrape(tmp_path):
         "jax.flush.interval.ms": 100,
         "jax.metrics.interval.ms": 25,
         "jax.metrics.port": 0,          # ephemeral, printed at startup
+        "jax.obs.lifecycle": True,      # attribution rides the journal
     })
     cfg = default_config()
     broker = FileBroker(os.path.join(wd, "broker"))
@@ -510,6 +581,13 @@ def test_cli_metrics_jsonl_and_prometheus_scrape(tmp_path):
                        for k in ("p50", "p95", "p99"))
     final = recs[-1]
     assert final["kind"] == "final"
+    # jax.obs.lifecycle: the final record carries the per-segment
+    # attribution, one sample per segment per observed write
+    att = final["attribution"]
+    assert att["writes_observed"] > 0
+    for seg in ("ingest", "encode", "fold", "flush", "sink"):
+        assert att["segments"][seg]["count"] == att["writes_observed"]
+    assert att["e2e_ms"]["count"] == att["writes_observed"]
     # the time series' last word and the exit stats line agree
     assert final["run_stats"] == stats_line
     assert final["events"] == stats_line["events"]
