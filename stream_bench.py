@@ -63,6 +63,11 @@ WINDOW_SLOTS = int(os.environ.get("WINDOW_SLOTS", "16"))
 ENCODE_WORKERS = int(os.environ.get("ENCODE_WORKERS", "1"))
 # Staged ingest pipeline (engine/ingest.py): off | on | auto
 INGEST_PIPELINE = os.environ.get("INGEST_PIPELINE", "off")
+# Exactly-once writeback (ROBUSTNESS.md "Exactly-once"): epoch-fenced
+# idempotent sink flushes + absolute-ledger reconcile on resume.
+# Default off: the hot path stays byte-identical.
+EXACTLY_ONCE = os.environ.get("EXACTLY_ONCE", "") not in (
+    "", "0", "false", "no")
 # Observability knobs (obs/; README "Observability") — all default-off:
 # METRICS_INTERVAL_MS>0 journals <workdir>/metrics.jsonl at that cadence,
 # OBS_LIFECYCLE=1 adds per-window latency attribution to it (read with
@@ -240,6 +245,7 @@ def op_setup() -> None:
         "jax.window.slots": WINDOW_SLOTS,
         "jax.encode.workers": ENCODE_WORKERS,
         "jax.ingest.pipeline": INGEST_PIPELINE,
+        "jax.sink.exactly_once": EXACTLY_ONCE,
         "jax.metrics.interval.ms": METRICS_INTERVAL_MS,
         "jax.obs.lifecycle": OBS_LIFECYCLE,
         "jax.obs.flightrec.enabled": FLIGHTREC,

@@ -9,7 +9,8 @@ See ROBUSTNESS.md for the failure model.  The pieces:
 - ``supervisor`` — :class:`Supervisor` restart loop with capped
   exponential backoff and no-progress give-up
 - ``verify``     — the executable at-least-once bound
-  (:func:`check_at_least_once`)
+  (:func:`check_at_least_once`) and the strict exactly-once check
+  (:func:`check_exactly_once`, ``jax.sink.exactly_once`` runs)
 """
 
 from streambench_tpu.chaos.inject import (  # noqa: F401
@@ -29,5 +30,7 @@ from streambench_tpu.chaos.supervisor import (  # noqa: F401
 from streambench_tpu.chaos.verify import (  # noqa: F401
     ChaosVerdict,
     check_at_least_once,
+    check_exactly_once,
+    replay_note,
     segment_view_counts,
 )

@@ -42,6 +42,14 @@ class ChaosRedis:
     whole flush batches, so per-call granularity is per-batch
     granularity, matching how a real connection fails.
 
+    The one deliberate exception is the ``partial`` fault (exactly-once
+    sweeps only): a timeout that forwards a PREFIX of the pipeline
+    before raising — the non-atomic failure a real socket timeout can
+    leave behind, which the at-least-once model explicitly cannot
+    represent (ROBUSTNESS.md "modeling choices") and only the epoch/seq
+    fence protocol survives.  On a single ``execute`` it applies the
+    command fully and then raises (the response-loss flavor).
+
     Underscore attributes are deliberately NOT forwarded: the engine
     probes ``redis._store`` to pick its in-C bulk writeback, which would
     bypass this proxy entirely — hiding it forces every flush through
@@ -52,7 +60,9 @@ class ChaosRedis:
         self._target = target
         self._injector = injector
 
-    def _maybe_fault(self) -> None:
+    def _maybe_fault(self) -> str | None:
+        """Raise the scheduled atomic fault, or return "partial" for the
+        caller to enact (it needs the command list)."""
         kind = self._injector.sink_fault()
         if kind == "refused":
             raise ConnectionRefusedError("chaos: connection refused")
@@ -61,13 +71,25 @@ class ChaosRedis:
         if kind == "resp":
             raise RespError(
                 "LOADING chaos: Redis is loading the dataset in memory")
+        return kind
 
     def execute(self, *args):
-        self._maybe_fault()
+        kind = self._maybe_fault()
+        if kind == "partial":
+            # single command: fully applied, response lost
+            self._target.execute(*args)
+            raise TimeoutError("chaos: sink timed out after apply")
         return self._target.execute(*args)
 
     def pipeline_execute(self, commands):
-        self._maybe_fault()
+        kind = self._maybe_fault()
+        if kind == "partial":
+            cmds = list(commands)
+            k = max(len(cmds) // 2, 1)
+            self._target.pipeline_execute(cmds[:k])
+            raise TimeoutError(
+                f"chaos: sink timed out after partial pipeline apply "
+                f"({k}/{len(cmds)} commands landed)")
         return self._target.pipeline_execute(commands)
 
     def reconnect(self) -> None:

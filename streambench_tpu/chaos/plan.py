@@ -37,6 +37,12 @@ from dataclasses import dataclass, field
 from streambench_tpu.metrics import FaultCounters
 
 SINK_KINDS = ("refused", "timeout", "resp")
+# The non-atomic sink fault (a timeout that lands a PREFIX of the
+# pipeline before raising).  Deliberately NOT in SINK_KINDS: the
+# at-least-once bound assumes atomic sink failure (ROBUSTNESS.md), so
+# plain sweeps never roll it — only exactly-once sweeps opt in via
+# ``generate(..., sink_partial_rate=...)``.
+SINK_PARTIAL = "partial"
 JOURNAL_KINDS = ("truncated", "torn", "corrupt")
 CRASH_KINDS = ("batch", "flush", "checkpoint")
 
@@ -76,6 +82,7 @@ class FaultPlan:
                  sink_rate: float = 0.0,
                  sink_ops: int = 0,
                  sink_outage: tuple[int, int] | None = None,
+                 sink_partial_rate: float = 0.0,
                  journal_rate: float = 0.0,
                  journal_polls: int = 0,
                  crashes: int = 0,
@@ -87,15 +94,22 @@ class FaultPlan:
         operations (beyond those indices the surface runs clean, which
         guarantees retries eventually succeed).  ``sink_outage=(start,
         length)`` additionally fails every sink op in that index range —
-        a hard outage window.  ``crashes`` schedules that many crash
-        points, each at a random boundary kind within the first
+        a hard outage window.  ``sink_partial_rate`` rolls the
+        non-atomic ``partial`` fault on top (same index space, same
+        single RNG draw, so plans with the rate at 0 are bit-identical
+        to pre-partial plans under the same seed) — exactly-once sweeps
+        only, see :data:`SINK_PARTIAL`.  ``crashes`` schedules that many
+        crash points, each at a random boundary kind within the first
         ``crash_span`` boundaries of an attempt.
         """
         rng = random.Random(seed)
         sink: dict[int, str] = {}
         for i in range(sink_ops):
-            if rng.random() < sink_rate:
+            roll = rng.random()
+            if roll < sink_rate:
                 sink[i] = rng.choice(SINK_KINDS)
+            elif sink_partial_rate and roll < sink_rate + sink_partial_rate:
+                sink[i] = SINK_PARTIAL
         if sink_outage is not None:
             start, length = sink_outage
             for i in range(start, start + length):

@@ -145,6 +145,21 @@ class Supervisor:
         for (ci, ts), n in runner.engine.pending_counts().items():
             key = (campaigns[ci], int(ts))
             self.stats.carried[key] = self.stats.carried.get(key, 0) + n
+        # exactly-once runs: the restored fence baseline the next flush's
+        # sink read will be judged against — on the telemetry/postmortem
+        # streams so a reconcile decision can be traced back to its input
+        fence = getattr(runner.engine, "_xo_baseline", None)
+        xo = getattr(runner.engine, "_xo", False)
+        if xo and fence is not None:
+            if self.sampler is not None:
+                self.sampler.annotate(
+                    "resume", resume_offset=resume_pos,
+                    fence_epoch=fence[0], fence_seq=fence[1])
+            if self.flightrec is not None:
+                self.flightrec.record(
+                    "supervisor", event="resume",
+                    resume_offset=resume_pos,
+                    fence_epoch=fence[0], fence_seq=fence[1])
 
     # ------------------------------------------------------------------
     def run(self, *, catchup: bool = False, **run_kwargs) -> SupervisorStats:

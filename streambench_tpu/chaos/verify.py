@@ -23,6 +23,11 @@ over-count sources the supervisor recorded:
 Anything outside those bounds is a real bug: a count below the oracle is
 lost data (the at-least-once side), a count above the bound is
 double-counting the documented contract does not allow.
+
+With ``jax.sink.exactly_once`` on, :func:`check_exactly_once` drops the
+bound entirely: the fence protocol (ROBUSTNESS.md "Exactly-once")
+reconciles replay segments and carried pending, so ``count(w) ==
+oracle(w)`` must hold for every window.
 """
 
 from __future__ import annotations
@@ -86,17 +91,90 @@ class ChaosVerdict:
     undercounts: list = field(default_factory=list)
     overcounts: list = field(default_factory=list)
     max_overcount: int = 0
+    # one-paste repro for a red run (see replay_note): appended to
+    # summary() so every sweep assertion message carries it
+    repro: str | None = None
 
     def summary(self) -> str:
-        return (f"chaos verdict: ok={self.ok} windows={self.windows} "
-                f"exact={self.exact} within_bound={self.within_bound} "
-                f"under={len(self.undercounts)} over={len(self.overcounts)} "
-                f"max_overcount={self.max_overcount}")
+        s = (f"chaos verdict: ok={self.ok} windows={self.windows} "
+             f"exact={self.exact} within_bound={self.within_bound} "
+             f"under={len(self.undercounts)} over={len(self.overcounts)} "
+             f"max_overcount={self.max_overcount}")
+        if self.repro:
+            s += "\n" + self.repro
+        return s
+
+
+def replay_note(*, seed, topic_path: str,
+                overrides: dict | None = None) -> str:
+    """One-paste repro line for a failing seeded chaos run.
+
+    Fault plans are fully determined by their seed, so a red sweep
+    replays bit-identically from (test node, seed, config overrides,
+    topic).  Inside pytest the exact node id comes from
+    ``PYTEST_CURRENT_TEST``; the seed/topic/overrides ride along for
+    harnesses that drive plans directly.
+    """
+    node = os.environ.get("PYTEST_CURRENT_TEST", "").split(" ")[0]
+    cmd = (f"python -m pytest '{node}' -q" if node
+           else "python -m pytest tests/ -q -m chaos")
+    parts = [f"seed={seed}", f"topic={topic_path}"]
+    if overrides:
+        parts.append("overrides[" + " ".join(
+            f"{k}={v}" for k, v in sorted(overrides.items())) + "]")
+    return f"replay: {cmd}   # {' '.join(parts)}"
+
+
+def _read_oracle(workdir: str, divisor_ms: int) -> dict:
+    """(campaign, abs_window_ts) -> exact view count, from the golden
+    model (``datagen.gen.dostats``, the peer of ``check-correct``)."""
+    oracle_buckets = gen.dostats(workdir, time_divisor_ms=divisor_ms)
+    return {(c, b * divisor_ms): n
+            for c, per in oracle_buckets.items()
+            for b, n in per.items()}
+
+
+def _read_actual(redis) -> dict:
+    actual_nested = read_seen_counts(redis)
+    return {(c, ts): n
+            for c, per in actual_nested.items()
+            for ts, n in per.items()}
+
+
+def check_exactly_once(redis, workdir: str,
+                       divisor_ms: int = 10_000,
+                       repro: str | None = None) -> ChaosVerdict:
+    """Assert the exactly-once contract: for EVERY (campaign, window),
+    ``redis_count(w) == oracle(w)`` — no bound, no slack.  The
+    acceptance check for chaos runs with ``jax.sink.exactly_once`` on
+    (ROBUSTNESS.md "Exactly-once"): replay segments and carried pending
+    are reconciled by the fence protocol, so any deviation in either
+    direction is a real bug.  ``repro`` (see :func:`replay_note`) is
+    carried into the verdict so a red sweep's assertion message is one
+    paste away from a bit-identical local replay."""
+    oracle = _read_oracle(workdir, divisor_ms)
+    actual = _read_actual(redis)
+    v = ChaosVerdict(ok=True, repro=repro)
+    for key in sorted(set(oracle) | set(actual)):
+        want = oracle.get(key, 0)
+        have = actual.get(key, 0)
+        v.windows += 1
+        if have == want:
+            v.exact += 1
+        elif have < want:
+            v.ok = False
+            v.undercounts.append((key, have, want))
+        else:
+            v.ok = False
+            v.overcounts.append((key, have, want, 0))
+            v.max_overcount = max(v.max_overcount, have - want)
+    return v
 
 
 def check_at_least_once(redis, workdir: str, topic_path: str,
                         replay_segments=(), carried=None,
-                        divisor_ms: int = 10_000) -> ChaosVerdict:
+                        divisor_ms: int = 10_000,
+                        repro: str | None = None) -> ChaosVerdict:
     """Assert the at-least-once contract against a finished chaos run.
 
     ``redis`` holds the engine's writes; ``workdir`` holds the
@@ -108,20 +186,14 @@ def check_at_least_once(redis, workdir: str, topic_path: str,
     """
     mapping = gen.load_ad_mapping_file(
         os.path.join(workdir, gen.AD_TO_CAMPAIGN_FILE))
-    oracle_buckets = gen.dostats(workdir, time_divisor_ms=divisor_ms)
-    oracle = {(c, b * divisor_ms): n
-              for c, per in oracle_buckets.items()
-              for b, n in per.items()}
+    oracle = _read_oracle(workdir, divisor_ms)
     bound = segment_view_counts(topic_path, replay_segments, mapping,
                                 divisor_ms)
     for key, n in (carried or {}).items():
         bound[key] = bound.get(key, 0) + n
-    actual_nested = read_seen_counts(redis)
-    actual = {(c, ts): n
-              for c, per in actual_nested.items()
-              for ts, n in per.items()}
+    actual = _read_actual(redis)
 
-    v = ChaosVerdict(ok=True)
+    v = ChaosVerdict(ok=True, repro=repro)
     for key in sorted(set(oracle) | set(actual)):
         want = oracle.get(key, 0)
         have = actual.get(key, 0)

@@ -25,6 +25,18 @@ double-count exposure to a crash landing inside one flush→save gap; a
 larger interval widens it to every flush since the last snapshot.  This
 is the same guarantee class as the reference engines' offset commits
 (at-least-once on restart from the last committed Kafka offset).
+
+With ``jax.sink.exactly_once`` on the guarantee tightens to equality
+(ROBUSTNESS.md "Exactly-once"): the snapshot additionally carries the
+last sink fence it covers (``meta["sink_epoch"]``/``meta["sink_seq"]``),
+the cumulative per-window writeback ledger (``extra["xo_totals"]``) and
+the tainted-window set (``extra["xo_taint"]``).  On resume the engine
+compares the sink's fence against the snapshot's: any flush the crashed
+attempt landed — fully or partially — after this snapshot is detected
+and the attempt reconciles with absolute ledger writes instead of
+replayed increments.  All three fields ride the existing meta/extra
+channels, so the format version is unchanged and flag-off snapshots are
+byte-identical.
 """
 
 from __future__ import annotations

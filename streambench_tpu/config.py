@@ -115,6 +115,14 @@ class BenchmarkConfig:
     # --- robustness knobs (ROBUSTNESS.md; the reference has none of these:
     # a Redis outage is a Jedis stack trace and enableCheckpointing is
     # commented out, AdvertisingTopologyNative.java:81-84) ---
+    jax_sink_exactly_once: bool = False    # epoch-fenced idempotent sink
+    #   writeback (ROBUSTNESS.md "Exactly-once"): every flush carries a
+    #   (writer_epoch, flush_seq) fence record in the same pipeline
+    #   batch, resume detects unfenced post-snapshot flushes via the
+    #   sink fence, and affected windows are reconciled with absolute
+    #   writes from a cumulative per-window ledger.  Default off: the
+    #   serial hot path stays byte-identical (no ledger, no fence reads,
+    #   native array writeback intact)
     jax_sink_retry_base_ms: int = 100      # first writer backoff after a
     #   failed window writeback; doubles per consecutive failure
     jax_sink_retry_cap_ms: int = 5000      # backoff ceiling (keeps the retry
@@ -259,6 +267,7 @@ class BenchmarkConfig:
             jax_ingest_block_queue=max(geti("jax.ingest.block.queue", 4), 1),
             jax_ingest_batch_queue=max(geti("jax.ingest.batch.queue", 4), 1),
             jax_use_native_encoder=getb("jax.use.native.encoder", True),
+            jax_sink_exactly_once=getb("jax.sink.exactly_once", False),
             jax_sink_retry_base_ms=geti("jax.sink.retry.base.ms", 100),
             jax_sink_retry_cap_ms=geti("jax.sink.retry.cap.ms", 5000),
             jax_sink_dirty_cap_rows=geti("jax.sink.dirty.cap.rows", 1 << 18),

@@ -256,8 +256,9 @@ def main(argv: list[str] | None = None) -> int:
         print(f"metrics: interval={sampler.interval_ms}ms "
               f"jsonl={metrics_path}{endpoint}", flush=True)
 
+    xo = " exactly_once=on" if cfg.jax_sink_exactly_once else ""
     print(f"engine up: topic={cfg.kafka_topic} redis={cfg.redis_host}:"
-          f"{cfg.redis_port} batch={engine.batch_size}", flush=True)
+          f"{cfg.redis_port} batch={engine.batch_size}{xo}", flush=True)
 
     from streambench_tpu.trace import device_trace
 
@@ -268,9 +269,26 @@ def main(argv: list[str] | None = None) -> int:
             stats = runner.run(duration_s=args.duration,
                                idle_timeout_s=args.idleTimeout,
                                max_events=args.maxEvents)
-    engine.close()
+    close_err: BaseException | None = None
+    try:
+        engine.close()
+    except RuntimeError as e:
+        # Rows declared lost at shutdown (the writer still held failed
+        # batches after CLOSE_RETRY_LIMIT re-flushes).  The writer
+        # counted them (``rows_lost`` in FaultCounters) before raising;
+        # finish the accounting — stats line, flight recorder — and exit
+        # non-zero instead of dying before any of it prints.
+        close_err = e
+        print(f"error: {e}", file=sys.stderr, flush=True)
     if deadletter is not None:
         deadletter.close()
+    rows_lost = engine.faults.get("rows_lost")
+    if rows_lost:
+        stats.faults = dict(stats.faults, rows_lost=rows_lost)
+        if flightrec is not None:
+            flightrec.dump("rows_lost", terminal={
+                "kind": "fault", "event": "rows_lost",
+                "rows_lost": rows_lost, "error": repr(close_err)})
     # stage spans + Apex-style decile report (SURVEY.md §5.1/§5.5)
     print(engine.tracer.report(), file=sys.stderr, flush=True)
     print(engine.latency_tracker.report(), file=sys.stderr, flush=True)
@@ -292,7 +310,7 @@ def main(argv: list[str] | None = None) -> int:
     if metrics_server is not None:
         metrics_server.close()
     print(json.dumps(stats_line), flush=True)
-    return 0
+    return 1 if close_err is not None else 0
 
 
 if __name__ == "__main__":

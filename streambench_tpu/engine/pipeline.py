@@ -30,7 +30,10 @@ from streambench_tpu.config import BenchmarkConfig
 from streambench_tpu.encode.native_encoder import make_encoder
 from streambench_tpu.io.redis_schema import (
     RedisLike,
+    claim_epoch,
     dump_latency_hash,
+    fence_key,
+    read_fence,
     write_windows_pipelined,
 )
 from streambench_tpu.metrics import FaultCounters, LatencyTracker
@@ -101,12 +104,24 @@ class _RedisWriter:
     retrying, and the retained buffer is coalesced by (campaign, window)
     past a high-water row count so an hours-long outage holds memory at
     O(dirty windows), not O(outage duration).
+
+    Exactly-once mode (``exactly_once=True``, ROBUSTNESS.md
+    "Exactly-once"): every flush rides ONE pipeline bracketed by fence
+    records — ``intent``/``epoch`` first, the commit ``seq`` last — and
+    each apply is preceded by an epoch pre-check so a superseded writer
+    (an abandoned attempt's thread still draining its queue) aborts
+    instead of applying stale deltas (``fence_conflicts``).  A failed
+    apply whose commit fence IS on the sink actually landed end-to-end
+    (the error was response-side): the retry is suppressed
+    (``dedup_suppressed_flushes``) instead of double-applying.
     """
 
     def __init__(self, redis: RedisLike, absolute: bool, tracer: Tracer,
                  on_written, faults: "FaultCounters | None" = None,
                  retry_base_ms: int = 100, retry_cap_ms: int = 5000,
-                 dirty_cap_rows: int = 1 << 18) -> None:
+                 dirty_cap_rows: int = 1 << 18,
+                 exactly_once: bool = False, fence_key: str = "",
+                 epoch: int | None = None, start_seq: int = 0) -> None:
         self._redis = redis
         self._absolute = absolute
         self._tracer = tracer
@@ -115,6 +130,17 @@ class _RedisWriter:
         self._retry_base_ms = max(int(retry_base_ms), 1)
         self._retry_cap_ms = max(int(retry_cap_ms), self._retry_base_ms)
         self._dirty_cap_rows = max(int(dirty_cap_rows), 1)
+        # exactly-once fence state (all dormant when the flag is off):
+        # epoch None = claim lazily from the sink at the first apply;
+        # seq continues from the sink's high-water (never reused, so the
+        # landed-or-not dedup check is unambiguous)
+        self._xo = bool(exactly_once)
+        self._fence_key = fence_key
+        self._epoch = epoch
+        self._seq = int(start_seq)
+        self._seq_acked = int(start_seq)
+        self._fenced = False            # a newer epoch owns the sink
+        self._last_attempt_seq: int | None = None
         self._consec_failures = 0
         # window/list-UUID memo across flushes (sole-writer assumption,
         # see write_windows_pipelined); only this thread touches it
@@ -196,12 +222,18 @@ class _RedisWriter:
             try:
                 if item is None:
                     return
-                payload, stamp = item
+                payload, stamp, absolute = item
                 stamp = now_ms() if stamp is None else stamp
+                if absolute is None:
+                    absolute = self._absolute
                 arrays = not isinstance(payload, list)
+                fenced_out = False
                 try:
                     with self._tracer.span("redis_flush"):
-                        if arrays:
+                        if self._xo:
+                            fenced_out = not self._apply_fenced(
+                                payload, stamp, absolute)
+                        elif arrays:
                             # (ci, ts, cnt) numpy triple against the
                             # native store: campaign table passed once,
                             # zero per-row Python work
@@ -212,17 +244,88 @@ class _RedisWriter:
                         else:
                             write_windows_pipelined(
                                 self._redis, payload, time_updated=stamp,
-                                absolute=self._absolute,
+                                absolute=absolute,
                                 cache=self._uuid_cache)
                 except BaseException as e:  # retained for reclaim/retry
-                    self._on_failure(payload.to_rows() if arrays
-                                     else payload, e)
+                    if self._xo and self._landed(self._last_attempt_seq):
+                        # The whole pipeline — commit fence last —
+                        # actually landed; the failure was response-side.
+                        # Retrying would apply the deltas twice: suppress
+                        # and account the rows as written.
+                        self._faults.inc("dedup_suppressed_flushes")
+                        self._seq_acked = self._last_attempt_seq
+                        self._consec_failures = 0
+                        self._on_written(payload, stamp)
+                    else:
+                        self._on_failure(payload.to_rows() if arrays
+                                         else payload, e)
                 else:
+                    if fenced_out:
+                        continue   # superseded epoch: dropped, not written
                     self._consec_failures = 0
+                    if self._xo:
+                        self._seq_acked = self._last_attempt_seq
                     # latency bookkeeping only for rows that actually landed
                     self._on_written(payload, stamp)
             finally:
                 self._q.task_done()
+
+    # -- exactly-once fence protocol -----------------------------------
+    def _apply_fenced(self, rows: list, stamp: int, absolute: bool) -> bool:
+        """One fenced apply: claim/verify the epoch, then rows + fence in
+        one pipeline.  Returns False when a newer epoch owns the sink —
+        this writer is a zombie (its engine was abandoned by a supervised
+        restart) and the batch is DROPPED, never retained: the new
+        lineage's ledger is the truth and stale deltas would corrupt it.
+        Raises on sink errors like the plain path (rows then retained)."""
+        import sys
+
+        self._last_attempt_seq = None
+        # The epoch is ONLY ever claimed engine-side (_xo_attach_sink),
+        # never here: a writer claiming lazily at apply time could be a
+        # zombie reading the fence AFTER its successor claimed — it
+        # would "claim" an even newer epoch, fence out the LIVE writer,
+        # and silently drop the live lineage's batches (the exact
+        # undercount the 20-seed sweep caught).  The engine never
+        # submits without a claimed epoch, so this is a bug trap.
+        if self._epoch is None:
+            raise RuntimeError(
+                "fenced writer received a batch without a claimed epoch")
+        e, _, _ = read_fence(self._redis, self._fence_key)
+        if e > self._epoch:
+            if not self._fenced:
+                print(f"redis writer: fenced out (sink epoch {e} > "
+                      f"writer epoch {self._epoch}); dropping "
+                      f"{len(rows)} stale rows", file=sys.stderr,
+                      flush=True)
+            self._fenced = True
+            self._faults.inc("fence_conflicts")
+            return False
+        self._seq += 1
+        self._last_attempt_seq = self._seq
+        write_windows_pipelined(
+            self._redis, rows, time_updated=stamp, absolute=absolute,
+            cache=self._uuid_cache,
+            fence=(self._fence_key, self._epoch, self._seq))
+        return True
+
+    def _landed(self, seq: int | None) -> bool:
+        """Did the flush with ``seq`` fully land despite the raised
+        error?  True iff the sink's commit fence — the LAST command of
+        that flush's pipeline — records exactly our (epoch, seq)."""
+        if seq is None or self._epoch is None:
+            return False
+        try:
+            e, s, _ = read_fence(self._redis, self._fence_key)
+        except BaseException:
+            return False    # sink still down: treat as not landed
+        return e == self._epoch and s == seq
+
+    def fence_state(self) -> tuple[int, int]:
+        """(epoch, last fully-landed flush seq): what a snapshot records
+        as the fence it covers.  Read after ``drain()`` for a stable
+        value (the writer thread owns these fields)."""
+        return (self._epoch or 0, self._seq_acked)
 
     def has_failed(self) -> bool:
         with self._lock:
@@ -243,8 +346,13 @@ class _RedisWriter:
             self._failed_rows = 0
         return failed
 
-    def submit(self, rows, stamp: int | None) -> None:
-        self._q.put((rows, stamp))
+    def submit(self, rows, stamp: int | None,
+               absolute: bool | None = None) -> None:
+        """Queue one writeback payload.  ``absolute`` overrides the
+        writer-level mode per payload (the exactly-once path mixes
+        absolute ledger reconciles with plain delta batches); None keeps
+        the constructor's mode."""
+        self._q.put((rows, stamp, absolute))
 
     def drain(self) -> None:
         """Block until every submitted batch was attempted.  Failures are
@@ -253,16 +361,23 @@ class _RedisWriter:
 
     def close(self) -> None:
         """Stop the thread.  Raises if batches failed and were never
-        reclaimed — silent data loss at shutdown is not an option."""
+        reclaimed — silent data loss at shutdown is not an option.  The
+        lost rows are ALSO counted (``rows_lost`` in FaultCounters)
+        before raising: callers that survive the raise — or harnesses
+        reading the fault map after the fact — still see the loss in the
+        accounting, never only in a log line."""
         if self._thread.is_alive():
             self._q.put(None)
             self._wake.set()  # cut short any in-progress backoff sleep
             self._thread.join()
         with self._lock:
             lost, err = len(self._failed), self._error
+            rows_lost = self._failed_rows
         if lost:
+            self._faults.inc("rows_lost", rows_lost)
             raise RuntimeError(
-                f"redis writer shut down with {lost} unwritten batches"
+                f"redis writer shut down with {lost} unwritten batches "
+                f"({rows_lost} window rows lost)"
             ) from err
 
 
@@ -400,6 +515,27 @@ class AdAnalyticsEngine:
         # fault/retry/recovery accounting (ROBUSTNESS.md): shared with the
         # writer thread; surfaced via RunStats.faults at end of run
         self.faults = FaultCounters()
+        # exactly-once writeback (jax.sink.exactly_once, ROBUSTNESS.md
+        # "Exactly-once") — ALL dormant when the flag is off:
+        #   _sink_totals  cumulative per-window ledger of every delta
+        #                 ever handed to the writer (the idempotent
+        #                 absolute value a reconcile writes)
+        #   _taint        windows whose last flush failed or may have
+        #                 partially applied -> next flush rewrites them
+        #                 ABSOLUTE from the ledger
+        #   _reconcile_all  resumed over a sink holding unfenced flushes:
+        #                 every flush this attempt writes absolute
+        #   _xo_baseline  the restored snapshot's (epoch, seq) fence —
+        #                 what the sink fence is compared against
+        self._xo = bool(getattr(cfg, "jax_sink_exactly_once", False))
+        self._fence_key = fence_key(cfg.kafka_topic)
+        self._sink_totals: dict[tuple[int, int], int] = {}
+        self._taint: set[tuple[int, int]] = set()
+        self._reconcile_all = False
+        self._xo_baseline: tuple[int, int] = (0, 0)
+        self._xo_attached = not self._xo
+        self._sink_epoch: int | None = None
+        self._sink_seq0 = 0
         # live telemetry (obs/): None until attach_obs — the default
         # engine pays nothing for the observability layer beyond this
         # attribute and one None check per flush writeback.  The
@@ -1126,6 +1262,8 @@ class AdAnalyticsEngine:
             else:
                 self._materialize_drains()
         self._reclaim_failed_writes()
+        if self._xo:
+            return self._flush_exactly_once(time_updated)
         if not self._pending and not self._pending_np:
             return 0
         campaigns = self.encoder.campaigns
@@ -1179,17 +1317,11 @@ class AdAnalyticsEngine:
             self._obs_lifecycle.note_flush(ts_out)
         total = len(rows) + (len(arrays) if arrays is not None else 0)
         if self.redis is not None:
-            if self._writer is None:
-                self._writer = _RedisWriter(
-                    self.redis, self.absolute_counts, self.tracer,
-                    self._note_written, faults=self.faults,
-                    retry_base_ms=self.cfg.jax_sink_retry_base_ms,
-                    retry_cap_ms=self.cfg.jax_sink_retry_cap_ms,
-                    dirty_cap_rows=self.cfg.jax_sink_dirty_cap_rows)
+            writer = self._ensure_writer()
             if rows:
-                self._writer.submit(rows, time_updated)
+                writer.submit(rows, time_updated)
             if arrays is not None:
-                self._writer.submit(arrays, time_updated)
+                writer.submit(arrays, time_updated)
         else:
             stamp = now_ms() if time_updated is None else time_updated
             if rows:
@@ -1198,9 +1330,154 @@ class AdAnalyticsEngine:
                 self._note_written(arrays, stamp)
         return total
 
+    def _ensure_writer(self) -> _RedisWriter:
+        """Get-or-start the background writeback thread (one per engine
+        lifetime).  In exactly-once mode it inherits whatever epoch/seq
+        the sink attach already claimed; with nothing claimed yet the
+        writer claims lazily at its first apply."""
+        if self._writer is None:
+            self._writer = _RedisWriter(
+                self.redis, self.absolute_counts, self.tracer,
+                self._note_written, faults=self.faults,
+                retry_base_ms=self.cfg.jax_sink_retry_base_ms,
+                retry_cap_ms=self.cfg.jax_sink_retry_cap_ms,
+                dirty_cap_rows=self.cfg.jax_sink_dirty_cap_rows,
+                exactly_once=self._xo, fence_key=self._fence_key,
+                epoch=self._sink_epoch, start_seq=self._sink_seq0)
+        return self._writer
+
+    # ------------------------------------------------------------------
+    # exactly-once writeback (jax.sink.exactly_once; ROBUSTNESS.md
+    # "Exactly-once")
+    def _xo_attach_sink(self) -> None:
+        """First fenced flush of an attempt: read the sink fence, detect
+        unfenced flushes from a previous lineage, claim the next writer
+        epoch.
+
+        Detection: ``sink_seq > snapshot_seq`` means whole flushes landed
+        after the snapshot this attempt restored (or, for a fresh attempt
+        resuming a crashed run that never checkpointed, after offset
+        zero); ``intent > seq`` on top catches a PARTIALLY applied
+        pipeline — the intent record is the first command of every flush
+        and the commit seq the last, so a timeout that landed a prefix
+        leaves intent ahead.  Either way replayed increments would
+        double-count, so the attempt switches to absolute ledger
+        reconciliation for every window it flushes.  A failed read means
+        the sink cannot be proven clean: reconcile conservatively and
+        retry the attach at the next flush."""
+        if self._xo_attached or self.redis is None:
+            return
+        base_e, base_s = self._xo_baseline
+        try:
+            e, s, i = read_fence(self.redis, self._fence_key)
+        except Exception:
+            self.faults.inc("fence_read_errors")
+            self._reconcile_all = True
+            return   # _xo_attached stays False: retry next flush
+        if max(s, i) > base_s:
+            if not self._reconcile_all:
+                self.faults.inc("sink_unfenced_resumes")
+            self._reconcile_all = True
+        epoch = max(e, base_e) + 1
+        try:
+            claim_epoch(self.redis, self._fence_key, epoch)
+        except Exception:
+            # claim failed: retry the WHOLE attach next flush (nothing
+            # is ever submitted without a claimed epoch — see
+            # _apply_fenced for why a lazy writer-side claim is unsafe).
+            # If the claim actually landed (a response-lost timeout),
+            # the re-read sees our epoch and simply claims the next one.
+            self.faults.inc("fence_read_errors")
+            return
+        self._sink_epoch = epoch
+        self._sink_seq0 = max(s, i, base_s)
+        self._xo_attached = True
+
+    def _fence_state(self) -> tuple[int, int]:
+        """The (epoch, committed seq) a snapshot records.  Stable only
+        after ``drain_writes`` (``_snapshot_sync`` guarantees it)."""
+        if self._writer is not None and self._xo:
+            return self._writer.fence_state()
+        if self._sink_epoch is not None:
+            return (self._sink_epoch, self._sink_seq0)
+        return self._xo_baseline
+
+    def _flush_exactly_once(self, time_updated: int | None) -> int:
+        """The fenced flush path.  Deltas fold into the cumulative
+        per-window ledger first; tainted windows (earlier flush failed or
+        may have partially applied) and — in reconcile mode — every
+        window are written ABSOLUTE from the ledger (idempotent: any
+        number of applications lands the same count); the rest go as the
+        canonical HINCRBY deltas.  Each submitted batch carries its
+        (epoch, seq) fence inside the same pipeline.
+
+        The ledger is the single source of truth for "what the sink
+        should hold": it is updated exactly once per delta (reclaimed
+        failed batches taint windows instead of re-merging, see
+        ``_reclaim_failed_writes``), carried in snapshots, and rebuilt by
+        replay after a resume — so an absolute write is always safe, no
+        matter what prefix of earlier flushes actually landed."""
+        self._xo_attach_sink()
+        self._fold_pending_arrays()
+        if not self._pending and not self._taint:
+            return 0
+        if self.redis is not None and self._sink_epoch is None:
+            # No claimed epoch (sink unreachable at attach): flushing
+            # unfenced would forfeit both the zombie guard and resume
+            # detection.  Hold everything — _pending is exactly the
+            # retention buffer — and retry the attach next flush.
+            return 0
+        totals = self._sink_totals
+        for key, n in self._pending.items():
+            if self.absolute_counts:
+                totals[key] = n        # absolute engines: freshest wins
+            else:
+                totals[key] = totals.get(key, 0) + n
+        if self._reconcile_all:
+            abs_keys = self._taint | set(self._pending)
+            delta_keys: list = []
+        else:
+            abs_keys = set(self._taint)
+            delta_keys = [k for k in self._pending if k not in abs_keys]
+        campaigns = self.encoder.campaigns
+        rows_abs = [(campaigns[c], ts, totals[(c, ts)])
+                    for (c, ts) in sorted(abs_keys)]
+        rows_delta = [(campaigns[c], ts, self._pending[(c, ts)])
+                      for (c, ts) in delta_keys]
+        self._pending.clear()
+        self._taint.clear()
+        if rows_abs:
+            self.faults.inc("reconciled_windows", len(rows_abs))
+        if self._obs_lifecycle is not None:
+            self._obs_lifecycle.note_flush(
+                [ts for _, ts, _ in rows_abs] +
+                [ts for _, ts, _ in rows_delta])
+        total = len(rows_abs) + len(rows_delta)
+        if self.redis is not None:
+            writer = self._ensure_writer()
+            # Ledger rewrites first: FIFO submission order keeps an
+            # absolute reconcile of a window strictly ahead of any later
+            # delta to it, so HINCRBY always lands on a reconciled base.
+            if rows_abs:
+                writer.submit(rows_abs, time_updated, absolute=True)
+            if rows_delta:
+                writer.submit(rows_delta, time_updated,
+                              absolute=self.absolute_counts)
+        else:
+            stamp = now_ms() if time_updated is None else time_updated
+            if rows_abs:
+                self._note_written(rows_abs, stamp)
+            if rows_delta:
+                self._note_written(rows_delta, stamp)
+        return total
+
     def _native_table(self):
         """(names_blob, names_off, native_store) when the sink is the
-        in-process native store, else None; built once."""
+        in-process native store, else None; built once.  Exactly-once
+        mode always returns None: the C array writeback has no fence
+        hook, and the fence must ride the SAME pipeline as its rows."""
+        if self._xo:
+            return None
         if self._camp_table is False:
             tbl = None
             store = getattr(self.redis, "_store", None)
@@ -1253,6 +1530,17 @@ class AdAnalyticsEngine:
         idx = self.encoder.campaign_index
         for batch in self._writer.take_failed():
             self.faults.inc("sink_retries", len(batch))
+            if self._xo:
+                # The ledger already counted these deltas when they left
+                # for the writer, and a failed pipeline may have landed a
+                # PREFIX of them (the partial-apply fault): re-merging
+                # would double-count, dropping would under-count.  Taint
+                # the windows instead — the next fenced flush rewrites
+                # them ABSOLUTE from the ledger, erasing whatever prefix
+                # actually landed.
+                self._taint.update((idx[camp], int(ts))
+                                   for camp, ts, _ in batch)
+                continue
             for camp, ts, n in batch:
                 if self.absolute_counts:
                     # A fresher re-drained estimate already in _pending
@@ -1295,7 +1583,7 @@ class AdAnalyticsEngine:
         drain, safe from the sampler thread at any cadence."""
         wm = self._host_wm
         writer = self._writer
-        return {
+        out = {
             "events": self.events_processed,
             "windows_written": self.windows_written,
             "watermark_lag_ms": (now_ms() - wm) if wm is not None else None,
@@ -1308,6 +1596,12 @@ class AdAnalyticsEngine:
                              + sum(int(t[0].shape[0])
                                    for t in tuple(self._pending_np))),
         }
+        if self._xo:
+            e, s = self._fence_state()
+            out["sink_fence"] = {"epoch": e, "seq": s,
+                                 "reconcile": self._reconcile_all,
+                                 "tainted_windows": len(self._taint)}
+        return out
 
     def drain_writes(self) -> None:
         """Block until every queued Redis writeback has landed.  The sync
@@ -1353,7 +1647,7 @@ class AdAnalyticsEngine:
         from streambench_tpu.checkpoint import Snapshot
 
         self._snapshot_sync()
-        return Snapshot(
+        return self._xo_decorate(Snapshot(
             offset=offset,
             meta=self._snapshot_meta(),
             counts=np.asarray(self.state.counts),
@@ -1362,7 +1656,28 @@ class AdAnalyticsEngine:
             dropped=int(self.state.dropped),
             pending=[(c, ts, n) for (c, ts), n in self._pending.items()],
             latency=sorted(self.window_latency.items()),
-        )
+        ))
+
+    def _xo_decorate(self, snap: "Snapshot") -> "Snapshot":
+        """Attach the exactly-once ledger/taint/fence to a snapshot (a
+        no-op with the flag off — snapshots stay byte-identical).  Every
+        engine family's ``snapshot()`` routes its built Snapshot through
+        here so resume-side reconciliation works for all of them.  Call
+        AFTER ``_snapshot_sync``: the fence must be the writer's drained,
+        committed seq and the taint set must include reclaimed
+        failures."""
+        if not self._xo:
+            return snap
+        e, s = self._fence_state()
+        snap.meta["sink_epoch"] = int(e)
+        snap.meta["sink_seq"] = int(s)
+        snap.extra["xo_totals"] = np.asarray(
+            [(c, ts, n)
+             for (c, ts), n in sorted(self._sink_totals.items())],
+            np.int64).reshape(-1, 3)
+        snap.extra["xo_taint"] = np.asarray(
+            sorted(self._taint), np.int64).reshape(-1, 2)
+        return snap
 
     def _check_geometry(self, snap: "Snapshot",
                         extra: dict[str, int] | None = None) -> None:
@@ -1422,6 +1737,24 @@ class AdAnalyticsEngine:
         for c, ts, n in snap.pending:
             self._pending[(int(c), int(ts))] = int(n)
         self.window_latency = {int(ts): int(v) for ts, v in snap.latency}
+        # exactly-once bookkeeping (flag off: the arrays are absent and
+        # everything below resets to its dormant state).  The sink fence
+        # itself is read lazily at the first flush (_xo_attach_sink) —
+        # the comparison baseline restored here is what that read is
+        # judged against.
+        self._sink_totals = {
+            (int(c), int(ts)): int(n)
+            for c, ts, n in snap.extra.get(
+                "xo_totals", np.empty((0, 3), np.int64))}
+        self._taint = {(int(c), int(ts))
+                       for c, ts in snap.extra.get(
+                           "xo_taint", np.empty((0, 2), np.int64))}
+        self._xo_baseline = (int(snap.meta.get("sink_epoch", 0)),
+                             int(snap.meta.get("sink_seq", 0)))
+        self._reconcile_all = False
+        self._xo_attached = not self._xo
+        self._sink_epoch = None
+        self._sink_seq0 = 0
 
     def restore(self, snap: "Snapshot") -> None:
         """Reset this engine to a snapshot; caller re-tails the journal at
@@ -1444,6 +1777,15 @@ class AdAnalyticsEngine:
     # past this many the outage is treated as permanent and close raises).
     CLOSE_RETRY_LIMIT = 8
 
+    def _close_unwritten(self) -> int:
+        """Window rows still unflushed at close: writer-retained failed
+        batches, plus — exactly-once mode — pending/tainted windows a
+        sink-unreachable attach kept from ever being submitted."""
+        n = self._writer.dirty_rows() if self._writer is not None else 0
+        if self._xo:
+            n += len(self._pending) + len(self._taint)
+        return n
+
     def close(self) -> None:
         """Final flush + fork-style latency dump
         (``AdvertisingTopologyNative.java:521-532``).  Retries the final
@@ -1452,11 +1794,22 @@ class AdAnalyticsEngine:
         self.flush(final=True)
         if self._writer is not None:
             self._writer.drain()
-            for _ in range(self.CLOSE_RETRY_LIMIT):
-                if not self._writer.has_failed():
-                    break
-                self.flush(final=True)  # reclaims failed rows, resubmits
+        for _ in range(self.CLOSE_RETRY_LIMIT):
+            if not self._close_unwritten():
+                break
+            self.flush(final=True)  # reclaims failed rows, resubmits
+            if self._writer is not None:
                 self._writer.drain()
+        if self._writer is None and self._close_unwritten():
+            # exactly-once with the sink down since before the first
+            # flush: no writer was ever started, so the raise below
+            # cannot fire — account and raise here instead (a
+            # silent-loss exit is not an option in any mode).
+            lost = self._close_unwritten()
+            self.faults.inc("rows_lost", lost)
+            raise RuntimeError(
+                f"exactly-once close with {lost} windows never flushed "
+                "(sink unreachable: no writer epoch was ever claimed)")
         if self._encode_pool is not None:
             self._encode_pool.close()
             self._encode_pool = None

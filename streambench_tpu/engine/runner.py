@@ -132,6 +132,8 @@ class StreamRunner:
                "sink_dirty_rows": tel["sink_dirty_rows"],
                "batches": self.stats.batches,
                "flushes": self.stats.flushes}
+        if "sink_fence" in tel:
+            rec["sink_fence"] = tel["sink_fence"]
         faults = self.engine.faults.snapshot()
         deltas = {k: v - self._flight_prev_faults.get(k, 0)
                   for k, v in faults.items()

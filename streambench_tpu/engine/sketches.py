@@ -160,7 +160,7 @@ class HLLDistinctEngine(_SketchEngineBase):
         self._snapshot_sync()
         meta = self._snapshot_meta()
         meta["num_registers"] = self.registers
-        return Snapshot(
+        return self._xo_decorate(Snapshot(
             offset=offset, meta=meta,
             counts=np.zeros((0, 0), np.int32),  # registers live in extra
             window_ids=np.asarray(self.state.window_ids),
@@ -170,7 +170,7 @@ class HLLDistinctEngine(_SketchEngineBase):
             latency=sorted(self.window_latency.items()),
             extra={"hll_registers": np.asarray(self.state.registers),
                    **self._intern_extra()},
-        )
+        ))
 
     def restore(self, snap) -> None:
         self._check_geometry(snap, extra={"num_registers": self.registers})
@@ -384,7 +384,7 @@ class SlidingTDigestEngine(_SketchEngineBase):
         meta = self._snapshot_meta()
         meta.update(size_ms=self.size_ms, slide_ms=self.slide_ms,
                     compression=int(self.digest.means.shape[1]))
-        return Snapshot(
+        return self._xo_decorate(Snapshot(
             offset=offset, meta=meta,
             counts=np.asarray(self.state.counts),
             window_ids=np.asarray(self.state.window_ids),
@@ -395,7 +395,7 @@ class SlidingTDigestEngine(_SketchEngineBase):
             extra={"td_means": np.asarray(self.digest.means),
                    "td_weights": np.asarray(self.digest.weights),
                    **self._intern_extra()},
-        )
+        ))
 
     def restore(self, snap) -> None:
         self._check_geometry(snap, extra=dict(
@@ -617,7 +617,7 @@ class SessionCMSEngine(_SketchEngineBase):
                     cms_total=int(self.cms.total),
                     sessions_closed=self.sessions_closed,
                     session_clicks=self.session_clicks)
-        return Snapshot(
+        return self._xo_decorate(Snapshot(
             offset=offset, meta=meta,
             counts=np.zeros((0, 0), np.int32),
             window_ids=np.zeros((0,), np.int32),  # no window ring here
@@ -631,7 +631,7 @@ class SessionCMSEngine(_SketchEngineBase):
                    "hh_ests": np.asarray(self.topk.ests),
                    "lat_hist": np.asarray(self.lat_hist),
                    **self._intern_extra()},
-        )
+        ))
 
     def restore(self, snap) -> None:
         self._check_geometry(snap, extra=dict(

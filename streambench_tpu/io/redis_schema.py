@@ -128,6 +128,45 @@ def load_ad_mapping(r: RedisLike, ad_ids: Iterable[str]) -> dict[str, str]:
 
 
 # ----------------------------------------------------------------------
+# Writeback fence (exactly-once mode, ROBUSTNESS.md "Exactly-once")
+# ----------------------------------------------------------------------
+# One HASH per (topic, partition) holding the writeback fence:
+#   intent -> flush_seq of the LAST ATTEMPTED flush (written FIRST)
+#   epoch  -> writer epoch that attempted it
+#   seq    -> flush_seq of the last FULLY LANDED flush (written LAST)
+# A flush pipeline is [intent/epoch HSET] + window rows + [seq HSET], so
+# any partial application leaves intent > seq — the signature resume
+# detection keys on.  The key never enters the ``campaigns`` SET, so the
+# canonical schema walk (walk_windows) and every stats reader skip it.
+
+def fence_key(topic: str = "", partition: int = 0) -> str:
+    return f"__streambench:fence:{topic}:{int(partition)}"
+
+
+def read_fence(r: RedisLike, key: str) -> tuple[int, int, int]:
+    """``(epoch, seq, intent)`` from the sink, zeros where absent.
+    One pipeline round trip (one fault decision under chaos wrappers);
+    non-string replies (missing field, WRONGTYPE error) read as 0."""
+    vals = r.pipeline_execute([("HGET", key, "epoch"),
+                               ("HGET", key, "seq"),
+                               ("HGET", key, "intent")])
+    out = []
+    for v in vals:
+        try:
+            out.append(int(v) if isinstance(v, str) else 0)
+        except ValueError:
+            out.append(0)
+    return out[0], out[1], out[2]
+
+
+def claim_epoch(r: RedisLike, key: str, epoch: int) -> None:
+    """Advertise a new writer epoch (zombie guard: older epochs abort
+    their flushes once they observe it).  Leaves seq/intent untouched —
+    seq continuity across epochs is what resume detection compares."""
+    r.execute("HSET", key, "epoch", str(int(epoch)))
+
+
+# ----------------------------------------------------------------------
 # Canonical window writeback (AdvertisingSpark.scala:184-208)
 # ----------------------------------------------------------------------
 
@@ -157,7 +196,8 @@ def write_windows_pipelined(r: RedisLike,
                             entries: Iterable[tuple[str, int, int]],
                             time_updated: int | None = None,
                             absolute: bool = False,
-                            cache: dict | None = None) -> int:
+                            cache: dict | None = None,
+                            fence: tuple[str, int, int] | None = None) -> int:
     """Flush many ``(campaign, window_ts, count)`` rows efficiently.
 
     Same observable schema as ``write_window``, but the existence probes for
@@ -177,9 +217,16 @@ def write_windows_pipelined(r: RedisLike,
     ``AdvertisingTopology.java:232-233``).  Cuts the two existence probes
     per already-seen row, which at catchup flush sizes (10^5 rows) is most
     of the Redis round-trip volume.
+
+    ``fence=(key, epoch, seq)`` brackets the mutation batch with the
+    exactly-once fence records: ``HSET key intent seq / epoch epoch`` as
+    the FIRST command and ``HSET key seq seq`` as the LAST, so the sink
+    states a pipeline can be left in are exactly {nothing, intent-only,
+    intent+prefix, fully-landed} — the signature
+    ``engine/pipeline._RedisWriter`` and resume detection key on.
     """
     rows = [(c, str(w), int(n)) for c, w, n in entries]
-    if not rows:
+    if not rows and fence is None:
         return 0
     stamp = str(now_ms() if time_updated is None else int(time_updated))
 
@@ -190,14 +237,20 @@ def write_windows_pipelined(r: RedisLike,
         if hasattr(store, "write_windows_bulk"):
             # Native store: the whole probe/create/LPUSH/HINCRBY sequence
             # runs in C (~100 ns/row); it maintains its own existence
-            # view, so no client-side id cache is involved.
+            # view, so no client-side id cache is involved.  In-process
+            # there is no partial-apply failure mode, so the fence rides
+            # as one HSET after the bulk write.
             store.write_windows_bulk(rows, stamp, absolute)
+            if fence is not None:
+                key, epoch, seq = fence
+                r.execute("HSET", key, "intent", str(seq),
+                          "epoch", str(epoch), "seq", str(seq))
             return len(rows)
         # In-process Python store: one lock hold, no command tuples — the
         # embedded-state-store fast path (the RESP/TCP path below stays
         # byte-identical for real Redis).
         _bulk_write_windows(store, rows, stamp, absolute,
-                            win_cache, list_cache)
+                            win_cache, list_cache, fence=fence)
         return len(rows)
     # Probe only rows the cache can't resolve.
     need = [i for i, (c, w, _) in enumerate(rows)
@@ -240,27 +293,45 @@ def write_windows_pipelined(r: RedisLike,
         if wuuid is None:
             wuuid = _fresh_id()
             new_win[(campaign, wts)] = wuuid
-            win_reg[(campaign, wts)] = len(muts)
-            muts.append(("HSET", campaign, wts, wuuid))
             luuid = list_cache.get(campaign) or new_list.get(campaign)
             if luuid is None:
                 luuid = _fresh_id()
                 new_list[campaign] = luuid
                 list_reg[campaign] = len(muts)
                 muts.append(("HSET", campaign, "windows", luuid))
+            # Registration order matters under the partial-apply fault
+            # (exactly-once chaos): the ``wts -> wuuid`` HSET is the
+            # COMMIT of the window's creation and must come LAST of the
+            # trio.  Any torn prefix then leaves either no registration
+            # (retry recreates everything) or a list entry without the
+            # hash mapping (harmless: the walk skips it, the retry
+            # re-registers).  The old order could land the hash mapping
+            # WITHOUT the list entry — the retry would cache-hit the
+            # uuid and never repair the list, leaving a window invisible
+            # to every canonical reader.
             muts.append(("LPUSH", luuid, wts))
+            win_reg[(campaign, wts)] = len(muts)
+            muts.append(("HSET", campaign, wts, wuuid))
         if absolute:
             muts.append(("HSET", wuuid, "seen_count", str(count),
                          "time_updated", stamp))
         else:
             muts.append(("HINCRBY", wuuid, "seen_count", str(count)))
             muts.append(("HSET", wuuid, "time_updated", stamp))
+    off = 0
+    if fence is not None:
+        fkey, epoch, seq = fence
+        # intent+epoch FIRST, commit seq LAST: any partial application
+        # leaves intent > seq on the sink
+        muts = ([("HSET", fkey, "intent", str(seq), "epoch", str(epoch))]
+                + muts + [("HSET", fkey, "seq", str(seq))])
+        off = 1
     res = r.pipeline_execute(muts)
     for key, i in win_reg.items():
-        if isinstance(res[i], RespError):
+        if isinstance(res[i + off], RespError):
             del new_win[key]
     for campaign, i in list_reg.items():
-        if isinstance(res[i], RespError):
+        if isinstance(res[i + off], RespError):
             del new_list[campaign]
     win_cache.update(new_win)
     list_cache.update(new_list)
@@ -269,11 +340,13 @@ def write_windows_pipelined(r: RedisLike,
 
 def _bulk_write_windows(store: FakeRedisStore, rows, stamp: str,
                         absolute: bool, win_cache: dict,
-                        list_cache: dict) -> None:
+                        list_cache: dict, fence=None) -> None:
     """Canonical-schema writeback directly against the in-process store's
     dicts, one lock hold for the whole flush.  Observable state is
     IDENTICAL to the pipelined path (same keys, same hash fields, same
-    list contents) — asserted by the schema round-trip tests."""
+    list contents) — asserted by the schema round-trip tests.  A fence
+    lands under the same lock hold: rows + fence are truly atomic here
+    (the partial-apply failure mode only exists on the command path)."""
     with store._lock:
         hashes = store._hashes
         lists = store._lists
@@ -325,6 +398,12 @@ def _bulk_write_windows(store: FakeRedisStore, rows, stamp: str,
                 wh["seen_count"] = str(int(wh.get("seen_count", "0"))
                                        + count)
             wh["time_updated"] = stamp
+        if fence is not None:
+            fkey, epoch, seq = fence
+            fh = hashes.setdefault(fkey, {})
+            fh["intent"] = str(seq)
+            fh["epoch"] = str(epoch)
+            fh["seq"] = str(seq)
 
 
 # ----------------------------------------------------------------------

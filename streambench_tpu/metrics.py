@@ -50,6 +50,22 @@ class FaultCounters:
       journal
     - ``flush_stalls``      — flush-cadence gaps past the stall threshold
       (``StallDetector`` with ``counters`` wired)
+    - ``rows_lost``         — window rows abandoned at writer shutdown
+      after ``CLOSE_RETRY_LIMIT`` exhausted (counted AND raised: a
+      silent-loss run can never report a clean exit)
+
+    Exactly-once mode (``jax.sink.exactly_once``, ROBUSTNESS.md):
+
+    - ``fence_conflicts``   — flushes aborted because a newer writer
+      epoch owns the sink (zombie guard)
+    - ``dedup_suppressed_flushes`` — failed flushes whose commit fence
+      proved they fully landed; the retry was suppressed
+    - ``reconciled_windows`` — windows rewritten absolute from the
+      cumulative ledger (tainted or reconcile-mode flushes)
+    - ``sink_unfenced_resumes`` — resumes that found sink fence state
+      past the snapshot's (unfenced flushes -> reconcile mode)
+    - ``fence_read_errors`` — sink-fence reads that failed (attach
+      retried; reconcile assumed conservatively)
 
     Writers are the Redis flusher thread, the chaos injector, and the
     supervisor — concurrent by construction, hence the lock.  ``inc`` is

@@ -102,6 +102,15 @@ def engine_collector(engine, reader=None, runner=None, registry=None):
         rec["watermark_lag_ms"] = tel["watermark_lag_ms"]
         rec["sink_dirty_rows"] = tel["sink_dirty_rows"]
         rec["pending_rows"] = tel["pending_rows"]
+        if "sink_fence" in tel:
+            # exactly-once writeback: the (epoch, seq) fence plus the
+            # reconcile flag — a resumed-in-reconcile run is visible in
+            # the time series, not only in the fault counters
+            rec["sink_fence"] = tel["sink_fence"]
+            if reg is not None:
+                reg.gauge("streambench_sink_fence_seq",
+                          "last committed exactly-once flush seq"
+                          ).set(tel["sink_fence"]["seq"])
         if reader is not None:
             bb = getattr(reader, "backlog_bytes", None)
             rec["backlog_bytes"] = bb() if bb is not None else None

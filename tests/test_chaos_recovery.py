@@ -14,6 +14,7 @@ from streambench_tpu.chaos import (
     FaultPlan,
     Supervisor,
     check_at_least_once,
+    replay_note,
 )
 from streambench_tpu.checkpoint import Checkpointer
 from streambench_tpu.config import default_config
@@ -81,7 +82,11 @@ def test_all_three_surfaces_within_oracle_bounds(tmp_path):
     assert inj.counters.get("journal_faults") > 0
     v = check_at_least_once(r, str(tmp_path),
                             broker.topic_path(cfg.kafka_topic),
-                            st.replay_segments, st.carried)
+                            st.replay_segments, st.carried,
+                            repro=replay_note(
+                                seed=plan.seed,
+                                topic_path=broker.topic_path(
+                                    cfg.kafka_topic)))
     assert v.ok, (v.summary(), v.undercounts[:3], v.overcounts[:3])
     assert v.windows > 0
     # cumulative accounting survived every crash: the resumed engine's

@@ -1,0 +1,444 @@
+"""Exactly-once writeback: the fence protocol, executed (ISSUE 5).
+
+The acceptance property: with ``jax.sink.exactly_once`` on, a supervised
+chaos run over all three fault surfaces — INCLUDING the non-atomic
+partial-apply sink fault the at-least-once model cannot represent —
+finishes with ``redis_count(w) == oracle(w)`` for EVERY window, no
+bound, no slack.  Plus the unit surfaces: zombie-writer epoch fencing,
+fence-based retry dedup, taint-driven absolute reconcile, and the
+``rows_lost`` shutdown accounting (satellite).
+"""
+
+import json
+import random
+
+import pytest
+
+from streambench_tpu.chaos import (
+    FaultInjector,
+    FaultPlan,
+    Supervisor,
+    check_exactly_once,
+    replay_note,
+)
+from streambench_tpu.checkpoint import Checkpointer
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import (
+    as_redis,
+    fence_key,
+    read_seen_counts,
+    seed_campaigns,
+)
+
+XO = {"jax_sink_exactly_once": True}
+
+
+# ----------------------------------------------------------------------
+# unit surface: engines driven by hand over a tiny ad space
+# ----------------------------------------------------------------------
+
+MAPPING = {f"ad{i}": f"camp{i % 3}" for i in range(9)}
+
+
+def view_lines(n, t0=1_000_000, step=10):
+    return [json.dumps({"user_id": "u", "page_id": "p",
+                        "ad_id": f"ad{i % 9}", "ad_type": "banner",
+                        "event_type": "view",
+                        "event_time": str(t0 + i * step),
+                        "ip_address": "1.2.3.4"}).encode()
+            for i in range(n)]
+
+
+def make_engine(r, **over):
+    cfg = default_config(jax_batch_size=64, jax_sink_retry_base_ms=1,
+                         jax_sink_retry_cap_ms=2, **XO, **over)
+    return AdAnalyticsEngine(cfg, MAPPING, redis=r)
+
+
+def total_counts(r):
+    return {(c, ts): n for c, per in read_seen_counts(r).items()
+            for ts, n in per.items()}
+
+
+def test_flag_off_writes_no_fence():
+    """Default-off: no fence key, no ledger — the sink state is
+    byte-identical to the pre-fence writeback."""
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, ["camp0", "camp1", "camp2"])
+    cfg = default_config(jax_batch_size=64)
+    eng = AdAnalyticsEngine(cfg, MAPPING, redis=r)
+    eng.process_lines(view_lines(100))
+    eng.flush()
+    eng.close()
+    assert r.execute("HGET", fence_key(cfg.kafka_topic), "seq") is None
+    assert eng._sink_totals == {} and not eng._taint
+
+
+def test_fenced_flush_commits_fence_and_counts():
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, ["camp0", "camp1", "camp2"])
+    eng = make_engine(r)
+    eng.process_lines(view_lines(200))
+    eng.flush()
+    eng.drain_writes()
+    fk = fence_key(eng.cfg.kafka_topic)
+    assert r.execute("HGET", fk, "epoch") == "1"
+    assert r.execute("HGET", fk, "seq") == "1"
+    assert r.execute("HGET", fk, "intent") == "1"
+    counts = total_counts(r)
+    assert sum(counts.values()) == 200
+    eng.process_lines(view_lines(200))
+    eng.flush()
+    eng.drain_writes()
+    assert r.execute("HGET", fk, "seq") == "2"
+    assert sum(total_counts(r).values()) == 400
+    eng.close()
+
+
+def test_zombie_writer_is_fenced_out():
+    """Satellite: two writers on one sink — the older epoch's flush must
+    be rejected and counted (``fence_conflicts``), the newer epoch's
+    rows must land intact."""
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, ["camp0", "camp1", "camp2"])
+    a = make_engine(r)
+    a.process_lines(view_lines(90))
+    a.flush()
+    a.drain_writes()                      # epoch 1, 90 views on the sink
+    before = total_counts(r)
+    assert sum(before.values()) == 90
+
+    b = make_engine(r)                    # same sink, fresh lineage
+    b.process_lines(view_lines(90, t0=2_000_000))
+    b.flush()
+    b.drain_writes()                      # claims epoch 2
+    fk = fence_key(b.cfg.kafka_topic)
+    assert r.execute("HGET", fk, "epoch") == "2"
+
+    # the superseded writer keeps draining: its flush must be DROPPED,
+    # not applied and not retained for retry
+    a.process_lines(view_lines(90))
+    a.flush()
+    a.drain_writes()
+    assert a.faults.get("fence_conflicts") >= 1
+    assert not a._writer.has_failed()
+    after = total_counts(r)
+    # epoch-1 windows untouched by the stale flush, epoch-2 rows intact
+    for key, n in before.items():
+        assert after[key] == n, (key, after[key], n)
+    assert sum(after.values()) == 180
+    b.close()
+    # a.close() must not raise: fenced-out batches are not "unwritten"
+    a.close()
+
+
+class _ApplyThenRaise:
+    """Sink proxy: applies the window-mutation pipeline FULLY, then
+    raises — the response-lost timeout (the fence commit is on the sink
+    but the writer saw an error)."""
+
+    def __init__(self, target):
+        self._target = target
+        self.armed = 0
+
+    def execute(self, *args):
+        return self._target.execute(*args)
+
+    def pipeline_execute(self, commands):
+        cmds = list(commands)
+        res = self._target.pipeline_execute(cmds)
+        if self.armed and any(c[0] in ("HINCRBY",) or
+                              (c[0] == "HSET" and "intent" in c)
+                              for c in cmds):
+            self.armed -= 1
+            raise TimeoutError("stub: response lost after full apply")
+        return res
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._target, name)
+
+
+def test_fence_dedup_suppresses_retry_of_landed_flush():
+    """A flush whose pipeline fully landed but whose response was lost
+    must NOT be re-applied: the commit fence proves it landed, the retry
+    is suppressed, and the counts stay exact."""
+    store = as_redis(FakeRedisStore())
+    seed_campaigns(store, ["camp0", "camp1", "camp2"])
+    proxy = _ApplyThenRaise(store)
+    eng = make_engine(proxy)
+    eng.process_lines(view_lines(120))
+    proxy.armed = 1
+    eng.flush()
+    eng.drain_writes()
+    assert eng.faults.get("dedup_suppressed_flushes") == 1
+    assert not eng._writer.has_failed()   # nothing retained
+    assert sum(total_counts(store).values()) == 120
+    # and the windows are NOT tainted: next flush is plain deltas
+    eng.process_lines(view_lines(120))
+    eng.flush()
+    eng.drain_writes()
+    assert sum(total_counts(store).values()) == 240
+    assert eng.faults.get("reconciled_windows") == 0
+    eng.close()
+
+
+def test_partial_apply_is_reconciled_absolute():
+    """The partial-apply fault: a prefix of the pipeline lands, the
+    fence commit does not.  The retry must rewrite the tainted windows
+    ABSOLUTE from the ledger — final counts exact, never prefix-doubled."""
+    store = as_redis(FakeRedisStore())
+    seed_campaigns(store, ["camp0", "camp1", "camp2"])
+    inj = FaultInjector(FaultPlan(sink_faults={4: "partial"}))
+    eng = make_engine(inj.wrap_redis(store))
+    eng.process_lines(view_lines(120))
+    # sink op stream: 0 = attach fence read, 1 = epoch claim, 2 = writer
+    # epoch pre-check, 3 = existence probes, 4 = the mutation pipeline
+    # -> PARTIAL apply (intent lands + a prefix of rows, commit doesn't)
+    eng.flush()
+    eng.drain_writes()
+    assert eng.faults.get("sink_errors") >= 1
+    fk = fence_key(eng.cfg.kafka_topic)
+    # the partial signature: intent ran ahead of the commit seq
+    assert int(store.execute("HGET", fk, "intent") or 0) \
+        > int(store.execute("HGET", fk, "seq") or 0)
+    # retry path: reclaim taints the windows, next flush rewrites them
+    eng.flush()
+    eng.drain_writes()
+    assert eng.faults.get("reconciled_windows") > 0
+    assert sum(total_counts(store).values()) == 120
+    eng.close()
+    assert total_counts(store) == {
+        ("camp0", 1_000_000): 40, ("camp1", 1_000_000): 40,
+        ("camp2", 1_000_000): 40}
+
+
+def test_rows_lost_counted_at_close(tmp_path):
+    """Satellite bugfix: rows abandoned when close() exhausts
+    CLOSE_RETRY_LIMIT are counted as ``rows_lost`` in FaultCounters (and
+    close still raises — a silent-loss run can never exit clean)."""
+    class _DeadSink:
+        def execute(self, *args):
+            raise ConnectionRefusedError("down")
+
+        def pipeline_execute(self, commands):
+            raise ConnectionRefusedError("down")
+
+    eng = AdAnalyticsEngine(
+        default_config(jax_batch_size=64, jax_sink_retry_base_ms=1,
+                       jax_sink_retry_cap_ms=2),
+        MAPPING, redis=_DeadSink())
+    eng.CLOSE_RETRY_LIMIT = 2
+    eng.process_lines(view_lines(50))
+    with pytest.raises(RuntimeError, match="rows lost"):
+        eng.close()
+    assert eng.faults.get("rows_lost") > 0
+    assert eng.faults.get("sink_errors") > 0
+
+
+def test_replay_note_embeds_node_and_seed(monkeypatch):
+    monkeypatch.setenv("PYTEST_CURRENT_TEST",
+                       "tests/test_x.py::test_y[3] (call)")
+    note = replay_note(seed=1234, topic_path="/tmp/topic",
+                       overrides={"jax.sink.exactly_once": True})
+    assert "python -m pytest 'tests/test_x.py::test_y[3]' -q" in note
+    assert "seed=1234" in note and "/tmp/topic" in note
+    assert "jax.sink.exactly_once=True" in note
+
+
+# ----------------------------------------------------------------------
+# acceptance: supervised chaos sweeps with the flag on
+# ----------------------------------------------------------------------
+
+def setup_run(tmp_path, events=12_000, **cfg_over):
+    # redis_hashtable="": the close-time fork latency dump is
+    # diagnostics, not counts — keeping it off the faulted op stream
+    # keeps the plan indices on the writeback path under test
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2,
+                         jax_sink_retry_base_ms=1, jax_sink_retry_cap_ms=4,
+                         redis_hashtable="", **XO, **cfg_over)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=events,
+                 rng=random.Random(7), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    return cfg, r, broker, mapping
+
+
+def supervise(tmp_path, cfg, r, broker, mapping, plan, seed=1):
+    inj = FaultInjector(plan)
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+
+    def make_runner():
+        eng = AdAnalyticsEngine(cfg, mapping, redis=inj.wrap_redis(r))
+        reader = inj.wrap_reader(broker.reader(cfg.kafka_topic))
+        return StreamRunner(eng, reader, checkpointer=ckpt,
+                            crash_points=inj.scheduler)
+
+    sup = Supervisor(make_runner, backoff_base_ms=1, backoff_cap_ms=4,
+                     seed=seed, max_no_progress_restarts=8)
+    st = sup.run(catchup=True)
+    assert st.completed, f"supervised run did not complete: {st.errors}"
+    sup.runner.engine.close()
+    return st, inj, sup
+
+
+def acceptance_plan(partial=True):
+    """The ISSUE-1 acceptance faults + the partial-apply surface.  The
+    fenced writeback spends ~3 sink ops per flush attempt (fence
+    pre-check, apply, landed-check on failure), so the density is lower
+    than the at-least-once plan's over a wider index window — the same
+    count of faulted ops, without starving close()'s bounded retries of
+    any clean tail."""
+    plan = FaultPlan.generate(
+        1234,
+        sink_rate=0.12, sink_ops=60, sink_outage=(5, 6),
+        sink_partial_rate=0.08 if partial else 0.0,
+        journal_rate=0.4, journal_polls=12,
+        crashes=0)
+    return FaultPlan(seed=plan.seed, sink_faults=plan.sink_faults,
+                     journal_faults=plan.journal_faults,
+                     crashes=(("batch", 5), ("flush", 1), ("batch", 2),
+                              ("checkpoint", 1)))
+
+
+def test_all_three_surfaces_exactly_once(tmp_path):
+    """The headline: sink outage + scattered faults + PARTIAL pipeline
+    applies + torn journal reads + a 4-crash script — and every window
+    still equals the oracle exactly."""
+    cfg, r, broker, mapping = setup_run(tmp_path)
+    plan = acceptance_plan()
+    assert any(k == "partial" for k in plan.sink_faults.values()), \
+        "plan rolled no partial-apply fault; widen sink_partial_rate"
+    st, inj, sup = supervise(tmp_path, cfg, r, broker, mapping, plan)
+    assert st.crashes >= 3
+    assert inj.counters.get("chaos_sink_faults") > 0
+    assert inj.counters.get("journal_faults") > 0
+    v = check_exactly_once(
+        r, str(tmp_path),
+        repro=replay_note(seed=plan.seed,
+                          topic_path=broker.topic_path(cfg.kafka_topic),
+                          overrides={"jax.sink.exactly_once": True}))
+    assert v.ok, (v.summary(), v.undercounts[:3], v.overcounts[:3])
+    assert v.windows > 0 and v.exact == v.windows
+    assert sup.runner.engine.events_processed == 12_000
+
+
+def test_all_three_surfaces_exactly_once_with_ingest_pipeline(tmp_path):
+    """The same sweep with the staged ingest pipeline ON: fenced flushes
+    and folded-offset checkpoints must compose."""
+    cfg, r, broker, mapping = setup_run(tmp_path,
+                                        jax_ingest_pipeline="on")
+    plan = acceptance_plan()
+    st, inj, sup = supervise(tmp_path, cfg, r, broker, mapping, plan)
+    assert st.crashes >= 3
+    v = check_exactly_once(
+        r, str(tmp_path),
+        repro=replay_note(seed=plan.seed,
+                          topic_path=broker.topic_path(cfg.kafka_topic),
+                          overrides={"jax.sink.exactly_once": True,
+                                     "jax.ingest.pipeline": "on"}))
+    assert v.ok, (v.summary(), v.undercounts[:3], v.overcounts[:3])
+    assert v.exact == v.windows > 0
+    assert sup.runner.engine.events_processed == 12_000
+    assert sup.runner._pipeline is not None
+
+
+def test_crash_after_flush_reconciles_to_exact(tmp_path):
+    """The replay window hit on purpose (the at-least-once suite's
+    within-bound scenario): crash right after a flush landed, BEFORE the
+    covering snapshot.  With the fence on, the resume must DETECT the
+    unfenced flush (sink_seq > snapshot_seq) and reconcile to exact
+    equality — the overcount the bound used to allow is gone."""
+    cfg, r, broker, mapping = setup_run(tmp_path, events=6_000)
+    plan = FaultPlan(crashes=(("batch", 3), ("flush", 1)))
+    st, _, sup = supervise(tmp_path, cfg, r, broker, mapping, plan)
+    assert st.crashes == 2
+    # the resumed attempts saw the unfenced flushes and reconciled
+    merged = dict(st.stats.faults)
+    assert merged.get("sink_unfenced_resumes", 0) > 0, merged
+    assert merged.get("reconciled_windows", 0) > 0, merged
+    v = check_exactly_once(r, str(tmp_path))
+    assert v.ok, (v.summary(), v.undercounts[:3], v.overcounts[:3])
+    assert v.exact == v.windows > 0
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("xo")
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2,
+                         jax_sink_retry_base_ms=1, jax_sink_retry_cap_ms=4,
+                         redis_hashtable="", **XO)
+    broker = FileBroker(str(tmp / "broker"))
+    gen.do_setup(None, cfg, broker=broker, events_num=6_000,
+                 rng=random.Random(11), workdir=str(tmp))
+    mapping = gen.load_ad_mapping_file(str(tmp / gen.AD_TO_CAMPAIGN_FILE))
+    campaigns, _ = gen.load_ids(str(tmp))
+    return tmp, cfg, broker, mapping, campaigns
+
+
+def xo_sweep_seed(dataset, tmp_path, seed: int, flightrec=None) -> None:
+    """One randomized supervised run under the flag; asserts EXACT
+    oracle equality (the 4-seed subset is the tier-1 CI leg)."""
+    tmp, cfg, broker, mapping, campaigns = dataset
+    rng = random.Random(seed)
+    crashes = []
+    for _ in range(rng.randrange(1, 5)):
+        kind = rng.choice(("batch", "batch", "flush", "checkpoint"))
+        n = rng.randrange(1, 9) if kind == "batch" else 1
+        crashes.append((kind, n))
+    plan = FaultPlan.generate(seed, sink_rate=0.08, sink_ops=24,
+                              sink_partial_rate=0.12)
+    plan = FaultPlan(seed=seed, sink_faults=plan.sink_faults,
+                     crashes=tuple(crashes))
+    inj = FaultInjector(plan)
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, campaigns)
+    ckpt = Checkpointer(str(tmp_path / f"ckpt-{seed}"))
+
+    def make_runner():
+        eng = AdAnalyticsEngine(cfg, mapping, redis=inj.wrap_redis(r))
+        reader = inj.wrap_reader(broker.reader(cfg.kafka_topic))
+        return StreamRunner(eng, reader, checkpointer=ckpt,
+                            crash_points=inj.scheduler,
+                            flightrec=flightrec)
+
+    topic = broker.topic_path(cfg.kafka_topic)
+    repro = replay_note(seed=seed, topic_path=topic,
+                        overrides={"jax.sink.exactly_once": True,
+                                   "jax.batch.size": 256})
+    sup = Supervisor(make_runner, backoff_base_ms=1, backoff_cap_ms=2,
+                     seed=seed, max_no_progress_restarts=len(crashes) + 1,
+                     flightrec=flightrec)
+    st = sup.run(catchup=True)
+    assert st.completed and not st.gave_up, (seed, st.errors, repro)
+    sup.runner.engine.close()
+    v = check_exactly_once(r, str(tmp), repro=repro)
+    assert v.ok, (seed, v.summary(), v.undercounts[:3], v.overcounts[:3])
+    assert v.exact == v.windows > 0, (seed, repro)
+    assert sup.runner.engine.events_processed == 6_000, (seed, repro)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_randomized_crash_boundaries_exactly_once_fast(dataset, tmp_path,
+                                                       seed):
+    # flight recorder armed (satellite: a red CI sweep ships its black
+    # box — the workflow uploads flight_*.jsonl from the basetemp)
+    from streambench_tpu.obs import FlightRecorder
+
+    xo_sweep_seed(dataset, tmp_path, seed,
+                  flightrec=FlightRecorder(str(tmp_path), capacity=64))
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(4, 24))
+def test_randomized_crash_boundaries_exactly_once_sweep(dataset, tmp_path,
+                                                        seed):
+    xo_sweep_seed(dataset, tmp_path, seed)

@@ -15,6 +15,7 @@ from streambench_tpu.chaos import (
     FaultPlan,
     Supervisor,
     check_at_least_once,
+    replay_note,
 )
 from streambench_tpu.chaos.plan import EngineCrash
 from streambench_tpu.checkpoint import Checkpointer
@@ -75,13 +76,18 @@ def crash_sweep_seed(dataset, tmp_path, seed: int) -> None:
     # the give-up policy (tested separately below)
     sup = Supervisor(make_runner, backoff_base_ms=1, backoff_cap_ms=2,
                      seed=seed, max_no_progress_restarts=len(crashes) + 1)
+    topic = broker.topic_path(cfg.kafka_topic)
+    # a red seed must be one paste away from a bit-identical replay
+    repro = replay_note(seed=seed, topic_path=topic,
+                        overrides={"jax.batch.size": 256,
+                                   "jax.scan.batches": 2})
     st = sup.run(catchup=True)
-    assert st.completed and not st.gave_up, (seed, st.errors)
+    assert st.completed and not st.gave_up, (seed, st.errors, repro)
     sup.runner.engine.close()
-    v = check_at_least_once(r, str(tmp), broker.topic_path(cfg.kafka_topic),
-                            st.replay_segments, st.carried)
+    v = check_at_least_once(r, str(tmp), topic,
+                            st.replay_segments, st.carried, repro=repro)
     assert v.ok, (seed, v.summary(), v.undercounts[:3], v.overcounts[:3])
-    assert sup.runner.engine.events_processed == 6_000, seed
+    assert sup.runner.engine.events_processed == 6_000, (seed, repro)
 
 
 @pytest.mark.parametrize("seed", range(4))
