@@ -26,6 +26,7 @@ diagnostics (platform, stage breakdown, latency deciles) go to stderr.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import random
@@ -121,17 +122,28 @@ def resolve_platform(window_s: float = PROBE_WINDOW_S) -> str:
 
 
 # ----------------------------------------------------------------------
+# Stdout lines must survive tail truncation (VERDICT item 6): the driver
+# keeps only the LAST ~2 KB of stdout and parses the last JSON line, and
+# the r05 run's single rich headline line was cut mid-JSON ("parsed":
+# null in BENCH_r05.json).  Every emitted line is therefore COMPACT and
+# hard-capped; the rich artifact lives in bench_latency.json only.
+COMPACT_LINE_MAX = 4096
+
+
 class HeadlineEmitter:
     """Parse-proof artifact emission (the round-4 failure mode: the
     driver SIGKILLed the bench before its single end-of-run print, and
     the whole run evaporated).
 
-    The driver takes the LAST JSON line on stdout, so the headline is
-    re-printed — and ``bench_latency.json`` rewritten — after EVERY
-    completed phase: catchup, each ladder rung, each config row.  A kill
-    at any point still leaves the richest completed view on record,
-    mirroring the reference harness collecting stats even during
-    teardown (``stream-bench.sh:231-236``)."""
+    Emission is re-done after EVERY completed phase — catchup, each
+    ladder rung, each config row — two ways: the RICH view rewrites
+    ``bench_latency.json`` atomically, and stdout gets one COMPACT
+    single-line JSON summary (``<= COMPACT_LINE_MAX`` bytes, enforced by
+    progressive field stripping) so a consumer that keeps only a tail of
+    the log still ends on a parseable line.  A kill at any point leaves
+    the richest completed view on record, mirroring the reference
+    harness collecting stats even during teardown
+    (``stream-bench.sh:231-236``)."""
 
     def __init__(self, latency_path: str):
         self.latency_path = latency_path
@@ -139,6 +151,55 @@ class HeadlineEmitter:
 
     def update(self, **fields) -> None:
         self.headline.update(fields)
+
+    def compact_line(self) -> str:
+        """The bounded per-phase stdout summary.  Keeps the contract
+        keys consumers rely on (metric/value/unit/vs_baseline/phase,
+        per-config compact rows) and the PR-6 measurement headlines
+        (method table winner, device-decode A/B); sheds detail fields
+        until it fits the cap."""
+        h = self.headline
+        rows = []
+        for c in (h.get("configs") or []):
+            row = {"config": c.get("config")}
+            for k in ("catchup_events_per_s", "oracle", "skipped",
+                      "error"):
+                if c.get(k) is not None:
+                    row[k] = c[k]
+            p = c.get("paced")
+            if isinstance(p, dict):
+                row["paced_p99_ms"] = p.get("p99_ms")
+                row["sustained"] = p.get("sustained")
+            rows.append(row)
+        dev = h.get("device") or {}
+        sweep = h.get("latency_sweep") or {}
+        compact = {
+            "compact": True,
+            "phase": h.get("phase"),
+            "metric": h.get("metric"),
+            "value": h.get("value"),
+            "unit": h.get("unit"),
+            "vs_baseline": h.get("vs_baseline"),
+            "platform": h.get("platform"),
+            "max_sustained_rate": sweep.get("max_sustained_rate"),
+            "configs": rows,
+            "device": {k: dev[k] for k in (
+                "chunk_events", "encode_ms", "dispatch_ms",
+                "device_ms_meas", "decode_probe_ms",
+                "decode_dispatch_ms", "decode_chunk_ms_pipelined")
+                if k in dev} or None,
+            "methods": h.get("methods_compact"),
+            "device_decode": h.get("device_decode_ab"),
+            "artifact": os.path.basename(self.latency_path),
+        }
+        line = json.dumps(compact)
+        for drop in ("device_decode", "methods", "device", "configs",
+                     "max_sustained_rate"):
+            if len(line) <= COMPACT_LINE_MAX:
+                break
+            compact.pop(drop, None)
+            line = json.dumps(compact)
+        return line
 
     def emit(self) -> None:
         side = {
@@ -150,6 +211,11 @@ class HeadlineEmitter:
             # — the README's evidence contract says every quoted number
             # lives here, and occupancy was stdout-only until r5
             "device": self.headline.get("device"),
+            # per-method kernel micro-bench + the device-decode A/B
+            # (ISSUE 6): the measured inputs default_method and
+            # jax.decode.device=auto consult
+            "methods": self.headline.get("methods"),
+            "device_decode_ab": self.headline.get("device_decode_ab"),
             # per-window latency attribution of the best catchup rep
             # (obs.lifecycle; STREAMBENCH_BENCH_ATTRIBUTION=1 or a
             # metrics dir opts in) — the per-stage ms, per WINDOW
@@ -166,7 +232,7 @@ class HeadlineEmitter:
             os.replace(tmp, self.latency_path)
         except OSError as e:
             log(f"could not write {self.latency_path}: {e}")
-        print(json.dumps(self.headline), flush=True)
+        print(self.compact_line(), flush=True)
 
 
 # ----------------------------------------------------------------------
@@ -338,7 +404,50 @@ def _measure_device_time(cfg, mapping, broker) -> dict:
     group_n = sum(b.n for b in group)
     device_meas_s = (time.perf_counter() - t0) / dev_iters
     device_est_s = max(pipelined_s - encode_s, 0.0)
+
+    # Device-decode arm (ISSUE 6): the same chunk through the raw-bytes
+    # path — the host stage is a layout PROBE (no columns), the decode
+    # itself runs inside the fused device step.  Per-stage keys mirror
+    # the host arm's encode/dispatch split so the artifact shows where
+    # host encode_ms went.
+    decode: dict = {"decode_supported": False}
+    try:
+        import dataclasses as _dc
+
+        eng_dd = AdAnalyticsEngine(
+            _dc.replace(cfg, jax_decode_device="on"), mapping)
+        if eng_dd._devdecode is not None and use_block and block:
+            eng_dd.warmup()
+            eng_dd.process_block(block)        # compile real shapes
+            jax.block_until_ready(eng_dd.state.counts)
+            dd = eng_dd._devdecode
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                dd.prepare(block)
+            probe_s = (time.perf_counter() - t0) / iters
+            pre_blocks = eng_dd.encode_raw_block(block)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                eng_dd.fold_batches(pre_blocks)
+            jax.block_until_ready(eng_dd.state.counts)
+            dd_dispatch_s = (time.perf_counter() - t0) / iters
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                eng_dd.process_block(block)
+            jax.block_until_ready(eng_dd.state.counts)
+            dd_pipe_s = (time.perf_counter() - t0) / iters
+            decode = {
+                "decode_supported": True,
+                "decode_probe_ms": round(probe_s * 1e3, 3),
+                "decode_dispatch_ms": round(dd_dispatch_s * 1e3, 3),
+                "decode_chunk_ms_pipelined": round(dd_pipe_s * 1e3, 3),
+                "decode_fallback_rows": eng_dd._devdecode.rows_fallback,
+            }
+    except Exception as e:  # the decode sample must not kill the probe
+        log(f"device-decode sample failed (non-fatal): {e!r}")
+        decode = {"decode_supported": False, "decode_error": repr(e)}
     return {
+        **decode,
         "chunk_events": n,
         "ingest_mode": "block" if use_block else "lines",
         "round_trip_ms": round(round_trip_s * 1e3, 3),
@@ -580,6 +689,30 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
 
 
 MIN_RUNG_WINDOWS = 12
+# Per-rung wall-time budget guard (VERDICT 6 / the BENCH_r04 rc-124
+# lesson): a paced rung whose full duration would overrun the envelope
+# is CLAMPED down to what fits (>= MIN_RUNG_S so it still yields a few
+# unique windows) instead of either running past the driver's kill or
+# silently vanishing.  A rung that cannot fit even clamped is skipped.
+MIN_RUNG_S = 30.0
+RUNG_MARGIN_S = 45.0
+
+
+def _clamped_rung_duration(deadline: float | None, duration_s: float,
+                           margin_s: float = RUNG_MARGIN_S,
+                           now: float | None = None) -> float | None:
+    """The duration one paced rung may use: the requested one when it
+    fits the remaining budget (+margin for setup/teardown/judging),
+    clamped down to the remaining room when only a shorter rung fits,
+    None when not even ``MIN_RUNG_S`` does."""
+    if deadline is None:
+        return duration_s
+    room = deadline - (time.monotonic() if now is None else now) - margin_s
+    if room >= duration_s:
+        return duration_s
+    if room >= MIN_RUNG_S:
+        return room
+    return None
 
 
 def _judge_rung(res: dict, sla_ms: int, duration_s: float,
@@ -717,17 +850,22 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
     runs_allowed = max_runs
     stall_retry_used = False
     while run_id < runs_allowed:
-        if deadline is not None and (
-                time.monotonic() + duration_s + 45 > deadline):
+        rung_s = _clamped_rung_duration(deadline, duration_s)
+        if rung_s is None:
             log("latency sweep stopped: bench time budget would be "
                 "exceeded (headline must still print)")
             break
+        if rung_s < duration_s:
+            log(f"latency sweep rung clamped to {rung_s:.0f}s by the "
+                "bench time budget")
         res = _paced_latency_phase(cfg, mapping, broker,
                                    as_redis(make_store()), workdir,
-                                   rate, duration_s, run_id=run_id)
+                                   rate, rung_s, run_id=run_id)
+        if rung_s < duration_s:
+            res["duration_clamped_s"] = round(rung_s, 1)
         run_id += 1
         results.append(res)
-        _judge_rung(res, sla_ms, duration_s)
+        _judge_rung(res, sla_ms, rung_s)
         sustained = res["sustained"]
         if sustained:
             best = max(best or 0, rate)
@@ -746,8 +884,8 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
         else:
             if (not stall_retry_used and not res["invalid_producer"]
                     and _stall_signature(res, sla_ms)
-                    and (deadline is None or time.monotonic()
-                         + duration_s + 45 <= deadline)):
+                    and _clamped_rung_duration(deadline, duration_s)
+                    is not None):
                 # budget re-checked HERE so the flag is only stamped on
                 # a rung whose retry actually runs (the loop-top check
                 # would otherwise break first and record a phantom
@@ -1012,7 +1150,10 @@ def main() -> int:
     # enforced two ways: every phase checks the deadline before starting,
     # and the headline is re-emitted after every completed phase so even
     # a kill inside a phase loses only that phase.
-    budget_s = float(os.environ.get("STREAMBENCH_BENCH_BUDGET_S", "1500"))
+    # 840 s default: the harness driver kills at 870 s (BENCH_r04 died
+    # rc-124 to exactly this); the envelope must end, artifact emitted,
+    # BEFORE that kill.  Raise explicitly for longer standalone runs.
+    budget_s = float(os.environ.get("STREAMBENCH_BENCH_BUDGET_S", "840"))
     paced_rate = int(os.environ.get("STREAMBENCH_BENCH_PACED_RATE", "0"))
     paced_dur = float(os.environ.get("STREAMBENCH_BENCH_PACED_SECS", "125"))
     sla_ms = int(os.environ.get("STREAMBENCH_BENCH_SLA_MS", "15000"))
@@ -1133,6 +1274,40 @@ def main() -> int:
                 f"{device['encode_ms']} ms, device+dispatch est "
                 f"{device['device_ms_est']} ms = "
                 f"{device['device_ns_per_event']} ns/event)")
+            if device.get("decode_supported"):
+                log(f"device-decode sample: probe {device['decode_probe_ms']}"
+                    f" ms + dispatch {device['decode_dispatch_ms']} ms "
+                    f"(pipelined {device['decode_chunk_ms_pipelined']} ms) "
+                    f"vs host encode {device['encode_ms']} ms — the encode "
+                    "stage builds no columns on the decode arm")
+
+        # Kernel-method micro-bench (VERDICT 7): per-method ns/event at
+        # (this backend, this campaign bucket), winner cached so
+        # engine.pipeline.default_method picks from measurement for
+        # every engine built from here on.
+        methods = None
+        try:
+            from streambench_tpu.ops import methodbench
+
+            t0 = time.monotonic()
+            methods = methodbench.measure_and_record(
+                num_campaigns=cfg.jax_num_campaigns,
+                window_slots=min(cfg.jax_window_slots, 64),
+                batch_size=min(cfg.jax_batch_size, 4096),
+                iters=10)
+            log(f"method micro-bench ({time.monotonic() - t0:.1f}s): "
+                f"winner={methods['winner']} "
+                + " ".join(f"{m}={v.get('ns_per_event', 'err')}ns/ev"
+                           for m, v in methods["methods"].items()))
+        except Exception as e:
+            log(f"method micro-bench failed (non-fatal): {e!r}")
+        emitter.update(
+            methods=methods,
+            methods_compact=(
+                {"winner": methods["winner"],
+                 "ns_per_event": {m: v.get("ns_per_event")
+                                  for m, v in methods["methods"].items()}}
+                if methods else None))
 
         # optional kernel override (scatter|onehot|matmul|pallas); default
         # is the per-backend choice in engine.pipeline.default_method
@@ -1329,6 +1504,60 @@ def main() -> int:
         exact_row["oracle"] = "exact"
         emitter.update(metric="sustained events/sec (oracle-verified)",
                        phase="catchup")
+        emitter.emit()
+
+        # Device-decode A/B (ISSUE 6): one catchup rep over the SAME
+        # journal with decode on the device, oracle-checked, committed
+        # either way; the measured winner feeds jax.decode.device=auto
+        # through the shared measurement cache.
+        dd_ab = None
+        if device.get("decode_supported"):
+            try:
+                r_dd = as_redis(make_store())
+                seed_campaigns(r_dd, sorted(set(mapping.values())))
+                eng_dd = AdAnalyticsEngine(
+                    dataclasses.replace(cfg, jax_decode_device="on"),
+                    mapping, redis=r_dd, method=method)
+                eng_dd.warmup()
+                runner_dd = StreamRunner(
+                    eng_dd, broker.reader(cfg.kafka_topic),
+                    ingest_pipeline=os.environ.get(
+                        "STREAMBENCH_BENCH_INGEST", "").strip().lower()
+                    or None)
+                t0 = time.monotonic()
+                stats_dd = runner_dd.run_catchup()
+                eng_dd.close()
+                dd_s = max(time.monotonic() - t0, 1e-9)
+                c_dd, d_dd, m_dd = gen.check_correct(
+                    r_dd, workdir=wd, log=lambda s: None,
+                    time_divisor_ms=cfg.jax_time_divisor_ms)
+                v_dd = round(stats_dd.events / dd_s, 1)
+                on_exact = not (d_dd or m_dd or int(eng_dd.dropped))
+                dd_ab = {
+                    "off_events_per_s": value,
+                    "on_events_per_s": v_dd,
+                    "on_oracle": ("exact" if on_exact else
+                                  f"INVALID: differ={d_dd} "
+                                  f"missing={m_dd} "
+                                  f"dropped={int(eng_dd.dropped)}"),
+                    "fallback_rows": eng_dd._devdecode.rows_fallback,
+                    "winner": ("device" if on_exact and v_dd > value
+                               else "host"),
+                }
+                log(f"device-decode A/B: off {value:,.0f} ev/s vs on "
+                    f"{v_dd:,.0f} ev/s (oracle "
+                    f"{dd_ab['on_oracle']}) -> auto gates "
+                    f"{dd_ab['winner']}")
+                try:
+                    from streambench_tpu.ops import methodbench
+
+                    methodbench.record(f"{backend}/devdecode", dd_ab)
+                except Exception:
+                    pass
+            except Exception as e:  # the A/B must not kill the headline
+                log(f"device-decode A/B failed (non-fatal): {e!r}")
+                dd_ab = {"error": repr(e)}
+        emitter.update(device_decode_ab=dd_ab, phase="device_decode_ab")
         emitter.emit()
 
         # Phase 2: the reference's real metric — p99 window-writeback

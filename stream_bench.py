@@ -63,6 +63,11 @@ WINDOW_SLOTS = int(os.environ.get("WINDOW_SLOTS", "16"))
 ENCODE_WORKERS = int(os.environ.get("ENCODE_WORKERS", "1"))
 # Staged ingest pipeline (engine/ingest.py): off | on | auto
 INGEST_PIPELINE = os.environ.get("INGEST_PIPELINE", "off")
+# On-device event decode (ops/devdecode.py): off | on | auto — "on"
+# ships raw journal blocks to the device and decodes inside the jitted
+# step; "auto" follows the measured per-backend A/B (README "Device
+# decode").  Default off: the host-encode hot path stays byte-identical.
+DECODE_DEVICE = os.environ.get("DECODE_DEVICE", "off")
 # Exactly-once writeback (ROBUSTNESS.md "Exactly-once"): epoch-fenced
 # idempotent sink flushes + absolute-ledger reconcile on resume.
 # Default off: the hot path stays byte-identical.
@@ -245,6 +250,7 @@ def op_setup() -> None:
         "jax.window.slots": WINDOW_SLOTS,
         "jax.encode.workers": ENCODE_WORKERS,
         "jax.ingest.pipeline": INGEST_PIPELINE,
+        "jax.decode.device": DECODE_DEVICE,
         "jax.sink.exactly_once": EXACTLY_ONCE,
         "jax.metrics.interval.ms": METRICS_INTERVAL_MS,
         "jax.obs.lifecycle": OBS_LIFECYCLE,

@@ -112,6 +112,15 @@ class BenchmarkConfig:
     jax_ingest_batch_queue: int = 4    # encoded-batch groups the encode
     #   stage may buffer ahead of device dispatch
     jax_use_native_encoder: bool = True    # C++ fast-path when the .so is built
+    # --- on-device event decode (ops.devdecode; ISSUE 6) ---
+    # "off" (default) keeps host encoding byte-identical; "on" ships raw
+    # journal blocks to the device and does bytes->columns + view filter
+    # + ad->campaign hash join + window fold inside the jitted step
+    # (exact-count engines with the generator's uuid wire format only —
+    # unsupported engines fall back to host encode with a warning);
+    # "auto" enables it only where the measured A/B says the device arm
+    # wins (bench.py records it; accelerator backends default on).
+    jax_decode_device: str = "off"
     # --- robustness knobs (ROBUSTNESS.md; the reference has none of these:
     # a Redis outage is a Jedis stack trace and enableCheckpointing is
     # commented out, AdvertisingTopologyNative.java:81-84) ---
@@ -219,6 +228,11 @@ class BenchmarkConfig:
             raise ConfigError(
                 f"config key 'jax.ingest.pipeline' must be one of "
                 f"off/on/auto: {ingest_mode!r}")
+        decode_mode = gets("jax.decode.device", "off").strip().lower()
+        if decode_mode not in ("off", "on", "auto"):
+            raise ConfigError(
+                f"config key 'jax.decode.device' must be one of "
+                f"off/on/auto: {decode_mode!r}")
         mesh_shape = conf.get("jax.mesh.shape", (1,))
         mesh_axes = conf.get("jax.mesh.axes", ("data",))
         try:
@@ -267,6 +281,7 @@ class BenchmarkConfig:
             jax_ingest_block_queue=max(geti("jax.ingest.block.queue", 4), 1),
             jax_ingest_batch_queue=max(geti("jax.ingest.batch.queue", 4), 1),
             jax_use_native_encoder=getb("jax.use.native.encoder", True),
+            jax_decode_device=decode_mode,
             jax_sink_exactly_once=getb("jax.sink.exactly_once", False),
             jax_sink_retry_base_ms=geti("jax.sink.retry.base.ms", 100),
             jax_sink_retry_cap_ms=geti("jax.sink.retry.cap.ms", 5000),

@@ -331,6 +331,13 @@ class EventEncoder:
             u, p, ad, at, et, t = rec
             if self.base_time_ms is None:
                 self._rebase(t)
+            if not (-2**31 <= t - self.base_time_ms < 2**31):
+                # rebased time must fit the int32 column; an absurd
+                # timestamp (clock garbage, fuzzed input) is a bad
+                # line, not a crash or a silent int32 wrap (every
+                # encoder arm applies this same rule)
+                self._reject(line)
+                continue
             i = n
             ad_idx[i] = self._ad_lookup(ad)
             etype[i] = EVENT_TYPE_INDEX_B.get(et, -1)
@@ -381,6 +388,9 @@ class EventEncoder:
                 continue
             if self.base_time_ms is None:
                 self._rebase(ti)
+            if not (-2**31 <= ti - self.base_time_ms < 2**31):
+                self._reject(line)   # same int32-fit rule as encode()
+                continue
             ad_idx[n] = self._ad_lookup(ad)
             etype[n] = EVENT_TYPE_INDEX_B.get(et, -1)
             etime[n] = ti - self.base_time_ms

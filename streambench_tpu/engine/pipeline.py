@@ -51,10 +51,25 @@ MATMUL_MAX_CAMPAIGNS = 4_096
 
 
 def default_method(num_campaigns: int | None = None) -> str:
-    """Scatter-add on CPU or for large key spaces; the factored MXU matmul
-    on TPU while the campaign axis stays under ``MATMUL_MAX_CAMPAIGNS``
-    (the [B, W] slot one-hot is never the binding operand: W is a ring of
-    open windows, bounded by config to a few hundred slots)."""
+    """Counting-kernel choice, MEASURED where a measurement exists.
+
+    ``ops.methodbench`` caches per-backend/per-campaign-bucket winners
+    (``bench.py``'s device section records them; the CI smoke runs the
+    tiny-size path); an exact bucket hit decides.  Without one, the
+    original heuristic: scatter-add on CPU or for large key spaces; the
+    factored MXU matmul on TPU while the campaign axis stays under
+    ``MATMUL_MAX_CAMPAIGNS`` (the [B, W] slot one-hot is never the
+    binding operand: W is a ring of open windows, bounded by config to a
+    few hundred slots)."""
+    try:
+        from streambench_tpu.ops import methodbench
+
+        measured = methodbench.cached_winner(jax.default_backend(),
+                                             num_campaigns)
+    except Exception:
+        measured = None
+    if measured is not None:
+        return measured
     if jax.default_backend() not in ("tpu", "axon"):
         return "scatter"
     if num_campaigns is not None and num_campaigns > MATMUL_MAX_CAMPAIGNS:
@@ -560,6 +575,17 @@ class AdAnalyticsEngine:
             self._encode_pool = ParallelEncodePool(
                 self.encoder, _new_encoder,
                 workers=cfg.jax_encode_workers)
+        # On-device event decode (ops.devdecode; jax.decode.device):
+        # raw journal blocks ship to the device and bytes->columns +
+        # view filter + ad->campaign hash join + window fold run inside
+        # one jitted step; the host keeps only the layout probe.  None
+        # whenever the mode is off or this engine/data shape is not
+        # eligible — the host encoders stay the (byte-identical)
+        # fallback, never a changed path.
+        self._devdecode = None
+        if input_format == "json":
+            self._devdecode = self._maybe_device_decoder(
+                getattr(cfg, "jax_decode_device", "off"))
 
     # Subclasses whose _device_step is not the exact-count kernel clear
     # this; process_chunk then folds per-batch (still with deferred
@@ -583,6 +609,47 @@ class AdAnalyticsEngine:
     # identity (HLL): consistent across pool workers and restarts, no
     # intern table in snapshots, parallel encode stays sound.
     HASHED_IDS = False
+
+    # ------------------------------------------------------------------
+    def _maybe_device_decoder(self, mode: str):
+        """Build the device decoder when the mode and this engine allow
+        it; None otherwise (callers treat None as "host encode").
+
+        Eligibility fails CLOSED, like ``_packed_scan``: only the pure
+        exact-count device hooks are decodable (a subclass overriding
+        ``_device_step``/``_device_scan`` consumes columns this path
+        never builds — sketch engines read user ids, the sharded engine
+        reshards), the key space must stay under the dirty-row-drain
+        threshold (those drains track touched campaigns from host-side
+        ``ad_idx`` columns that no longer exist), and the ad table must
+        be the generator's fixed 36-byte uuid wire format.  ``auto``
+        additionally gates on the measured A/B
+        (``devdecode.auto_enabled``)."""
+        if mode == "off":
+            return None
+        if not (type(self)._device_step is AdAnalyticsEngine._device_step
+                and type(self)._device_scan
+                is AdAnalyticsEngine._device_scan):
+            return None
+        if self._track_dirty_rows():
+            return None
+        from streambench_tpu.ops import devdecode
+
+        if mode == "auto" and not devdecode.auto_enabled():
+            return None
+        try:
+            return devdecode.DeviceDecoder(
+                self.encoder, batch_size=self.batch_size,
+                scan_batches=self.scan_batches,
+                divisor_ms=self.divisor, lateness_ms=self.lateness)
+        except ValueError as e:
+            if mode == "on":
+                import sys
+
+                print(f"device decode requested but unsupported here "
+                      f"({e}); falling back to host encode",
+                      file=sys.stderr, flush=True)
+            return None
 
     # ------------------------------------------------------------------
     def warmup(self) -> None:
@@ -683,6 +750,11 @@ class AdAnalyticsEngine:
         through the encode pool (or the primary encoder), empty batches
         dropped.  The ingest pipeline's encode stage calls this from its
         own thread; nothing here touches device state."""
+        if self._devdecode is not None and lines:
+            # line-mode ingest with device decode: rejoin into one block
+            # (a memcpy) so paced/streaming readers share the raw-bytes
+            # path; poll() strips the newlines, so restore them
+            return self._prepare_device_blocks(b"\n".join(lines) + b"\n")
         B = self.batch_size
         if self._encode_pool is not None:
             with self.tracer.span("encode"):
@@ -706,15 +778,34 @@ class AdAnalyticsEngine:
         batches into device state IN ORDER (scan-grouped when the kernel
         supports it).  Returns parsed events folded.  The ingest
         pipeline's host loop calls this with batches its encode stage
-        produced; the serial paths compose it with the encode halves."""
+        produced; the serial paths compose it with the encode halves.
+
+        Device-decode items (``devdecode.PreparedBlock``) interleave
+        with encoded batches in journal order: runs of encoded batches
+        keep the scan-grouped path, prepared blocks dispatch through
+        the fused decode+fold scan."""
         before = self.events_processed
         K = self.scan_batches
-        if not self.SCAN_SUPPORTED or K <= 1:
-            for b in batches:
-                self._fold(b)
-        else:
-            for g in range(0, len(batches), K):
-                self._fold_group(batches[g:g + K])
+        run: list = []
+
+        def flush_run() -> None:
+            if not run:
+                return
+            if not self.SCAN_SUPPORTED or K <= 1:
+                for b in run:
+                    self._fold(b)
+            else:
+                for g in range(0, len(run), K):
+                    self._fold_group(run[g:g + K])
+            run.clear()
+
+        for b in batches:
+            if getattr(b, "is_device_block", False):
+                flush_run()
+                self._fold_prepared(b)
+            else:
+                run.append(b)
+        flush_run()
         return self.events_processed - before
 
     def _fold_group(self, batches: list) -> None:
@@ -791,6 +882,36 @@ class AdAnalyticsEngine:
         self.events_processed += sum(b.n for b in batches)
         self.last_event_ms = now_ms()
 
+    def _fold_prepared(self, pb) -> None:
+        """Ring-guarded fold of one device-decode block: the same two
+        span hazards as ``_fold`` (drain when the unflushed span would
+        overrun; halve when the block ALONE outspans the ring), then one
+        fused decode+fold dispatch.  Host bookkeeping (watermark mirror,
+        attribution, event counting) reads the probe's times through the
+        block's EncodedBatch-shaped surface."""
+        if pb.n == 0:
+            return
+        vt = pb.event_time
+        batch_max = int(vt.max()) + pb.base_time_ms
+        batch_min = int(vt.min()) + pb.base_time_ms
+        if batch_max - batch_min > self._span_guard and pb.n > 1:
+            for half in pb.halves():
+                self._fold_prepared(half)
+            return
+        if self._span_start is None:
+            self._span_start = batch_min
+        if batch_max - self._span_start > self._span_guard:
+            with self.tracer.span("drain"):
+                self._drain_device()
+            if self._span_start is None or batch_min < self._span_start:
+                self._span_start = batch_min
+        with self.tracer.span("device_decode"):
+            self.state = self._devdecode.fold(self.state, pb,
+                                              method=self.method)
+        self._note_watermark(pb)
+        self.events_processed += pb.n
+        self.last_event_ms = now_ms()
+
     def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
         """Fold ``[K, B]`` stacked batches in one compiled scan."""
         self.state = wc.scan_steps(
@@ -811,13 +932,16 @@ class AdAnalyticsEngine:
     @property
     def supports_block_ingest(self) -> bool:
         """True when raw journal blocks can be encoded without per-line
-        Python objects (native encoder + JSON wire format).  Sketch
-        engines with a Python-pinned encoder inherit False.  With a
-        parallel encode pool the block is carved at record boundaries
+        Python objects (native encoder + JSON wire format, or the
+        device-decode path — which wants raw bytes by construction).
+        Sketch engines with a Python-pinned encoder inherit False.  With
+        a parallel encode pool the block is carved at record boundaries
         first and parsed on all workers (``carve_block_parallel``), so
         block ingest and multi-core encoding compose — the round-3
         either/or (pool XOR block mode) left the fastest ingest path
         single-threaded."""
+        if self._devdecode is not None:
+            return True
         return (hasattr(self.encoder, "encode_block")
                 and self._encode == self.encoder.encode)
 
@@ -842,6 +966,8 @@ class AdAnalyticsEngine:
         both ingest modes see identical events."""
         if not data:
             return []
+        if self._devdecode is not None:
+            return self._prepare_device_blocks(data)
         if not self.supports_block_ingest:
             lines = data.split(b"\n")
             if lines and not lines[-1]:
@@ -864,6 +990,36 @@ class AdAnalyticsEngine:
         if self._obs_lifecycle is not None:
             self._obs_lifecycle.stamp_encoded(batches)
         return batches
+
+    def _prepare_device_blocks(self, data: bytes) -> list:
+        """Device-decode "encode" stage: probe the raw block (record
+        boundaries + fixed-layout validation + times, NO columns) and
+        return dispatch-ready items — probe-rejected rows re-encoded
+        through the host encoder first (bad-line counting + dead-letter
+        parity), then the :class:`devdecode.PreparedBlock`\\ s.  The
+        fallback batches fold before the device rows of the same call,
+        so a malformed row is never judged against a watermark its own
+        block advanced."""
+        with self.tracer.span("decode_probe"):
+            blocks, bad_lines = self._devdecode.prepare(data)
+            nl_end = data.rfind(b"\n") + 1
+            if nl_end < len(data):
+                # unterminated trailing record (poll_block never produces
+                # one, but direct callers can): same one-line rule as the
+                # host block path
+                bad_lines.append(data[nl_end:])
+        out: list = []
+        if bad_lines:
+            B = self.batch_size
+            for off in range(0, len(bad_lines), B):
+                with self.tracer.span("encode"):
+                    b = self._encode(bad_lines[off:off + B], B)
+                if b.n:
+                    out.append(b)
+        out.extend(blocks)
+        if self._obs_lifecycle is not None:
+            self._obs_lifecycle.stamp_encoded(out)
+        return out
 
     def _fold(self, batch) -> None:
         """Ring-guarded fold of one encoded batch, splitting when needed.
@@ -1601,6 +1757,8 @@ class AdAnalyticsEngine:
             out["sink_fence"] = {"epoch": e, "seq": s,
                                  "reconcile": self._reconcile_all,
                                  "tainted_windows": len(self._taint)}
+        if self._devdecode is not None:
+            out["device_decode"] = self._devdecode.telemetry()
         return out
 
     def drain_writes(self) -> None:

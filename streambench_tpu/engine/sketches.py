@@ -233,11 +233,11 @@ class HLLDistinctEngine(_SketchEngineBase):
 
 
 @functools.partial(jax.jit, static_argnames=("size_ms", "slide_ms",
-                                             "lateness_ms"))
+                                             "lateness_ms", "method"))
 def _sliding_tdigest_scan(win_state, digest, join_table, now_rel,
                           ad_idx, event_type, event_time, valid,
                           *, size_ms: int, slide_ms: int,
-                          lateness_ms: int):
+                          lateness_ms: int, method: str = "scatter"):
     """Fused sliding-window + t-digest scan over ``[N, B]`` batches.
 
     One dispatch per chunk, digest samples taken against a single
@@ -253,7 +253,8 @@ def _sliding_tdigest_scan(win_state, digest, join_table, now_rel,
         st, hn, hw = carry
         a, et, t, v = xs
         st = sliding.step(st, join_table, a, et, t, v, size_ms=size_ms,
-                          slide_ms=slide_ms, lateness_ms=lateness_ms)
+                          slide_ms=slide_ms, lateness_ms=lateness_ms,
+                          method=method)
         lat = jnp.maximum(now_rel - t, 0)
         campaign = join_table[a]
         mask = v & (et == 0) & (campaign >= 0)
@@ -269,11 +270,12 @@ def _sliding_tdigest_scan(win_state, digest, join_table, now_rel,
 
 
 @functools.partial(jax.jit, static_argnames=("size_ms", "slide_ms",
-                                             "lateness_ms"))
+                                             "lateness_ms", "method"))
 def _sliding_tdigest_scan_packed(win_state, digest, join_table, now_rel,
                                  packed, event_time,
                                  *, size_ms: int, slide_ms: int,
-                                 lateness_ms: int):
+                                 lateness_ms: int,
+                                 method: str = "scatter"):
     """``_sliding_tdigest_scan`` over the packed wire word
     (``windowcount.pack_columns``): 8 B/event on the wire instead of
     13 B across four buffers; unpacked per scan step, bit-identical."""
@@ -284,7 +286,8 @@ def _sliding_tdigest_scan_packed(win_state, digest, join_table, now_rel,
         p, t = xs
         a, et, v = wc.unpack_columns(p)
         st = sliding.step(st, join_table, a, et, t, v, size_ms=size_ms,
-                          slide_ms=slide_ms, lateness_ms=lateness_ms)
+                          slide_ms=slide_ms, lateness_ms=lateness_ms,
+                          method=method)
         lat = jnp.maximum(now_rel - t, 0)
         campaign = join_table[a]
         mask = v & (et == 0) & (campaign >= 0)
@@ -368,14 +371,14 @@ class SlidingTDigestEngine(_SketchEngineBase):
             self.state, self.digest, self.join_table, self._now_rel(),
             ad_idx, event_type, event_time, valid,
             size_ms=self.size_ms, slide_ms=self.slide_ms,
-            lateness_ms=self.base_lateness)
+            lateness_ms=self.base_lateness, method=self.method)
 
     def _device_scan_packed(self, packed, event_time) -> None:
         self.state, self.digest = _sliding_tdigest_scan_packed(
             self.state, self.digest, self.join_table, self._now_rel(),
             packed, event_time,
             size_ms=self.size_ms, slide_ms=self.slide_ms,
-            lateness_ms=self.base_lateness)
+            lateness_ms=self.base_lateness, method=self.method)
 
     def snapshot(self, offset: int):
         from streambench_tpu.checkpoint import Snapshot
@@ -417,7 +420,7 @@ class SlidingTDigestEngine(_SketchEngineBase):
         self.state = sliding.step(
             self.state, self.join_table, ad, et, tm, valid,
             size_ms=self.size_ms, slide_ms=self.slide_ms,
-            lateness_ms=self.base_lateness)
+            lateness_ms=self.base_lateness, method=self.method)
         # Latency sample per view event, bucketed per campaign.
         # TWO-CLOCK CAVEAT (SURVEY.md §7 "faithful latency semantics"):
         # now_ms() is THIS host's clock, event_time the generator's; the

@@ -59,6 +59,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c_p, ctypes.c_char_p, c_i64, c_i64, c_i64,
         i32p, i32p, i32p, i32p, i32p, i32p,
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(c_i64)]
+    lib.sb_probe_block.restype = c_i64
+    lib.sb_probe_block.argtypes = [
+        ctypes.c_char_p, c_i64, c_i64, ctypes.c_int32,
+        i32p, i32p, ctypes.POINTER(c_i64),
+        ctypes.POINTER(ctypes.c_uint8)]
     c_i32 = ctypes.c_int32
     lib.sb_format_events.restype = c_i64
     lib.sb_format_events.argtypes = [
@@ -111,6 +116,9 @@ def load(rebuild: bool = False) -> ctypes.CDLL | None:
                 subprocess.run(["make", "-C", _HERE], check=True,
                                capture_output=True, timeout=120)
             _lib = _configure(ctypes.CDLL(_SO))
-        except (OSError, subprocess.SubprocessError):
+        except (OSError, subprocess.SubprocessError, AttributeError):
+            # AttributeError = a stale .so missing a newer symbol; treat
+            # it like any other unusable library rather than crashing the
+            # import path (callers fall back to pure Python).
             _lib = None
         return _lib

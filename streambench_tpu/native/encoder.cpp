@@ -196,6 +196,11 @@ namespace {
 // value instead of a memchr).  Any mismatch falls back to the quote-token
 // parser below, which tolerates arbitrary spacing.
 
+inline bool rel_time_fits(int64_t t, int64_t base) {
+  const int64_t rel = t - base;
+  return rel >= INT32_MIN && rel <= INT32_MAX;
+}
+
 inline bool skel(const char*& p, const char* end, const char* lit,
                  size_t n) {
   if (static_cast<size_t>(end - p) < n || std::memcmp(p, lit, n) != 0)
@@ -266,6 +271,10 @@ inline int parse_skeleton(Encoder* enc, const char* p, const char* end,
   if (enc->base_time_ms == kBaseUnset) {
     enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
   }
+  if (!rel_time_fits(t, enc->base_time_ms)) {
+    status[i] = 2;  // python fallback re-applies the int32-fit rule and
+    return 0;       // rejects — never a silent int32 wrap
+  }
   auto ad_it = enc->ad_index.find(std::string_view(ad.p, ad.len));
   ad_idx[i] = ad_it == enc->ad_index.end() ? enc->unknown_ad
                                            : ad_it->second;
@@ -330,6 +339,10 @@ inline int parse_tokens(Encoder* enc, const char* p, const char* end,
   }
   if (enc->base_time_ms == kBaseUnset) {
     enc->base_time_ms = t - (t % enc->divisor_ms) - enc->lateness_ms;
+  }
+  if (!rel_time_fits(t, enc->base_time_ms)) {
+    status[i] = 2;
+    return 0;
   }
   auto ad_it = enc->ad_index.find(std::string_view(toks[11].p,
                                                    toks[11].len));
@@ -487,6 +500,90 @@ int64_t sb_encode_block(void* enc_, const char* buf, int64_t len,
     ++n;
   }
   rec_offsets[n] = pos;
+  return n;
+}
+
+// Device-decode probe (ops/devdecode.py): scan newline-delimited records
+// and VALIDATE the generator's fixed byte layout without building any
+// columns — the decode itself (field extraction, ad join, window fold)
+// happens inside the jitted device step.  A record passes (ok=1) iff
+// every byte the device kernel will read sits exactly where the fixed
+// schema puts it:
+//
+//   {"user_id": "<36>", "page_id": "<36>", "ad_id": "<36>",
+//    "ad_type": "<1..n, no quotes>", "event_type": "<view|click|purchase>",
+//    "event_time": "<exactly 13 digits>", "ip_address": "1.2.3.4"}
+//
+// head literals are anchored at the record START (uuid fields are
+// quote-free, so their 36-byte spans cannot hide early terminators the
+// host's token parser would split on), tail literals at the record END.
+// Rows that fail go back to the host encoder verbatim, which keeps
+// bad-line counting and dead-letter behavior identical to the host
+// arms.  times[i] holds the parsed absolute ms stamp for ok rows (the
+// span-guard/watermark input the host loop needs before dispatching).
+int64_t sb_probe_block(const char* buf, int64_t len, int64_t start,
+                       int32_t max_rows, int32_t* starts, int32_t* lens,
+                       int64_t* times, uint8_t* ok) {
+  static const char kHead[] = "{\"user_id\": \"";          // 13 @ 0
+  static const char kPage[] = "\", \"page_id\": \"";       // 15 @ 49
+  static const char kAd[] = "\", \"ad_id\": \"";           // 13 @ 100
+  static const char kAdType[] = "\", \"ad_type\": \"";     // 15 @ 149
+  static const char kTime[] = "\", \"event_time\": \"";    // 18 @ L-58
+  static const char kSuffix[] = "\", \"ip_address\": \"1.2.3.4\"}";  // 27
+  static const char kView[] = "\", \"event_type\": \"view";        // 22
+  static const char kClick[] = "\", \"event_type\": \"click";      // 23
+  static const char kPurchase[] = "\", \"event_type\": \"purchase";  // 26
+  int64_t n = 0;
+  int64_t pos = start;
+  while (n < max_rows && pos < len) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(buf + pos, '\n', static_cast<size_t>(len - pos)));
+    if (nl == nullptr) break;  // incomplete trailing record: not consumed
+    const char* p = buf + pos;
+    const int64_t L = nl - p;
+    starts[n] = static_cast<int32_t>(pos);
+    lens[n] = static_cast<int32_t>(L);
+    int good = 0;
+    int64_t t = 0;
+    // 245 = 164-byte fixed head + 1-byte ad_type floor + 80-byte fixed
+    // tail (event_type "view" is the shortest).
+    if (L >= 245 && std::memcmp(p, kHead, 13) == 0 &&
+        std::memchr(p + 13, '"', 36) == nullptr &&
+        std::memcmp(p + 49, kPage, 15) == 0 &&
+        std::memchr(p + 64, '"', 36) == nullptr &&
+        std::memcmp(p + 100, kAd, 13) == 0 &&
+        std::memchr(p + 113, '"', 36) == nullptr &&
+        std::memcmp(p + 149, kAdType, 15) == 0 &&
+        std::memcmp(p + L - 27, kSuffix, 27) == 0 &&
+        std::memcmp(p + L - 58, kTime, 18) == 0) {
+      good = 1;
+      for (int k = 0; k < 13; ++k) {
+        char c = p[L - 40 + k];
+        if (c < '0' || c > '9') { good = 0; break; }
+        t = t * 10 + (c - '0');
+      }
+      if (good) {
+        int64_t et_len;
+        if (std::memcmp(p + L - 80, kView, 22) == 0) et_len = 4;
+        else if (std::memcmp(p + L - 81, kClick, 23) == 0) et_len = 5;
+        else if (std::memcmp(p + L - 84, kPurchase, 26) == 0) et_len = 8;
+        else et_len = -1;
+        // ad_type value: whatever sits between the fixed head and the
+        // event_type literal; must be non-empty and quote-free or the
+        // host token parser would see a different structure.
+        const int64_t at_len = L - 240 - et_len;
+        good = (et_len > 0 && at_len >= 1 &&
+                std::memchr(p + 164, '"',
+                            static_cast<size_t>(at_len)) == nullptr)
+                   ? 1
+                   : 0;
+      }
+    }
+    ok[n] = static_cast<uint8_t>(good);
+    times[n] = good ? t : 0;
+    pos = (nl - buf) + 1;
+    ++n;
+  }
   return n;
 }
 

@@ -1,0 +1,228 @@
+"""Measured kernel-method selection: scatter vs matmul vs pallas, per backend.
+
+``windowcount.step`` ships four bit-identical counting strategies and
+``engine.pipeline.default_method`` picked between them by a hand-written
+heuristic (scatter on CPU, matmul on TPU under a campaign bound) that
+was never measured — VERDICT item 7.  This module times the ACTUAL
+compiled step per method at a given geometry and caches the winner, so
+``default_method`` becomes a measured decision with the heuristic as
+fallback.
+
+The cache is one JSON file (``$STREAMBENCH_METHOD_CACHE``, default
+``~/.cache/streambench_tpu/method_bench.json``) keyed by
+``<backend>/C<pow2-bucket>``; ``bench.py``'s device section writes it on
+every run and records the full per-method ns/event table in the
+committed artifact.  The same file carries the device-decode A/B winner
+under ``<backend>/devdecode`` (``ops.devdecode.auto_enabled``).
+
+``python -m streambench_tpu.ops.methodbench --smoke`` runs a tiny-size
+measurement end to end (CI exercises the measured path this way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+METHODS = ("scatter", "matmul", "pallas")
+_DEFAULT_CACHE = os.path.join(
+    os.path.expanduser("~"), ".cache", "streambench_tpu",
+    "method_bench.json")
+
+# in-process memo: (path, mtime) -> parsed cache
+_memo: tuple[str, float, dict] | None = None
+
+
+def cache_path() -> str:
+    return os.environ.get("STREAMBENCH_METHOD_CACHE", _DEFAULT_CACHE)
+
+
+def _load_cache() -> dict:
+    global _memo
+    path = cache_path()
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}
+    if _memo is not None and _memo[0] == path and _memo[1] == mtime:
+        return _memo[2]
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, ValueError):
+        data = {}
+    _memo = (path, mtime, data)
+    return data
+
+
+def record(key: str, value: dict) -> None:
+    """Merge one measurement under ``key`` (atomic rewrite)."""
+    global _memo
+    path = cache_path()
+    data = dict(_load_cache())
+    data[key] = value
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    _memo = None
+
+
+def cached_value(key: str) -> dict | None:
+    v = _load_cache().get(key)
+    return v if isinstance(v, dict) else None
+
+
+def bucket(num_campaigns: int) -> int:
+    """Pow2 bucket a geometry's campaign axis (the method trade-off's
+    driver: the matmul's [B, C] operand scales with C)."""
+    return 1 << max((max(int(num_campaigns), 1) - 1).bit_length(), 0)
+
+
+def method_key(backend: str, num_campaigns: int) -> str:
+    return f"{backend}/C{bucket(num_campaigns)}"
+
+
+def cached_winner(backend: str, num_campaigns: int | None) -> str | None:
+    """The measured winner for this backend + campaign bucket, or None
+    when nothing comparable was ever measured (callers fall back to the
+    heuristic).  Only an exact bucket hit is trusted: the scatter/matmul
+    crossover moves with C, so a winner measured at C=128 says nothing
+    about C=1e6."""
+    if num_campaigns is None:
+        return None
+    entry = cached_value(method_key(backend, int(num_campaigns)))
+    if entry is None:
+        return None
+    winner = entry.get("winner")
+    return winner if winner in METHODS else None
+
+
+# ----------------------------------------------------------------------
+def measure_methods(num_campaigns: int = 100, window_slots: int = 16,
+                    batch_size: int = 8192, iters: int = 20,
+                    methods: tuple = METHODS, scan_batches: int = 1,
+                    time_budget_s: float = 5.0, seed: int = 0) -> dict:
+    """Time the compiled window step per counting method.
+
+    Synthetic uniform batch (every row a counted view — the worst case
+    for all methods equally), blocking sample like bench.py's device
+    section: warm once, then ``iters`` timed dispatches with one
+    trailing block.  A method whose single warm call already exceeds
+    ``time_budget_s / len(methods)`` is sampled just once (pallas in
+    interpret mode on CPU is orders slower; the table should record
+    that, not burn the bench budget proving it).  Returns the artifact
+    table: per-method ns/event (or error), the winner, geometry.
+    """
+    import jax
+
+    from streambench_tpu.ops import windowcount as wc
+
+    rng = np.random.default_rng(seed)
+    C, W, B = int(num_campaigns), int(window_slots), int(batch_size)
+    ad_per = 1
+    join = np.arange(C * ad_per, dtype=np.int32) % C
+    join_table = np.concatenate([join, np.array([-1], np.int32)])
+    ad_idx = rng.integers(0, C * ad_per, B).astype(np.int32)
+    event_type = np.zeros(B, np.int32)           # all views
+    event_time = (rng.integers(0, W // 2 + 1, B).astype(np.int32)
+                  * np.int32(10_000))
+    valid = np.ones(B, bool)
+    jt = jax.numpy.asarray(join_table)
+    np_cols = (ad_idx, event_type, event_time, valid)
+    if scan_batches > 1:
+        np_cols = tuple(np.stack([c] * scan_batches) for c in np_cols)
+    cols = [jax.numpy.asarray(c) for c in np_cols]
+
+    out: dict = {
+        "backend": jax.default_backend(),
+        "num_campaigns": C, "window_slots": W, "batch_size": B,
+        "scan_batches": int(scan_batches), "iters": int(iters),
+        "methods": {},
+    }
+    per_budget = time_budget_s / max(len(methods), 1)
+    events = B * max(scan_batches, 1)
+    for method in methods:
+        state = wc.init_state(C, W)
+
+        def run(st):
+            if scan_batches > 1:
+                return wc.scan_steps(st, jt, *cols, method=method)
+            return wc.step(st, jt, *cols, method=method)
+
+        try:
+            st = run(state)
+            jax.block_until_ready(st.counts)      # compile + warm
+            t0 = time.perf_counter()
+            st = run(state)
+            jax.block_until_ready(st.counts)
+            warm_s = time.perf_counter() - t0
+            n = (1 if warm_s > per_budget
+                 else max(1, min(iters, int(per_budget / max(warm_s,
+                                                             1e-7)))))
+            t0 = time.perf_counter()
+            for _ in range(n):
+                st = run(st)
+            jax.block_until_ready(st.counts)
+            per_call = (time.perf_counter() - t0) / n
+            out["methods"][method] = {
+                "ns_per_event": round(per_call * 1e9 / events, 2),
+                "ms_per_step": round(per_call * 1e3, 4),
+                "timed_iters": n,
+            }
+        except Exception as e:  # a broken method must not kill the table
+            out["methods"][method] = {"error": repr(e)}
+    ranked = sorted(
+        (m for m, v in out["methods"].items() if "ns_per_event" in v),
+        key=lambda m: out["methods"][m]["ns_per_event"])
+    out["winner"] = ranked[0] if ranked else None
+    return out
+
+
+def measure_and_record(num_campaigns: int = 100, window_slots: int = 16,
+                       batch_size: int = 8192, **kw) -> dict:
+    """Measure + persist under the backend/C-bucket key.  The entry
+    ``default_method`` consults; re-measuring overwrites."""
+    res = measure_methods(num_campaigns=num_campaigns,
+                          window_slots=window_slots,
+                          batch_size=batch_size, **kw)
+    if res.get("winner"):
+        record(method_key(res["backend"], num_campaigns), res)
+    return res
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="micro-bench the window-count kernel methods")
+    ap.add_argument("--campaigns", type=int, default=100)
+    ap.add_argument("--window-slots", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--scan-batches", type=int, default=1)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes, 2 iters (CI: exercise the "
+                         "measured path end to end)")
+    ap.add_argument("--no-record", action="store_true",
+                    help="print the table without touching the cache")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.campaigns, args.window_slots = 8, 4
+        args.batch, args.iters = 128, 2
+    fn = measure_methods if args.no_record else measure_and_record
+    res = fn(num_campaigns=args.campaigns,
+             window_slots=args.window_slots, batch_size=args.batch,
+             iters=args.iters, scan_batches=args.scan_batches)
+    print(json.dumps(res, indent=1, sort_keys=True))
+    return 0 if res.get("winner") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,10 +8,29 @@ the watermark passes ``start + size + lateness`` (the generic ring closes
 at ``(wid+1)*divisor + lateness``; the widened lateness makes those equal).
 
 The membership loop is a static Python ``for`` over S — under jit XLA
-unrolls it into S masked scatters, no dynamic control flow.  State shape
-is identical to the tumbling ``WindowState``; ``flush_deltas`` works
-unchanged when called with the same effective lateness.  ``dropped``
-counts lost *memberships* (an event has S of them), not events.
+unrolls it, no dynamic control flow.  Ring-slot *claims* stay per-k
+(masked scatter-maxes over the [W] id vector — order matters and they
+are cheap); how the S memberships become count updates is the
+``method`` knob:
+
+- ``"scatter"`` — the original unrolled form: S masked ``[C*W]``
+  scatter-adds, one per membership (VERDICT item 8's complaint).
+- ``"matmul"`` / ``"onehot"`` / ``"pallas"`` — the factored one-hot
+  form: each k contributes a masked slot one-hot, summed into ONE
+  ``[B, W]`` membership matrix (the ``[B, S*W]`` membership tensor with
+  its S axis pre-folded — memberships of one event hit S *distinct*
+  slots, so the sum stays 0/1), and a single
+  ``campaign_onehot^T @ membership`` matmul lands all S memberships in
+  one MXU pass instead of S scatters.  ``apply_count`` does the final
+  dispatch, so the sliding step follows the same measured per-backend
+  method choice (``ops.methodbench``) as the tumbling one.
+  (``"pallas"``'s tiled kernel consumes single (campaign, slot) pairs,
+  not membership rows — it routes to the same factored matmul here.)
+
+All methods are bit-identical (tested).  State shape is identical to the
+tumbling ``WindowState``; ``flush_deltas`` works unchanged when called
+with the same effective lateness.  ``dropped`` counts lost *memberships*
+(an event has S of them), not events.
 """
 
 from __future__ import annotations
@@ -21,7 +40,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from streambench_tpu.ops.windowcount import WindowState, assign_windows
+from streambench_tpu.ops.windowcount import (
+    WindowState,
+    apply_count,
+    assign_windows,
+)
 
 
 def effective_lateness(size_ms: int, slide_ms: int, lateness_ms: int) -> int:
@@ -30,17 +53,23 @@ def effective_lateness(size_ms: int, slide_ms: int, lateness_ms: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("size_ms", "slide_ms", "lateness_ms", "view_type"))
+    static_argnames=("size_ms", "slide_ms", "lateness_ms", "view_type",
+                     "method"))
 def step(state: WindowState, join_table: jax.Array,
          ad_idx: jax.Array, event_type: jax.Array,
          event_time: jax.Array, valid: jax.Array,
          *, size_ms: int = 10_000, slide_ms: int = 1_000,
-         lateness_ms: int = 60_000, view_type: int = 0) -> WindowState:
+         lateness_ms: int = 60_000, view_type: int = 0,
+         method: str = "scatter") -> WindowState:
     if size_ms % slide_ms:
         raise ValueError("size_ms must be a multiple of slide_ms")
     S = size_ms // slide_ms
     late_eff = effective_lateness(size_ms, slide_ms, lateness_ms)
     C, W = state.counts.shape
+    if S > W:
+        # the factored membership sum (and slot claiming generally)
+        # needs each event's S memberships on distinct ring slots
+        raise ValueError(f"ring too small: {W} slots < {S} memberships")
 
     campaign = join_table[ad_idx]
     base_wid = event_time // slide_ms
@@ -50,17 +79,33 @@ def step(state: WindowState, join_table: jax.Array,
     ids = state.window_ids
     dropped = state.dropped
     watermark = state.watermark
+    factored = method != "scatter"
+    membership = None
     for k in range(S):
         wid = base_wid - k
         slot, count_mask, ids, wm = assign_windows(
             ids, state.watermark, wid, wanted, valid, event_time,
             divisor_ms=slide_ms, lateness_ms=late_eff)
         watermark = wm
-        flat = jnp.where(count_mask, campaign * W + slot, C * W)
-        counts = (counts.reshape(-1)
-                  .at[flat].add(1, mode="drop")
-                  .reshape(C, W))
+        if factored:
+            oh = (slot[:, None] == jnp.arange(W, dtype=jnp.int32)
+                  ) & count_mask[:, None]                        # [B, W]
+            membership = oh if membership is None else membership | oh
+        else:
+            counts = apply_count(counts, campaign, slot, count_mask,
+                                 "scatter")
         dropped = dropped + (
             jnp.sum(wanted.astype(jnp.int32))
             - jnp.sum(count_mask.astype(jnp.int32)))
+    if factored:
+        # one [B, C] x [B, W] MXU pass for all S memberships; masked
+        # rows have campaign -1 -> an all-zero one-hot row.  f32
+        # accumulation of 0/1 over B is exact to 2^24.
+        camp_oh = (campaign[:, None] == jnp.arange(C, dtype=jnp.int32)
+                   ).astype(jnp.float32)                         # [B, C]
+        delta = jax.lax.dot_general(
+            camp_oh, membership.astype(jnp.float32),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [C, W]
+        counts = counts + delta.astype(jnp.int32)
     return WindowState(counts, ids, watermark, dropped)

@@ -131,37 +131,32 @@ def assign_windows(window_ids: jax.Array, watermark: jax.Array,
     return slot, count_mask, new_window_ids, new_watermark
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("divisor_ms", "lateness_ms", "view_type", "method"))
-def step(state: WindowState, join_table: jax.Array,
-         ad_idx: jax.Array, event_type: jax.Array,
-         event_time: jax.Array, valid: jax.Array,
-         *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
-         view_type: int = 0, method: str = "scatter") -> WindowState:
-    """Fold one micro-batch into the window state.  Pure; jits once."""
-    C, W = state.counts.shape
+def apply_count(counts: jax.Array, campaign: jax.Array, slot: jax.Array,
+                count_mask: jax.Array, method: str) -> jax.Array:
+    """``counts[campaign, slot] += 1`` for masked rows, by strategy.
 
-    campaign = join_table[ad_idx]                      # [B] gather-join
-    wid = event_time // divisor_ms                     # [B]
-    wanted = valid & (event_type == view_type) & (campaign >= 0)
-
-    slot, count_mask, window_ids, watermark = assign_windows(
-        state.window_ids, state.watermark, wid, wanted, valid, event_time,
-        divisor_ms=divisor_ms, lateness_ms=lateness_ms)
-
-    # Masked rows get index C*W: out-of-bounds on the high side, which
-    # scatter mode="drop" discards (negative indices would *wrap*).
-    flat = jnp.where(count_mask, campaign * W + slot, C * W)
+    The ONE copy of the four counting strategies (module docstring):
+    every counting kernel — the tumbling step here, the sliding-window
+    membership fold, the device-decode fused step — routes its masked
+    (campaign, slot) pairs through this dispatch, so the per-backend
+    method choice (``engine.pipeline.default_method``, measured by
+    ``ops.methodbench``) applies uniformly.  Traced code; all methods
+    are bit-identical (tested).
+    """
+    C, W = counts.shape
     if method == "scatter":
-        counts = (state.counts.reshape(-1)
-                  .at[flat].add(1, mode="drop")
-                  .reshape(C, W))
-    elif method == "onehot":
+        # Masked rows get index C*W: out-of-bounds on the high side,
+        # which scatter mode="drop" discards (negative indices *wrap*).
+        flat = jnp.where(count_mask, campaign * W + slot, C * W)
+        return (counts.reshape(-1)
+                .at[flat].add(1, mode="drop")
+                .reshape(C, W))
+    if method == "onehot":
+        flat = jnp.where(count_mask, campaign * W + slot, C * W)
         onehot = (flat[:, None] == jnp.arange(C * W, dtype=jnp.int32)[None, :])
-        counts = state.counts + jnp.sum(
+        return counts + jnp.sum(
             onehot.astype(jnp.float32), axis=0).astype(jnp.int32).reshape(C, W)
-    elif method == "matmul":
+    if method == "matmul":
         # Masked rows have campaign -1 / arbitrary slot; zeroing their
         # campaign one-hot row zeroes their whole outer-product contribution.
         camp_oh = ((campaign[:, None] == jnp.arange(C, dtype=jnp.int32))
@@ -171,13 +166,32 @@ def step(state: WindowState, join_table: jax.Array,
         delta = jax.lax.dot_general(
             camp_oh, slot_oh, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                    # [C, W]
-        counts = state.counts + delta.astype(jnp.int32)
-    elif method == "pallas":
+        return counts + delta.astype(jnp.int32)
+    if method == "pallas":
         from streambench_tpu.ops.pallas_count import count_tiles
 
-        counts = count_tiles(state.counts, campaign, slot, count_mask)
-    else:
-        raise ValueError(f"unknown method {method!r}")
+        return count_tiles(counts, campaign, slot, count_mask)
+    raise ValueError(f"unknown method {method!r}")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("divisor_ms", "lateness_ms", "view_type", "method"))
+def step(state: WindowState, join_table: jax.Array,
+         ad_idx: jax.Array, event_type: jax.Array,
+         event_time: jax.Array, valid: jax.Array,
+         *, divisor_ms: int = 10_000, lateness_ms: int = 60_000,
+         view_type: int = 0, method: str = "scatter") -> WindowState:
+    """Fold one micro-batch into the window state.  Pure; jits once."""
+    campaign = join_table[ad_idx]                      # [B] gather-join
+    wid = event_time // divisor_ms                     # [B]
+    wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+    slot, count_mask, window_ids, watermark = assign_windows(
+        state.window_ids, state.watermark, wid, wanted, valid, event_time,
+        divisor_ms=divisor_ms, lateness_ms=lateness_ms)
+
+    counts = apply_count(state.counts, campaign, slot, count_mask, method)
 
     dropped = state.dropped + (
         jnp.sum(wanted.astype(jnp.int32)) - jnp.sum(count_mask.astype(jnp.int32)))

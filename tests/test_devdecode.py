@@ -1,0 +1,383 @@
+"""On-device event decode (ops.devdecode): oracle equality vs the host
+encoders, deadletter parity on adversarial input, probe differentials.
+
+The contract under test (ISSUE 6): with ``jax.decode.device=on`` the
+engine's Redis-visible output — per-(campaign, window) counts, dropped
+accounting, bad-line counting, dead-letter journal — is identical to
+both host arms (native encoder and pure-Python encoder) on ANY input:
+well-formed generator output, malformed JSON, re-ordered keys, torn
+tails, non-view mixes, unseen ad ids, and non-13-digit timestamps.
+Rows the device cannot decode must take the host fallback VERBATIM,
+never be silently dropped.
+"""
+
+import dataclasses
+import json
+import os
+import random
+import uuid
+
+import numpy as np
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import (
+    as_redis,
+    read_seen_counts,
+    seed_campaigns,
+)
+from streambench_tpu.ops import devdecode
+
+
+def _mk_mapping(rng, n_campaigns=5, ads_per=3):
+    campaigns = gen.make_ids(n_campaigns, rng)
+    ads = gen.make_ids(n_campaigns * ads_per, rng)
+    return {ad: campaigns[i // ads_per] for i, ad in enumerate(ads)}
+
+
+def _event(rng, ads, t, event_type="view", ad=None, ad_type="banner"):
+    return (
+        '{"user_id": "%s", "page_id": "%s", "ad_id": "%s", '
+        '"ad_type": "%s", "event_type": "%s", "event_time": "%d", '
+        '"ip_address": "1.2.3.4"}'
+        % (str(uuid.UUID(int=rng.getrandbits(128), version=4)),
+           str(uuid.UUID(int=rng.getrandbits(128), version=4)),
+           ad if ad is not None else rng.choice(ads), ad_type,
+           event_type, t)).encode()
+
+
+def _adversarial_block(rng, ads, t0=1_722_700_000_000):
+    """A journal block exercising every fallback class next to normal
+    rows."""
+    lines = [
+        _event(rng, ads, t0),                       # plain view
+        b"not json at all",                         # malformed -> DLQ
+        _event(rng, ads, t0 + 5, "click"),          # filtered, valid
+        b'{"event_time": "oops"}',                  # malformed -> DLQ
+        _event(rng, ads, t0 + 11, "purchase"),
+        # unseen ad id: valid row, campaign -1, NOT dead-lettered
+        _event(rng, ads, t0 + 20, ad=str(uuid.uuid4())),
+        # out-of-int32-range rebased time: bad line on EVERY arm (the
+        # pre-PR-6 python encoder crashed and the native skeleton
+        # silently wrapped here — both now reject)
+        # re-ordered keys: valid JSON, host slow path parses it
+        json.dumps({"event_time": str(t0 + 30), "ad_id": ads[0],
+                    "event_type": "view", "user_id": "u", "page_id": "p",
+                    "ad_type": "modal"}).encode(),
+        # short (non-13-digit) timestamp: valid via host fast path
+        _event(rng, ads, 12345),
+        # unknown event type: valid row, filtered
+        _event(rng, ads, t0 + 40, "hover"),
+        # long ad_type value (still quote-free): decodes on device
+        _event(rng, ads, t0 + 52, ad_type="sponsored-search"),
+        _event(rng, ads, t0 + 60),
+        b"",                                        # blank -> DLQ
+        _event(rng, ads, t0 + 70),
+    ]
+    return b"\n".join(lines) + b"\n"
+
+
+def _run_engine(cfg, mapping, data, dlq_dir=None):
+    eng = AdAnalyticsEngine(cfg, mapping)
+    dlq = None
+    if dlq_dir is not None:
+        from streambench_tpu.io.journal import JournalWriter
+
+        dlq = JournalWriter(os.path.join(dlq_dir, "dlq.txt"))
+        eng.encoder.set_deadletter(dlq)
+    eng.process_block(data)
+    eng.flush(final=True)
+    if dlq is not None:
+        dlq.close()
+    return eng
+
+
+ARMS = ("device", "native", "python")
+
+
+def _arm_cfg(arm, **over):
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2, **over)
+    if arm == "device":
+        return dataclasses.replace(cfg, jax_decode_device="on")
+    if arm == "python":
+        return dataclasses.replace(cfg, jax_use_native_encoder=False)
+    return cfg
+
+
+def _counts_and_accounting(arm, mapping, data, tmp_path, **over):
+    cfg = _arm_cfg(arm, **over)
+    d = tmp_path / f"dlq-{arm}"
+    d.mkdir()
+    eng = _run_engine(cfg, mapping, data, dlq_dir=str(d))
+    if arm == "device":
+        assert eng._devdecode is not None, "device arm did not engage"
+        assert eng._devdecode.rows_decoded > 0
+    counts = eng.pending_counts()
+    dlq_path = d / "dlq.txt"
+    dlq = dlq_path.read_bytes() if dlq_path.exists() else b""
+    return {
+        "counts": counts,
+        "dropped": int(eng.dropped),
+        "bad_lines": eng.encoder.bad_lines,
+        "dlq": sorted(dlq.splitlines()),
+        "events": eng.events_processed,
+    }
+
+
+def test_adversarial_block_all_arms_agree(tmp_path):
+    rng = random.Random(11)
+    mapping = _mk_mapping(rng)
+    ads = list(mapping)
+    data = _adversarial_block(rng, ads)
+    res = {arm: _counts_and_accounting(arm, mapping, data, tmp_path)
+           for arm in ARMS}
+    base = res["native"]
+    # the three malformed lines + the out-of-range timestamp
+    assert base["bad_lines"] == 4
+    assert base["dlq"], "deadletter sink never fed"
+    for arm in ARMS:
+        assert res[arm]["counts"] == base["counts"], arm
+        assert res[arm]["dropped"] == base["dropped"], arm
+        assert res[arm]["bad_lines"] == base["bad_lines"], arm
+        assert res[arm]["dlq"] == base["dlq"], arm
+        assert res[arm]["events"] == base["events"], arm
+
+
+def test_generator_journal_oracle_equality(tmp_path):
+    """Full catchup over a generator journal: device arm == host arm ==
+    golden model, through the real StreamRunner block path."""
+    cfg = default_config(jax_batch_size=512, jax_scan_batches=2)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(None, cfg, broker=broker, events_num=12_000,
+                 rng=random.Random(5), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    oracle = gen.dostats(str(tmp_path), mapping=mapping)
+    for mode in ("off", "on"):
+        r = as_redis(FakeRedisStore())
+        seed_campaigns(r, sorted(set(mapping.values())))
+        eng = AdAnalyticsEngine(
+            dataclasses.replace(cfg, jax_decode_device=mode),
+            mapping, redis=r)
+        runner = StreamRunner(eng, broker.reader(cfg.kafka_topic))
+        runner.run_catchup()
+        eng.close()
+        got = read_seen_counts(r)
+        want = {c: {b * cfg.jax_time_divisor_ms: n
+                    for b, n in per.items()}
+                for c, per in oracle.items()}
+        assert got == want, f"mode={mode}"
+        if mode == "on":
+            assert eng._devdecode is not None
+            assert eng._devdecode.rows_decoded == 12_000
+
+
+def test_probe_native_numpy_differential():
+    """The C probe and the numpy probe are the SAME predicate — one
+    adversarial block, bit-identical verdicts, times, boundaries."""
+    rng = random.Random(3)
+    mapping = _mk_mapping(rng)
+    data = _adversarial_block(rng, list(mapping))
+    # torn tail: an incomplete trailing record must not be scanned
+    data += b'{"user_id": "torn'
+    res_np = devdecode.probe_block(data, native=False)
+    from streambench_tpu import native
+
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    res_c = devdecode.probe_block(data, native=True)
+    for a, b, name in zip(res_np, res_c,
+                          ("starts", "lens", "times", "ok")):
+        assert np.array_equal(a, b), name
+    starts, lens, times, ok = res_c
+    assert not data[int(starts[-1]):].startswith(b'{"user_id": "torn')
+
+
+def test_probe_rejects_each_layout_break():
+    rng = random.Random(9)
+    mapping = _mk_mapping(rng)
+    ads = list(mapping)
+    good = _event(rng, ads, 1_722_700_000_000)
+    mutations = [
+        good.replace(b'"user_id"', b'"user_xx"'),      # key literal
+        good.replace(b'"ip_address": "1.2.3.4"',
+                     b'"ip_address": "9.9.9.9"'),      # suffix literal
+        good.replace(b'"event_type": "view"',
+                     b'"event_type": "hover"'),        # unknown type
+        good[:40] + b'"' + good[41:],                  # quote in uuid
+        good.replace(b'"ad_type": "banner"',
+                     b'"ad_type": "ban\\"er"'),        # quote in ad_type
+    ]
+    block = b"\n".join([good] + mutations) + b"\n"
+    for native in (False, None):
+        starts, lens, times, ok = devdecode.probe_block(
+            block, native=native)
+        assert ok.tolist() == [True] + [False] * len(mutations), native
+        assert int(times[0]) == 1_722_700_000_000
+
+
+def test_ad_table_join_matches_host():
+    rng = random.Random(21)
+    mapping = _mk_mapping(rng, n_campaigns=11, ads_per=7)
+    from streambench_tpu.encode.encoder import EventEncoder
+
+    enc = EventEncoder(mapping)
+    keys, vals, probes = devdecode.build_ad_table(
+        [a.encode() for a in enc.ads], enc.join_table[:-1])
+    assert probes >= 1
+    # every known ad resolves to its campaign; unknown ads to -1
+    T = vals.shape[0]
+    for ad in list(mapping)[:20] + [str(uuid.uuid4()) for _ in range(5)]:
+        h = devdecode.fnv1a32(ad.encode())
+        camp = -1
+        for p in range(probes):
+            slot = (h + p) & (T - 1)
+            if bytes(keys[slot]) == ad.encode():
+                camp = int(vals[slot])
+                break
+        want = (enc.join_table[enc.ad_index[ad.encode()]]
+                if ad.encode() in enc.ad_index else -1)
+        assert camp == int(want)
+
+
+def test_non_uuid_ads_fall_back_quietly():
+    cfg = dataclasses.replace(default_config(), jax_decode_device="on")
+    eng = AdAnalyticsEngine(cfg, {"short-ad": "c1", "other-ad": "c1"})
+    assert eng._devdecode is None     # fixed 36-byte wire format only
+    # ... and the host path still ingests
+    eng.process_block(b'{"bad": 1}\n')
+    assert eng.encoder.bad_lines == 1
+
+
+def test_sketch_engines_ineligible():
+    from streambench_tpu.engine.sketches import HLLDistinctEngine
+
+    rng = random.Random(2)
+    mapping = _mk_mapping(rng)
+    cfg = dataclasses.replace(default_config(jax_window_slots=64),
+                              jax_decode_device="on")
+    eng = HLLDistinctEngine(cfg, mapping)
+    assert eng._devdecode is None     # fails closed: kernel reads users
+
+
+def test_auto_mode_consults_measured_ab(tmp_path, monkeypatch):
+    monkeypatch.setenv("STREAMBENCH_METHOD_CACHE",
+                       str(tmp_path / "cache.json"))
+    from streambench_tpu.ops import methodbench
+
+    import jax
+
+    backend = jax.default_backend()
+    assert devdecode.auto_enabled(backend) == (backend != "cpu")
+    methodbench.record(f"{backend}/devdecode", {"winner": "device"})
+    assert devdecode.auto_enabled(backend) is True
+    methodbench.record(f"{backend}/devdecode", {"winner": "host"})
+    assert devdecode.auto_enabled(backend) is False
+    rng = random.Random(4)
+    mapping = _mk_mapping(rng)
+    cfg = dataclasses.replace(default_config(), jax_decode_device="auto")
+    eng = AdAnalyticsEngine(cfg, mapping)
+    assert eng._devdecode is None     # measured: host wins on this box
+    methodbench.record(f"{backend}/devdecode", {"winner": "device"})
+    eng = AdAnalyticsEngine(cfg, mapping)
+    assert eng._devdecode is not None
+
+
+def test_small_ring_span_guard_still_exact(tmp_path):
+    """A ring far smaller than the journal's event-time span forces
+    mid-run drains and block halving through the device path; counts
+    must stay oracle-exact."""
+    cfg = default_config(jax_batch_size=128, jax_scan_batches=2,
+                         jax_window_slots=16,
+                         jax_allowed_lateness_ms=10_000)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(None, cfg, broker=broker, events_num=8_000,
+                 rng=random.Random(13), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    oracle = gen.dostats(str(tmp_path), mapping=mapping)
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, sorted(set(mapping.values())))
+    eng = AdAnalyticsEngine(
+        dataclasses.replace(cfg, jax_decode_device="on"), mapping,
+        redis=r)
+    runner = StreamRunner(eng, broker.reader(cfg.kafka_topic))
+    runner.run_catchup()
+    eng.close()
+    got = read_seen_counts(r)
+    want = {c: {b * cfg.jax_time_divisor_ms: n for b, n in per.items()}
+            for c, per in oracle.items()}
+    assert got == want
+
+
+def test_checkpoint_resume_with_device_decode(tmp_path):
+    """Snapshot/restore mid-journal with decode on: the resumed engine
+    re-derives the same base time from the snapshot and the final
+    counts stay exact."""
+    from streambench_tpu.checkpoint import Checkpointer
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2,
+                         jax_checkpoint_interval_ms=0)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(None, cfg, broker=broker, events_num=6_000,
+                 rng=random.Random(17), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    oracle = gen.dostats(str(tmp_path), mapping=mapping)
+    cfg_on = dataclasses.replace(cfg, jax_decode_device="on")
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, sorted(set(mapping.values())))
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    eng = AdAnalyticsEngine(cfg_on, mapping, redis=r)
+    runner = StreamRunner(eng, broker.reader(cfg.kafka_topic),
+                          checkpointer=ckpt)
+    runner.run_catchup(max_events=3_000)
+    eng.drain_writes()
+    # fresh engine resumes from the snapshot and finishes the journal
+    eng2 = AdAnalyticsEngine(cfg_on, mapping, redis=r)
+    runner2 = StreamRunner(eng2, broker.reader(cfg.kafka_topic),
+                           checkpointer=ckpt)
+    assert runner2.resume()
+    runner2.run_catchup()
+    eng2.close()
+    got = read_seen_counts(r)
+    want = {c: {b * cfg.jax_time_divisor_ms: n for b, n in per.items()}
+            for c, per in oracle.items()}
+    assert got == want
+
+
+def test_chaos_sweep_with_device_decode(tmp_path):
+    """The PR-1 three-surface chaos acceptance run with
+    ``jax.decode.device=on``: supervised restarts over sink faults, torn
+    journal reads, and >= 3 mid-run crashes still satisfy the
+    at-least-once bound with the decode on the device (fresh decoder +
+    join table per attempt, snapshot base times re-applied)."""
+    from tests.test_chaos_recovery import setup_run, supervise
+    from streambench_tpu.chaos import FaultPlan, check_at_least_once
+
+    cfg, r, broker, mapping = setup_run(tmp_path,
+                                        jax_decode_device="on")
+    plan = FaultPlan.generate(
+        1234,
+        sink_rate=0.25, sink_ops=30, sink_outage=(5, 6),
+        journal_rate=0.4, journal_polls=12,
+        crashes=0)
+    plan = FaultPlan(seed=plan.seed, sink_faults=plan.sink_faults,
+                     journal_faults=plan.journal_faults,
+                     crashes=(("batch", 5), ("flush", 1), ("batch", 2),
+                              ("checkpoint", 1)))
+    st, inj, sup = supervise(tmp_path, cfg, r, broker, mapping, plan)
+    assert st.crashes >= 3
+    # every attempt ran decode-enabled (the final one may legitimately
+    # resume past a fully-consumed journal and decode 0 rows itself)
+    assert sup.runner.engine._devdecode is not None
+    v = check_at_least_once(r, str(tmp_path),
+                            broker.topic_path(cfg.kafka_topic),
+                            st.replay_segments, st.carried)
+    assert v.ok, (v.summary(), v.undercounts[:3], v.overcounts[:3])
+    assert v.windows > 0
+    assert sup.runner.engine.events_processed == 12_000

@@ -1,0 +1,85 @@
+"""Kernel-method micro-bench (ops.methodbench): the measured path
+``default_method`` consults, at smoke sizes (VERDICT 7)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from streambench_tpu.ops import methodbench
+
+
+@pytest.fixture()
+def cache(tmp_path, monkeypatch):
+    path = tmp_path / "method_bench.json"
+    monkeypatch.setenv("STREAMBENCH_METHOD_CACHE", str(path))
+    return path
+
+
+def test_measure_methods_smoke(cache):
+    res = methodbench.measure_methods(num_campaigns=8, window_slots=4,
+                                      batch_size=64, iters=2)
+    assert set(res["methods"]) == set(methodbench.METHODS)
+    for m, v in res["methods"].items():
+        assert "ns_per_event" in v or "error" in v, m
+    assert res["winner"] in methodbench.METHODS
+    timed = res["methods"][res["winner"]]["ns_per_event"]
+    assert timed > 0
+
+
+def test_measure_and_record_roundtrip(cache):
+    res = methodbench.measure_and_record(num_campaigns=8, window_slots=4,
+                                         batch_size=64, iters=1)
+    assert cache.exists()
+    data = json.loads(cache.read_text())
+    key = methodbench.method_key(res["backend"], 8)
+    assert data[key]["winner"] == res["winner"]
+    # the consult path default_method uses
+    assert methodbench.cached_winner(res["backend"], 8) == res["winner"]
+    # a different campaign bucket is NOT trusted
+    assert methodbench.cached_winner(res["backend"], 8192) is None
+    assert methodbench.cached_winner("no-such-backend", 8) is None
+
+
+def test_default_method_consults_measurement(cache):
+    import jax
+
+    from streambench_tpu.engine.pipeline import default_method
+
+    backend = jax.default_backend()
+    heuristic = default_method(100)
+    # a measured winner overrides the heuristic for its bucket...
+    other = "matmul" if heuristic != "matmul" else "scatter"
+    methodbench.record(methodbench.method_key(backend, 100),
+                       {"winner": other})
+    assert default_method(100) == other
+    # ...but a corrupt entry falls back to the heuristic
+    methodbench.record(methodbench.method_key(backend, 100),
+                       {"winner": "not-a-method"})
+    assert default_method(100) == heuristic
+    # unknown geometry never consults the cache
+    assert default_method(None) == default_method(None)
+
+
+def test_cache_tolerates_garbage_file(cache):
+    cache.write_text("{ not json")
+    assert methodbench.cached_winner("cpu", 8) is None
+    methodbench.record("cpu/devdecode", {"winner": "host"})
+    assert methodbench.cached_value("cpu/devdecode") == {"winner": "host"}
+
+
+def test_cli_smoke_records_measured_winner(cache):
+    """CI's measured-path exercise: the module CLI at --smoke sizes
+    writes a winner the next default_method call can consult."""
+    p = subprocess.run(
+        [sys.executable, "-m", "streambench_tpu.ops.methodbench",
+         "--smoke"],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__("os").environ,
+             "STREAMBENCH_METHOD_CACHE": str(cache),
+             "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, p.stderr[-500:]
+    res = json.loads(p.stdout)
+    assert res["winner"] in methodbench.METHODS
+    assert methodbench.cached_winner(res["backend"], 8) == res["winner"]
