@@ -1,0 +1,213 @@
+"""Collective-cost accounting from compiled HLO.
+
+The sharded engines' comms claims ("packed wire halves the per-step
+gather traffic", "the hoisted scan issues one gather per column per
+dispatch instead of K") lived in comments until ISSUE 7; this module
+turns a compiled step into the numbers.  It parses the optimized HLO
+text of a ``jax.stages.Compiled`` — the program XLA will actually run —
+and reports every cross-device collective with its payload size, split
+into top-level ops (execute once per dispatch) and loop-body ops
+(execute once per ``lax.scan`` iteration).
+
+Accounting model, stated precisely because artifacts cite it:
+
+- **payload bytes** = byte size of the op's output shape (tuple shapes
+  sum their leaves).  This is the data a collective makes every
+  participant agree on — NOT a link-level model (a ring all-reduce
+  moves ~2·(g-1)/g × payload per device); ``group_size`` is recorded
+  per op so a reader can apply whichever wire model their fabric uses.
+- **per_dispatch** = top-level + ``scan_len`` × loop-body.  The trip
+  count of a ``lax.scan`` is a compile-time constant the CALLER knows
+  (the [K, B] stack it passed); parsing it back out of the while
+  condition would be fragile, so it is an argument.  Collectives inside
+  nested loops (none today — CPU scatter loops carry no collectives)
+  are counted once per outer iteration; a new kernel that puts a
+  collective inside a double loop must extend this.
+
+Pure text processing — importing this module never initializes jax.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import NamedTuple
+
+# HLO opcode names of cross-device collectives.  ``-start`` covers the
+# async forms (the matching ``-done`` carries no new transfer).
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
+# A defining instruction line: ``  %name = <shape> <opcode>(...``.
+# The shape may be a tuple ``(s32[8]{0}, s32[8]{0})``; the opcode is the
+# first token after it.  Matching the opcode right after `` = `` shapes
+# out USE sites (``fusion(... %all-reduce.23)`` mentions the name but
+# not ``= ... all-reduce(``).
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\([^)]*\)|\S+?)\s+([\w\-]+)\(")
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*->.*\{\s*$")
+_BODY_RE = re.compile(r"body=%?([\w.\-]+)")
+_GROUPS_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+
+
+class CollectiveOp(NamedTuple):
+    """One collective instruction in the optimized program."""
+
+    kind: str            # base opcode, e.g. "all-reduce"
+    name: str            # HLO instruction name
+    payload_bytes: int   # output-shape bytes (see module docstring)
+    group_size: int      # participants per replica group (0 = unknown)
+    computation: str     # enclosing HLO computation
+    in_loop: bool        # True when reached through a while body
+
+
+def shape_bytes(shape: str) -> int:
+    """Byte size of an HLO shape string (``s32[3,64]{1,0}`` or a tuple
+    ``(s32[64]{0}, f32[64]{0})``).  A scalar ``s32[]`` is one element."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue  # token/opaque shapes carry no payload
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += size * n
+    return total
+
+
+def _loop_computations(text: str) -> set:
+    """Names of computations reachable through at least one ``while``
+    body.  One fixpoint pass: a while inside a loop body marks its own
+    body as a loop computation too (nesting collapses to "in a loop";
+    see the module docstring for the counting rule)."""
+    # computation -> set of while-body computations its whiles call
+    calls: dict[str, set] = {}
+    current = ""
+    for line in text.splitlines():
+        m = _COMP_RE.match(line)
+        if m:
+            current = m.group(2)
+            continue
+        for body in _BODY_RE.findall(line):
+            calls.setdefault(current, set()).add(body)
+    in_loop: set = set()
+    frontier = set().union(*calls.values()) if calls else set()
+    while frontier:
+        in_loop |= frontier
+        frontier = set().union(
+            *(calls.get(c, set()) for c in frontier)) - in_loop
+    return in_loop
+
+
+def collective_ops(text: str) -> list:
+    """Every collective instruction in an optimized-HLO dump, with its
+    payload size and whether it sits inside a loop body."""
+    loops = _loop_computations(text)
+    current = ""
+    out = []
+    for line in text.splitlines():
+        m = _COMP_RE.match(line)
+        if m:
+            current = m.group(2)
+            continue
+        d = _DEF_RE.match(line)
+        if d is None:
+            continue
+        name, shape, opcode = d.groups()
+        kind = opcode[:-len("-start")] if opcode.endswith("-start") else opcode
+        if kind not in COLLECTIVE_KINDS:
+            continue
+        g = _GROUPS_RE.search(line)
+        group = len(g.group(1).split(",")) if g else 0
+        out.append(CollectiveOp(
+            kind=kind, name=name, payload_bytes=shape_bytes(shape),
+            group_size=group, computation=current,
+            in_loop=current in loops))
+    return out
+
+
+def summarize(text: str, scan_len: int = 1,
+              column_bytes_min: int = 64) -> dict:
+    """Aggregate ``collective_ops`` into the per-dispatch view artifacts
+    cite.
+
+    ``per_dispatch`` counts top-level ops once and loop-body ops
+    ``scan_len`` times.  ``column_bytes`` restricts the byte total to
+    ops whose payload is at least ``column_bytes_min`` — the gathered
+    batch columns, excluding the scalar drop-counter psums (4 B; the
+    default 64 splits them cleanly, a [B] column being >= 64 B for any
+    real batch) — because the wire-packing claim is about column
+    traffic specifically.
+    """
+    ops = collective_ops(text)
+
+    def _agg(sel):
+        by_kind: dict[str, int] = {}
+        total_ops = 0
+        total_bytes = 0
+        col_ops = 0
+        col_bytes = 0
+        for op in ops:
+            mult = sel(op)
+            if not mult:
+                continue
+            total_ops += mult
+            total_bytes += mult * op.payload_bytes
+            if op.payload_bytes >= column_bytes_min:
+                col_ops += mult
+                col_bytes += mult * op.payload_bytes
+            by_kind[op.kind] = by_kind.get(op.kind, 0) + mult
+        return {"ops": total_ops, "bytes": total_bytes,
+                "column_ops": col_ops, "column_bytes": col_bytes,
+                "by_kind": by_kind}
+
+    return {
+        "scan_len": scan_len,
+        "top_level": _agg(lambda op: 0 if op.in_loop else 1),
+        "per_loop_iteration": _agg(lambda op: 1 if op.in_loop else 0),
+        "per_dispatch": _agg(
+            lambda op: scan_len if op.in_loop else 1),
+        "ops": [op._asdict() for op in ops],
+    }
+
+
+def publish_gauges(registry, report: dict) -> None:
+    """Mirror an engine ``collective_report`` onto obs gauges:
+    ``streambench_collective_{ops,bytes}{kernel="step"|"scan"}``."""
+    for kernel in ("step", "scan"):
+        r = report.get(kernel)
+        if not isinstance(r, dict):
+            continue
+        registry.gauge("streambench_collective_ops",
+                       "collective ops per device dispatch",
+                       labels={"kernel": kernel}
+                       ).set(r["per_dispatch"]["ops"])
+        registry.gauge("streambench_collective_bytes",
+                       "collective payload bytes per device dispatch",
+                       labels={"kernel": kernel}
+                       ).set(r["per_dispatch"]["bytes"])
+
+
+def report_for(fn, *args, scan_len: int = 1,
+               column_bytes_min: int = 64) -> dict:
+    """``summarize`` of a jitted function's optimized HLO for ``args``.
+
+    ``fn.lower(*args).compile()`` compiles a fresh executable (it does
+    not share the jit call cache), so this belongs in bench/obs setup,
+    never on a hot path.  The op list is dropped from the result — the
+    per-op detail is for tests; artifacts keep the aggregates."""
+    text = fn.lower(*args).compile().as_text()
+    out = summarize(text, scan_len=scan_len,
+                    column_bytes_min=column_bytes_min)
+    out.pop("ops")
+    return out

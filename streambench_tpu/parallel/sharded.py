@@ -22,7 +22,9 @@ Layouts (global view):
 - ``join_table [A+1]``  — replicated (1,000 ads; tiny)
 
 ``C`` must divide by the campaign-axis size (``sharded_init_state`` pads)
-and ``B`` by the data-axis size (the encoder already pads to a fixed B).
+and ``B`` by the data-axis size — the engines pad B itself with invalid
+rows when it doesn't (``data_axis_pad``; the encoder already pads every
+batch to a fixed B, so the pad is a constant tail of masked rows).
 """
 
 from __future__ import annotations
@@ -52,6 +54,33 @@ def pad_campaigns(num_campaigns: int, mesh: Mesh) -> int:
     """Campaign count padded up to a multiple of the campaign axis."""
     nc = mesh.shape[CAMPAIGN_AXIS]
     return ((num_campaigns + nc - 1) // nc) * nc
+
+
+def data_axis_pad(batch_size: int, mesh: Mesh) -> int:
+    """Invalid rows appended per batch so the data axis divides it.
+
+    The encoder already pads every batch to a fixed B; this pads B
+    itself when the configured size doesn't divide the data axis, so
+    any (batch size, mesh) pair works.  Padding rows are valid=False
+    everywhere they can matter — masked out of counts, the watermark
+    max, and drop accounting — so results stay bit-identical to the
+    unpadded engine (tested)."""
+    return (-batch_size) % mesh.shape[DATA_AXIS]
+
+
+def pad_data_cols(pad: int, *cols):
+    """Zero-pad the trailing (batch) axis of each column by ``pad`` rows.
+
+    A zero row is invalid in every wire form: the unpacked ``valid``
+    column pads to False, and a packed word of 0 decodes to
+    (ad 0, type -1, valid False) — masked everywhere."""
+    out = []
+    for c in cols:
+        c = jnp.asarray(c)
+        if pad:
+            c = jnp.pad(c, ((0, 0),) * (c.ndim - 1) + ((0, pad),))
+        out.append(c)
+    return tuple(out)
 
 
 def sharded_init_state(num_campaigns: int, window_slots: int,
@@ -108,13 +137,16 @@ def _gather_replicated(x, n_data: int):
     that stay put.  (A size-1 axis still marks its inputs varying, so
     the n_data == 1 case is an identity psum that proves replication.)
     The ONE copy of this trick — both the unpacked and the packed fold
-    must gather identically."""
+    must gather identically.  Gathers along the LAST axis, so it takes
+    both the per-batch ``[b]`` column and the hoisted-scan ``[K, b]``
+    stack (ONE [K, B] collective for a whole dispatch)."""
     if n_data == 1:
         return jax.lax.psum(x.astype(jnp.int32), DATA_AXIS)
-    b = x.shape[0]
-    buf = jnp.zeros((n_data * b,), jnp.int32)
+    b = x.shape[-1]
+    buf = jnp.zeros(x.shape[:-1] + (n_data * b,), jnp.int32)
     i = jax.lax.axis_index(DATA_AXIS)
-    buf = jax.lax.dynamic_update_slice(buf, x.astype(jnp.int32), (i * b,))
+    start = (0,) * (x.ndim - 1) + (i * b,)
+    buf = jax.lax.dynamic_update_slice(buf, x.astype(jnp.int32), start)
     return jax.lax.psum(buf, DATA_AXIS)
 
 
@@ -125,8 +157,10 @@ def _fold_one_packed(counts, window_ids, watermark, dropped, join_table,
     """``_fold_one`` consuming the packed wire word
     (``ops.windowcount.pack_columns``): two data-axis collectives per
     batch instead of four — the packing that halves host->device bytes
-    also halves the ICI all-gather traffic.  Unpacks AFTER the gather,
-    so every device decodes the identical replicated words."""
+    also halves the ICI all-gather traffic (MEASURED, not just claimed:
+    MULTICHIP_r06.json records packed_col_ratio 0.5 from the compiled
+    HLO via ``parallel.collectives``).  Unpacks AFTER the gather, so
+    every device decodes the identical replicated words."""
     packed = _gather_replicated(packed, n_data)
     event_time = _gather_replicated(event_time, n_data)
     ad_idx, event_type, valid = wc.unpack_columns(packed)
@@ -136,10 +170,15 @@ def _fold_one_packed(counts, window_ids, watermark, dropped, join_table,
                       view_type=view_type)
 
 
-def _fold_core(counts, window_ids, watermark, dropped, join_table,
-               ad_idx, event_type, event_time, valid,
-               *, divisor_ms: int, lateness_ms: int, view_type: int):
-    """The shard-local fold over an already-replicated batch."""
+def _fold_local(counts, window_ids, watermark, join_table,
+                ad_idx, event_type, event_time, valid,
+                *, divisor_ms: int, lateness_ms: int, view_type: int):
+    """The collective-free shard-local fold over an already-replicated
+    batch.  Returns ``(counts, ids, wm, wanted_n, counted_local)``;
+    the caller merges ``counted_local`` with a campaign-axis psum —
+    either per batch (``_fold_core``) or ONCE per dispatch (the hoisted
+    scan: psum is linear over int32 sums, so deferring the merge is
+    bit-identical)."""
     Cl, W = counts.shape
 
     campaign = join_table[ad_idx]                 # [B] gather-join
@@ -176,9 +215,22 @@ def _fold_core(counts, window_ids, watermark, dropped, join_table,
                   .at[flat].add(1, mode="drop")
                   .reshape(Cl, W))
 
-    counted = jax.lax.psum(
-        jnp.sum(in_shard.astype(jnp.int32)), CAMPAIGN_AXIS)
-    new_dropped = dropped + jnp.sum(wanted.astype(jnp.int32)) - counted
+    wanted_n = jnp.sum(wanted.astype(jnp.int32))
+    counted_local = jnp.sum(in_shard.astype(jnp.int32))
+    return new_counts, new_ids, new_wm, wanted_n, counted_local
+
+
+def _fold_core(counts, window_ids, watermark, dropped, join_table,
+               ad_idx, event_type, event_time, valid,
+               *, divisor_ms: int, lateness_ms: int, view_type: int):
+    """The shard-local fold over an already-replicated batch."""
+    new_counts, new_ids, new_wm, wanted_n, counted_local = _fold_local(
+        counts, window_ids, watermark, join_table,
+        ad_idx, event_type, event_time, valid,
+        divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+        view_type=view_type)
+    counted = jax.lax.psum(counted_local, CAMPAIGN_AXIS)
+    new_dropped = dropped + wanted_n - counted
     return new_counts, new_ids, new_wm, new_dropped
 
 
@@ -209,16 +261,24 @@ def _build_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
 
 @functools.lru_cache(maxsize=None)
 def _build_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                view_type: int):
+                view_type: int, hoist: bool = True):
     """Compile-cached scanned sharded step: fold [K, B] stacked batches in
     one dispatch (the multi-device peer of ``ops.windowcount.scan_steps``).
-    Collectives run inside the scan body, so cross-device merges happen
-    per folded batch and semantics stay bit-identical to K single steps."""
+
+    ``hoist=True`` (the default, what the engine dispatches) runs the
+    data-axis gathers OUTSIDE the scan body: the stacked ``[K, B]``
+    columns gather in ONE collective per column per dispatch, and the
+    drop-counter psum merges once after the scan — (cols + 1)
+    collectives per dispatch instead of K * (cols + 1).  Bit-identical:
+    the gather has no carry dependence and the psum is linear
+    (integer sums are exact and associative).  ``hoist=False`` keeps
+    the original per-batch collectives — the measured baseline arm
+    (``bench_multichip.py``) and the equivalence oracle in tests."""
 
     n_data = mesh.shape[DATA_AXIS]
 
-    def body(counts, window_ids, watermark, dropped, join_table,
-             ad_idx, event_type, event_time, valid):
+    def body_per_batch(counts, window_ids, watermark, dropped, join_table,
+                       ad_idx, event_type, event_time, valid):
         def one(carry, xs):
             c, ids, wm, dr = carry
             a, e, t, v = xs
@@ -231,8 +291,33 @@ def _build_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
             (ad_idx, event_type, event_time, valid))
         return carry
 
+    def body_hoisted(counts, window_ids, watermark, dropped, join_table,
+                     ad_idx, event_type, event_time, valid):
+        ad, et, tm, va = (_gather_replicated(x, n_data)
+                          for x in (ad_idx, event_type, event_time, valid))
+
+        # Per-batch (wanted, counted_local) ride the scan's ys (a carry
+        # accumulator would make the carry campaign-varying, which the
+        # scan replication checker rightly rejects); int32 sums are
+        # exact and associative, so summing after the scan and psum-ing
+        # ONCE is bit-identical to the per-batch merges.
+        def one(carry, xs):
+            c, ids, wm = carry
+            a, e, t, v = xs
+            c, ids, wm, wn, cl = _fold_local(
+                c, ids, wm, join_table, a, e, t, v > 0,
+                divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                view_type=view_type)
+            return (c, ids, wm), (wn, cl)
+
+        (c, ids, wm), (wn, cl) = jax.lax.scan(
+            one, (counts, window_ids, watermark), (ad, et, tm, va))
+        new_dropped = dropped + jnp.sum(wn) - jax.lax.psum(
+            jnp.sum(cl), CAMPAIGN_AXIS)
+        return c, ids, wm, new_dropped
+
     mapped = shard_map(
-        body, mesh=mesh,
+        body_hoisted if hoist else body_per_batch, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
                   P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(None, DATA_AXIS), P(None, DATA_AXIS)),
@@ -265,12 +350,13 @@ def _build_step_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
 
 @functools.lru_cache(maxsize=None)
 def _build_scan_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                       view_type: int):
-    """``_build_scan`` consuming [K, B] (packed, event_time) columns."""
+                       view_type: int, hoist: bool = True):
+    """``_build_scan`` consuming [K, B] (packed, event_time) columns:
+    2 gathers + 1 psum per dispatch hoisted, K * 3 per-batch."""
     n_data = mesh.shape[DATA_AXIS]
 
-    def body(counts, window_ids, watermark, dropped, join_table,
-             packed, event_time):
+    def body_per_batch(counts, window_ids, watermark, dropped, join_table,
+                       packed, event_time):
         def one(carry, xs):
             c, ids, wm, dr = carry
             p, t = xs
@@ -284,8 +370,31 @@ def _build_scan_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
             (packed, event_time))
         return carry
 
+    def body_hoisted(counts, window_ids, watermark, dropped, join_table,
+                     packed, event_time):
+        pk = _gather_replicated(packed, n_data)
+        tm = _gather_replicated(event_time, n_data)
+
+        def one(carry, xs):
+            c, ids, wm = carry
+            p, t = xs
+            # unpack AFTER the gather, identically on every device;
+            # per-batch elementwise work, no collectives in the body
+            a, e, v = wc.unpack_columns(p)
+            c, ids, wm, wn, cl = _fold_local(
+                c, ids, wm, join_table, a, e, t, v,
+                divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+                view_type=view_type)
+            return (c, ids, wm), (wn, cl)
+
+        (c, ids, wm), (wn, cl) = jax.lax.scan(
+            one, (counts, window_ids, watermark), (pk, tm))
+        new_dropped = dropped + jnp.sum(wn) - jax.lax.psum(
+            jnp.sum(cl), CAMPAIGN_AXIS)
+        return c, ids, wm, new_dropped
+
     mapped = shard_map(
-        body, mesh=mesh,
+        body_hoisted if hoist else body_per_batch, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
                   P(None, DATA_AXIS), P(None, DATA_AXIS)),
         out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
@@ -321,11 +430,10 @@ class ShardedWindowEngine(AdAnalyticsEngine):
         super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
                          redis=redis, input_format=input_format)
         self.mesh = mesh
-        n_data = mesh.shape[DATA_AXIS]
-        if self.batch_size % n_data:
-            raise ValueError(
-                f"batch size {self.batch_size} not divisible by data-axis "
-                f"size {n_data}")
+        # A batch size the data axis doesn't divide is padded with
+        # invalid rows at dispatch (data_axis_pad), never rejected: the
+        # encoder already pads to a fixed B, this pads B itself.
+        self._data_pad = data_axis_pad(self.batch_size, mesh)
         # Re-place state sharded (padded on the campaign axis) and the join
         # table replicated.
         self.state = sharded_init_state(
@@ -357,19 +465,25 @@ class ShardedWindowEngine(AdAnalyticsEngine):
                                     0)
             packed = wc.pack_columns(batch.ad_idx, batch.event_type,
                                      batch.valid)
+            packed, tm = pad_data_cols(self._data_pad, packed,
+                                       batch.event_time)
             counts, ids, wm, dropped = fn(
                 self.state.counts, self.state.window_ids,
                 self.state.watermark, self.state.dropped, self.join_table,
-                jnp.asarray(packed), jnp.asarray(batch.event_time))
+                packed, tm)
             self.state = WindowState(counts, ids, wm, dropped)
             return
+        ad, et, tm, va = pad_data_cols(
+            self._data_pad, batch.ad_idx, batch.event_type,
+            batch.event_time, batch.valid)
         self.state = sharded_step(
-            self.mesh, self.state, self.join_table,
-            batch.ad_idx, batch.event_type, batch.event_time, batch.valid,
+            self.mesh, self.state, self.join_table, ad, et, tm, va,
             divisor_ms=self.divisor, lateness_ms=self.lateness)
 
     def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
         fn = _build_scan(self.mesh, self.divisor, self.lateness, 0)
+        ad_idx, event_type, event_time, valid = pad_data_cols(
+            self._data_pad, ad_idx, event_type, event_time, valid)
         counts, ids, wm, dropped = fn(
             self.state.counts, self.state.window_ids, self.state.watermark,
             self.state.dropped, self.join_table,
@@ -378,7 +492,62 @@ class ShardedWindowEngine(AdAnalyticsEngine):
 
     def _device_scan_packed(self, packed, event_time) -> None:
         fn = _build_scan_packed(self.mesh, self.divisor, self.lateness, 0)
+        packed, event_time = pad_data_cols(self._data_pad, packed,
+                                           event_time)
         counts, ids, wm, dropped = fn(
             self.state.counts, self.state.window_ids, self.state.watermark,
             self.state.dropped, self.join_table, packed, event_time)
         self.state = WindowState(counts, ids, wm, dropped)
+
+    # ------------------------------------------------------------------
+    # collective-cost accounting (parallel.collectives)
+    def attach_obs(self, registry, lifecycle: bool = False) -> None:
+        super().attach_obs(registry, lifecycle)
+        self._obs_reg = registry
+
+    def collective_report(self, k: int | None = None) -> dict:
+        """Per-dispatch collective op count + payload bytes of the
+        compiled kernels this engine actually dispatches (packed step +
+        hoisted packed scan when ``_pack_ok``), derived from the
+        optimized HLO.  Compiles out of line (``lower().compile()``
+        does not share the jit call cache) — call off the hot path.
+        Publishes ``streambench_collective_{ops,bytes}{kernel=}``
+        gauges when obs is attached."""
+        from streambench_tpu.parallel import collectives
+
+        k = int(k or self.scan_batches)
+        B = self.batch_size + self._data_pad
+        st = self.state
+        tm = jnp.zeros((B,), jnp.int32)
+        if self._pack_ok:
+            step_fn = _build_step_packed(self.mesh, self.divisor,
+                                         self.lateness, 0)
+            step_args = (jnp.zeros((B,), jnp.int32), tm)
+            scan_fn = _build_scan_packed(self.mesh, self.divisor,
+                                         self.lateness, 0)
+            scan_args = (jnp.zeros((k, B), jnp.int32),
+                         jnp.zeros((k, B), jnp.int32))
+        else:
+            step_fn = _build_step(self.mesh, self.divisor, self.lateness, 0)
+            step_args = (jnp.zeros((B,), jnp.int32),
+                         jnp.zeros((B,), jnp.int32), tm,
+                         jnp.zeros((B,), bool))
+            scan_fn = _build_scan(self.mesh, self.divisor, self.lateness, 0)
+            scan_args = tuple(jnp.zeros((k, B), d)
+                              for d in (jnp.int32, jnp.int32, jnp.int32,
+                                        bool))
+        state_args = (st.counts, st.window_ids, st.watermark, st.dropped,
+                      self.join_table)
+        report = {
+            "batch_events": self.batch_size,
+            "scan_batches": k,
+            "packed": bool(self._pack_ok),
+            "step": collectives.report_for(
+                step_fn, *state_args, *step_args),
+            "scan": collectives.report_for(
+                scan_fn, *state_args, *scan_args, scan_len=k),
+        }
+        reg = getattr(self, "_obs_reg", None)
+        if reg is not None:
+            collectives.publish_gauges(reg, report)
+        return report

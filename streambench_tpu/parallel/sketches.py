@@ -54,9 +54,14 @@ from streambench_tpu.engine.sketches import (
 )
 from streambench_tpu.io.redis_schema import RedisLike
 from streambench_tpu.ops import cms, hll, session, sliding, tdigest
+from streambench_tpu.ops import windowcount as wc
 from streambench_tpu.ops.windowcount import NEG, WindowState, assign_windows
 from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
-from streambench_tpu.parallel.sharded import pad_campaigns
+from streambench_tpu.parallel.sharded import (
+    data_axis_pad,
+    pad_campaigns,
+    pad_data_cols,
+)
 
 try:  # jax >= 0.6 top-level export
     from jax import shard_map as _shard_map_raw
@@ -92,23 +97,25 @@ def shard_map(body, **kw):
 # Sharded HLL
 # ----------------------------------------------------------------------
 
-def _hll_fold(registers, window_ids, watermark, dropped, join_table,
-              ad_idx, user_idx, event_type, event_time, valid,
-              *, divisor_ms: int, lateness_ms: int, view_type: int):
-    """One batch folded into a campaign shard, written against shard-local
-    views inside ``shard_map``.  Batch columns arrive data-sharded and are
-    gathered here, so every value derived from them is replicated and the
-    ring claim / watermark / drop math needs no further collectives."""
+def _gather_cols(*cols):
+    """All-gather data-axis-sharded columns along their LAST axis: the
+    per-batch ``[b]`` form and the hoisted-scan ``[K, b]`` stack share
+    one spelling (ONE [K, B] collective per column per dispatch)."""
+    return tuple(
+        jax.lax.all_gather(c, DATA_AXIS, axis=c.ndim - 1, tiled=True)
+        for c in cols)
+
+
+def _hll_fold_local(registers, window_ids, watermark, join_table,
+                    ad, user, et, tm, v,
+                    *, divisor_ms: int, lateness_ms: int, view_type: int):
+    """The collective-free HLL fold over already-replicated columns.
+    Returns ``(registers, ids, wm, wanted_n, counted_local)``; the
+    caller psums ``counted_local`` over the campaign axis — per batch
+    (``_hll_fold``) or once per dispatch (the hoisted scan; psum is
+    linear over int32 sums, so deferring the merge is bit-identical)."""
     Cl, W, R = registers.shape
     p = R.bit_length() - 1
-
-    gather = functools.partial(jax.lax.all_gather, axis_name=DATA_AXIS,
-                               tiled=True)
-    ad = gather(ad_idx)
-    user = gather(user_idx)
-    et = gather(event_type)
-    tm = gather(event_time)
-    v = gather(valid)
 
     campaign = join_table[ad]
     wid = tm // divisor_ms
@@ -136,9 +143,42 @@ def _hll_fold(registers, window_ids, watermark, dropped, join_table,
                 .at[flat].max(rank, mode="drop")
                 .reshape(Cl, W, R))
 
-    counted = jax.lax.psum(jnp.sum(in_shard.astype(jnp.int32)),
-                           CAMPAIGN_AXIS)
-    new_dropped = dropped + jnp.sum(wanted.astype(jnp.int32)) - counted
+    wanted_n = jnp.sum(wanted.astype(jnp.int32))
+    counted_local = jnp.sum(in_shard.astype(jnp.int32))
+    return new_regs, new_ids, new_wm, wanted_n, counted_local
+
+
+def _hll_fold(registers, window_ids, watermark, dropped, join_table,
+              ad_idx, user_idx, event_type, event_time, valid,
+              *, divisor_ms: int, lateness_ms: int, view_type: int):
+    """One batch folded into a campaign shard, written against shard-local
+    views inside ``shard_map``.  Batch columns arrive data-sharded and are
+    gathered here, so every value derived from them is replicated and the
+    ring claim / watermark / drop math needs no further collectives."""
+    ad, user, et, tm, v = _gather_cols(ad_idx, user_idx, event_type,
+                                       event_time, valid)
+    new_regs, new_ids, new_wm, wanted_n, counted_local = _hll_fold_local(
+        registers, window_ids, watermark, join_table, ad, user, et, tm, v,
+        divisor_ms=divisor_ms, lateness_ms=lateness_ms, view_type=view_type)
+    counted = jax.lax.psum(counted_local, CAMPAIGN_AXIS)
+    new_dropped = dropped + wanted_n - counted
+    return new_regs, new_ids, new_wm, new_dropped
+
+
+def _hll_fold_packed(registers, window_ids, watermark, dropped, join_table,
+                     packed, user_idx, event_time,
+                     *, divisor_ms: int, lateness_ms: int, view_type: int):
+    """``_hll_fold`` consuming the packed wire word: three data-axis
+    gathers per batch (packed, user, time) instead of five — the ISSUE 7
+    wire packing, extended to the sketch engines.  Unpacks AFTER the
+    gather, so every device decodes identical replicated words."""
+    pk, user, tm = _gather_cols(packed, user_idx, event_time)
+    ad, et, v = wc.unpack_columns(pk)
+    new_regs, new_ids, new_wm, wanted_n, counted_local = _hll_fold_local(
+        registers, window_ids, watermark, join_table, ad, user, et, tm, v,
+        divisor_ms=divisor_ms, lateness_ms=lateness_ms, view_type=view_type)
+    counted = jax.lax.psum(counted_local, CAMPAIGN_AXIS)
+    new_dropped = dropped + wanted_n - counted
     return new_regs, new_ids, new_wm, new_dropped
 
 
@@ -163,14 +203,72 @@ def _build_hll_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _build_hll_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                    view_type: int):
-    """Scanned sharded HLL: fold ``[K, B]`` stacked batches in one
-    dispatch, collectives inside the scan body (the catchup hot path,
-    peer of ``parallel.sharded._build_scan``)."""
-
+def _build_hll_step_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                           view_type: int):
+    """``_build_hll_step`` consuming (packed, user_idx, event_time) wire
+    columns: three data-axis gathers per step instead of five."""
     def body(registers, window_ids, watermark, dropped, join_table,
-             ad_idx, user_idx, event_type, event_time, valid):
+             packed, user_idx, event_time):
+        return _hll_fold_packed(registers, window_ids, watermark, dropped,
+                                join_table, packed, user_idx, event_time,
+                                divisor_ms=divisor_ms,
+                                lateness_ms=lateness_ms,
+                                view_type=view_type)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P(), P(),
+                  P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
+    )
+    return jax.jit(mapped)
+
+
+def _hll_scan_hoisted(join_table, state4, cols, *, divisor_ms: int,
+                      lateness_ms: int, view_type: int, packed: bool):
+    """Shared hoisted-scan core: ``cols`` are ALREADY-GATHERED ``[K, B]``
+    stacks; the scan body is collective-free and the drop-counter psum
+    merges once after the scan (bit-identical — psum is linear)."""
+    registers, window_ids, watermark, dropped = state4
+
+    # Per-batch (wanted, counted_local) ride the scan's ys — see
+    # parallel.sharded._build_scan: int32 sums are exact and
+    # associative, so summing after the scan and psum-ing ONCE is
+    # bit-identical to the per-batch merges.
+    def one(carry, xs):
+        regs, ids, wm = carry
+        if packed:
+            p, u, t = xs
+            a, e, v = wc.unpack_columns(p)
+        else:
+            a, u, e, t, v = xs
+        regs, ids, wm, wn, cl = _hll_fold_local(
+            regs, ids, wm, join_table, a, u, e, t, v,
+            divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+            view_type=view_type)
+        return (regs, ids, wm), (wn, cl)
+
+    (regs, ids, wm), (wn, cl) = jax.lax.scan(
+        one, (registers, window_ids, watermark), cols)
+    new_dropped = dropped + jnp.sum(wn) - jax.lax.psum(jnp.sum(cl),
+                                                       CAMPAIGN_AXIS)
+    return regs, ids, wm, new_dropped
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hll_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                    view_type: int, hoist: bool = True):
+    """Scanned sharded HLL: fold ``[K, B]`` stacked batches in one
+    dispatch (the catchup hot path, peer of
+    ``parallel.sharded._build_scan``).  ``hoist=True`` (the engine
+    default) gathers the stacked columns ONCE per dispatch and psums the
+    drop counter once after the scan — 6 collectives per dispatch
+    instead of K * 6; ``hoist=False`` keeps the per-batch collectives
+    (the measured baseline arm and the equivalence oracle in tests)."""
+
+    def body_per_batch(registers, window_ids, watermark, dropped,
+                       join_table, ad_idx, user_idx, event_type,
+                       event_time, valid):
         def one(carry, xs):
             regs, ids, wm, dr = carry
             a, u, e, t, v = xs
@@ -184,10 +282,59 @@ def _build_hll_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
             (ad_idx, user_idx, event_type, event_time, valid))
         return carry
 
+    def body_hoisted(registers, window_ids, watermark, dropped,
+                     join_table, ad_idx, user_idx, event_type,
+                     event_time, valid):
+        cols = _gather_cols(ad_idx, user_idx, event_type, event_time,
+                            valid)
+        return _hll_scan_hoisted(
+            join_table, (registers, window_ids, watermark, dropped), cols,
+            divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+            view_type=view_type, packed=False)
+
     mapped = shard_map(
-        body, mesh=mesh,
+        body_hoisted if hoist else body_per_batch, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P(), P(),
                   P(None, DATA_AXIS), P(None, DATA_AXIS),
+                  P(None, DATA_AXIS), P(None, DATA_AXIS),
+                  P(None, DATA_AXIS)),
+        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hll_scan_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
+                           view_type: int, hoist: bool = True):
+    """``_build_hll_scan`` over ``[K, B]`` (packed, user_idx, event_time)
+    stacks: 3 gathers + 1 psum per dispatch hoisted, K * 4 per-batch."""
+
+    def body_per_batch(registers, window_ids, watermark, dropped,
+                       join_table, packed, user_idx, event_time):
+        def one(carry, xs):
+            regs, ids, wm, dr = carry
+            p, u, t = xs
+            return _hll_fold_packed(regs, ids, wm, dr, join_table,
+                                    p, u, t, divisor_ms=divisor_ms,
+                                    lateness_ms=lateness_ms,
+                                    view_type=view_type), None
+
+        carry, _ = jax.lax.scan(
+            one, (registers, window_ids, watermark, dropped),
+            (packed, user_idx, event_time))
+        return carry
+
+    def body_hoisted(registers, window_ids, watermark, dropped,
+                     join_table, packed, user_idx, event_time):
+        cols = _gather_cols(packed, user_idx, event_time)
+        return _hll_scan_hoisted(
+            join_table, (registers, window_ids, watermark, dropped), cols,
+            divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+            view_type=view_type, packed=True)
+
+    mapped = shard_map(
+        body_hoisted if hoist else body_per_batch, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P(), P(),
                   P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(None, DATA_AXIS)),
         out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
@@ -246,11 +393,9 @@ class ShardedHLLEngine(HLLDistinctEngine):
                          redis=redis, registers=registers,
                          input_format=input_format)
         self.mesh = mesh
-        n_data = mesh.shape[DATA_AXIS]
-        if self.batch_size % n_data:
-            raise ValueError(
-                f"batch size {self.batch_size} not divisible by data-axis "
-                f"size {n_data}")
+        # Non-divisible batch sizes pad with invalid rows at dispatch,
+        # exactly like the exact-count engine (parallel.sharded).
+        self._data_pad = data_axis_pad(self.batch_size, mesh)
         self.state = sharded_hll_init(
             self.encoder.num_campaigns, self.W, mesh,
             num_registers=registers)
@@ -259,20 +404,95 @@ class ShardedHLLEngine(HLLDistinctEngine):
             NamedSharding(mesh, P()))
 
     def _device_step(self, batch) -> None:
+        if self._pack_ok:
+            fn = _build_hll_step_packed(self.mesh, self.divisor,
+                                        self.lateness, 0)
+            packed = wc.pack_columns(batch.ad_idx, batch.event_type,
+                                     batch.valid)
+            packed, user, tm = pad_data_cols(
+                self._data_pad, packed, batch.user_idx, batch.event_time)
+            regs, ids, wm, dropped = fn(
+                self.state.registers, self.state.window_ids,
+                self.state.watermark, self.state.dropped, self.join_table,
+                packed, user, tm)
+            self.state = hll.HLLState(regs, ids, wm, dropped)
+            return
+        ad, user, et, tm, va = pad_data_cols(
+            self._data_pad, batch.ad_idx, batch.user_idx,
+            batch.event_type, batch.event_time, batch.valid)
         self.state = sharded_hll_step(
-            self.mesh, self.state, self.join_table,
-            batch.ad_idx, batch.user_idx, batch.event_type,
-            batch.event_time, batch.valid,
+            self.mesh, self.state, self.join_table, ad, user, et, tm, va,
             divisor_ms=self.divisor, lateness_ms=self.lateness)
 
     def _device_scan(self, ad_idx, user_idx, event_type, event_time,
                      valid) -> None:
         fn = _build_hll_scan(self.mesh, self.divisor, self.lateness, 0)
+        ad_idx, user_idx, event_type, event_time, valid = pad_data_cols(
+            self._data_pad, ad_idx, user_idx, event_type, event_time,
+            valid)
         regs, ids, wm, dropped = fn(
             self.state.registers, self.state.window_ids,
             self.state.watermark, self.state.dropped, self.join_table,
             ad_idx, user_idx, event_type, event_time, valid)
         self.state = hll.HLLState(regs, ids, wm, dropped)
+
+    def _device_scan_packed(self, packed, user_idx, event_time) -> None:
+        """The packed wire word, extended to the sharded sketch engine
+        (ISSUE 7): 3 stacked columns gather per dispatch instead of 5."""
+        fn = _build_hll_scan_packed(self.mesh, self.divisor,
+                                    self.lateness, 0)
+        packed, user_idx, event_time = pad_data_cols(
+            self._data_pad, packed, user_idx, event_time)
+        regs, ids, wm, dropped = fn(
+            self.state.registers, self.state.window_ids,
+            self.state.watermark, self.state.dropped, self.join_table,
+            packed, user_idx, event_time)
+        self.state = hll.HLLState(regs, ids, wm, dropped)
+
+    def attach_obs(self, registry, lifecycle: bool = False) -> None:
+        super().attach_obs(registry, lifecycle)
+        self._obs_reg = registry
+
+    def collective_report(self, k: int | None = None) -> dict:
+        """Per-dispatch collective costs of the compiled HLL kernels
+        (see ``ShardedWindowEngine.collective_report``)."""
+        from streambench_tpu.parallel import collectives
+
+        k = int(k or self.scan_batches)
+        B = self.batch_size + self._data_pad
+        st = self.state
+        state_args = (st.registers, st.window_ids, st.watermark,
+                      st.dropped, self.join_table)
+        zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+        if self._pack_ok:
+            step_fn = _build_hll_step_packed(self.mesh, self.divisor,
+                                             self.lateness, 0)
+            step_args = (zi(B), zi(B), zi(B))
+            scan_fn = _build_hll_scan_packed(self.mesh, self.divisor,
+                                             self.lateness, 0)
+            scan_args = (zi(k, B), zi(k, B), zi(k, B))
+        else:
+            step_fn = _build_hll_step(self.mesh, self.divisor,
+                                      self.lateness, 0)
+            step_args = (zi(B), zi(B), zi(B), zi(B),
+                         jnp.zeros((B,), bool))
+            scan_fn = _build_hll_scan(self.mesh, self.divisor,
+                                      self.lateness, 0)
+            scan_args = (zi(k, B), zi(k, B), zi(k, B), zi(k, B),
+                         jnp.zeros((k, B), bool))
+        report = {
+            "batch_events": self.batch_size,
+            "scan_batches": k,
+            "packed": bool(self._pack_ok),
+            "step": collectives.report_for(step_fn, *state_args,
+                                           *step_args),
+            "scan": collectives.report_for(scan_fn, *state_args,
+                                           *scan_args, scan_len=k),
+        }
+        reg = getattr(self, "_obs_reg", None)
+        if reg is not None:
+            collectives.publish_gauges(reg, report)
+        return report
 
     def restore(self, snap) -> None:
         super().restore(snap)
@@ -462,11 +682,9 @@ class ShardedSlidingTDigestEngine(SlidingTDigestEngine):
                          window_slots=window_slots, compression=compression,
                          input_format=input_format)
         self.mesh = mesh
-        n_data = mesh.shape[DATA_AXIS]
-        if self.batch_size % n_data:
-            raise ValueError(
-                f"batch size {self.batch_size} not divisible by data-axis "
-                f"size {n_data}")
+        # Non-divisible batch sizes pad with invalid rows at dispatch,
+        # exactly like the exact-count engine (parallel.sharded).
+        self._data_pad = data_axis_pad(self.batch_size, mesh)
         self._place_sliding()
 
     def _place_sliding(self) -> None:
@@ -510,17 +728,19 @@ class ShardedSlidingTDigestEngine(SlidingTDigestEngine):
     def _device_step(self, batch) -> None:
         fn = _build_sliding_step(self.mesh, self.size_ms, self.slide_ms,
                                  self.base_lateness)
+        cols = pad_data_cols(self._data_pad, batch.ad_idx,
+                             batch.event_type, batch.event_time,
+                             batch.valid)
         self._uncarry(fn(*self._carry(), self.join_table, self._now_rel(),
-                         jnp.asarray(batch.ad_idx),
-                         jnp.asarray(batch.event_type),
-                         jnp.asarray(batch.event_time),
-                         jnp.asarray(batch.valid)))
+                         *cols))
 
     def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
         fn = _build_sliding_scan(self.mesh, self.size_ms, self.slide_ms,
                                  self.base_lateness)
+        cols = pad_data_cols(self._data_pad, ad_idx, event_type,
+                             event_time, valid)
         self._uncarry(fn(*self._carry(), self.join_table, self._now_rel(),
-                         ad_idx, event_type, event_time, valid))
+                         *cols))
 
     def quantiles(self) -> np.ndarray:
         # padded campaign rows are empty digests; slice them off

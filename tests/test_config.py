@@ -121,6 +121,21 @@ def test_ingest_pipeline_keys():
         BenchmarkConfig.from_mapping({"jax.ingest.pipeline": "maybe"})
 
 
+def test_mesh_keys():
+    """jax.mesh.shape / jax.mesh.axes (the multichip scale-out keys):
+    defaults, list round-trip, and the non-int rejection."""
+    c = default_config()
+    assert c.jax_mesh_shape == (1,)
+    assert c.jax_mesh_axes == ("data",)
+    c = BenchmarkConfig.from_mapping(
+        {"jax.mesh.shape": [4, 2],
+         "jax.mesh.axes": ["data", "campaign"]})
+    assert c.jax_mesh_shape == (4, 2)
+    assert c.jax_mesh_axes == ("data", "campaign")
+    with pytest.raises(ConfigError):
+        BenchmarkConfig.from_mapping({"jax.mesh.shape": ["wide"]})
+
+
 def test_committed_reference_conf_roundtrip():
     """The committed ``conf/benchmarkConf.yaml`` documents every honored
     key at its default (VERDICT r5 "What's missing" #3): loading it must

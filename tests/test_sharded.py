@@ -166,3 +166,156 @@ def test_sharded_packed_step_and_scan_bit_identical(dshape):
     assert np.array_equal(np.asarray(counts), np.asarray(pcounts))
     assert np.array_equal(np.asarray(ids), np.asarray(pids))
     assert int(dr) == int(pdr)
+
+
+# ----------------------------------------------------------------------
+# ISSUE 7: hoisted-gather scans + non-divisible batch padding
+# ----------------------------------------------------------------------
+
+def adversarial_batches(rng, n_batches, B, n_ads):
+    """Batches with duplicate rows, rows late beyond allowed lateness,
+    and invalid rows — the cases where watermark/ring/drop accounting
+    could diverge between the per-batch and hoisted forms."""
+    out = []
+    t = 70_000
+    for k in range(n_batches):
+        ad = rng.integers(0, n_ads, B).astype(np.int32)
+        et = rng.integers(0, 3, B).astype(np.int32)
+        tm = (t + rng.integers(0, 30_000, B)).astype(np.int32)
+        # duplicates: a block of rows repeated verbatim
+        q = B // 4
+        ad[q:2 * q] = ad[:q]
+        et[q:2 * q] = et[:q]
+        tm[q:2 * q] = tm[:q]
+        # late rows: behind the watermark by more than allowed lateness
+        # once a couple of batches have advanced it; forced valid views
+        # so the sweep is guaranteed to exercise the drop accounting
+        tm[:B // 8] = max(5_000, t - 150_000)
+        et[:B // 8] = 0
+        valid = rng.random(B) < 0.85
+        valid[:B // 8] = True
+        t += 60_000
+        out.append((ad, et, tm, valid))
+    return out
+
+
+@pytest.mark.parametrize("dshape", [(4, 2), (2, 4)])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sharded_scan_hoisted_bit_identical(dshape, seed):
+    """The tentpole equivalence sweep: the hoisted-gather scans (ONE
+    [K, B] collective per column per dispatch + one deferred drop psum)
+    must match the per-batch-gather scans AND the single-step sequence
+    bit for bit — counts, window_ids, watermark, dropped — over seeds
+    with late/duplicate/invalid rows, packed and unpacked."""
+    from streambench_tpu.parallel.sharded import (
+        _build_scan,
+        _build_scan_packed,
+    )
+
+    d, c = dshape
+    mesh = build_mesh(data=d, campaign=c, devices=jax.devices()[:d * c])
+    rng = np.random.default_rng(seed)
+    C, W, A, B, K = 16, 8, 64, 8 * d, 4
+    jt = np.concatenate([rng.integers(0, C, A).astype(np.int32), [-1]])
+    batches = adversarial_batches(rng, K, B, A + 1)
+
+    ground = sharded_init_state(C, W, mesh)
+    for ad, et, tm, va in batches:
+        ground = sharded_step(mesh, ground, jt, ad, et, tm, va)
+
+    stack = lambda i: np.stack([b[i] for b in batches])  # noqa: E731
+    words = np.stack([wc.pack_columns(ad, et, va)
+                      for ad, et, tm, va in batches])
+    arms = {
+        "perbatch": (_build_scan(mesh, 10_000, 60_000, 0, False),
+                     (stack(0), stack(1), stack(2), stack(3))),
+        "hoisted": (_build_scan(mesh, 10_000, 60_000, 0, True),
+                    (stack(0), stack(1), stack(2), stack(3))),
+        "packed_perbatch": (_build_scan_packed(mesh, 10_000, 60_000, 0,
+                                               False), (words, stack(2))),
+        "packed_hoisted": (_build_scan_packed(mesh, 10_000, 60_000, 0,
+                                              True), (words, stack(2))),
+    }
+    assert int(ground.dropped) > 0  # the sweep must exercise drops
+    for name, (fn, cols) in arms.items():
+        s = sharded_init_state(C, W, mesh)
+        counts, ids, wm, dr = fn(
+            s.counts, s.window_ids, s.watermark, s.dropped, jt, *cols)
+        assert np.array_equal(np.asarray(ground.counts),
+                              np.asarray(counts)), name
+        assert np.array_equal(np.asarray(ground.window_ids),
+                              np.asarray(ids)), name
+        assert int(ground.watermark) == int(wm), name
+        assert int(ground.dropped) == int(dr), name
+
+
+def test_padded_batch_kernels_bit_identical():
+    """A batch size the data axis doesn't divide, padded with invalid
+    rows (pad_data_cols), must produce the single-device op's exact
+    state — padding rows touch nothing."""
+    from streambench_tpu.parallel.sharded import (
+        _build_scan,
+        data_axis_pad,
+        pad_data_cols,
+    )
+
+    mesh = build_mesh(data=4, campaign=2)
+    rng = np.random.default_rng(9)
+    C, W, A, B, K = 16, 8, 64, 30, 3  # 30 % 4 != 0 -> pad 2
+    pad = data_axis_pad(B, mesh)
+    assert pad == 2
+    jt = np.concatenate([rng.integers(0, C, A).astype(np.int32), [-1]])
+    batches = adversarial_batches(rng, K, B, A + 1)
+
+    ref = wc.init_state(C, W)
+    for ad, et, tm, va in batches:
+        ref = wc.step(ref, jt, ad, et, tm, va)
+
+    stack = lambda i: np.stack([b[i] for b in batches])  # noqa: E731
+    cols = pad_data_cols(pad, stack(0), stack(1), stack(2), stack(3))
+    s = sharded_init_state(C, W, mesh)
+    counts, ids, wm, dr = _build_scan(mesh, 10_000, 60_000, 0)(
+        s.counts, s.window_ids, s.watermark, s.dropped, jt, *cols)
+    assert np.array_equal(np.asarray(ref.counts), np.asarray(counts))
+    assert np.array_equal(np.asarray(ref.window_ids), np.asarray(ids))
+    assert int(ref.watermark) == int(wm)
+    assert int(ref.dropped) == int(dr)
+
+
+def test_sharded_engine_end_to_end_nondivisible_batch(tmp_path):
+    """The remainder case end-to-end: batch size 500 on an 8-wide data
+    axis (pad 4) through the real runner, oracle-exact."""
+    cfg = default_config(jax_batch_size=500, jax_window_slots=16)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=10_000,
+                 rng=random.Random(15), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+
+    mesh = build_mesh(data=8, campaign=1)
+    engine = ShardedWindowEngine(cfg, mapping, mesh, redis=r)
+    assert engine._data_pad == 4
+    stats = StreamRunner(engine, broker.reader(cfg.kafka_topic)).run_catchup()
+    engine.close()
+    assert stats.events == 10_000
+    assert engine.dropped == 0
+
+    logs = []
+    correct, differ, missing = gen.check_correct(r, str(tmp_path),
+                                                 log=logs.append)
+    assert differ == 0 and missing == 0, logs[:5]
+    assert correct > 0
+
+
+def test_mesh_from_config_keys():
+    """jax.mesh.shape / jax.mesh.axes drive build_mesh (the conf keys
+    documented in conf/benchmarkConf.yaml)."""
+    from streambench_tpu.config import BenchmarkConfig
+    from streambench_tpu.parallel import mesh_from_config
+    from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
+
+    cfg = BenchmarkConfig.from_mapping(
+        {"jax.mesh.shape": [4, 2],
+         "jax.mesh.axes": ["data", "campaign"]})
+    mesh = mesh_from_config(cfg)
+    assert mesh.shape[DATA_AXIS] == 4 and mesh.shape[CAMPAIGN_AXIS] == 2

@@ -231,6 +231,103 @@ def test_sharded_session_scan_matches_step_sequence():
         assert np.array_equal(np.asarray(a), np.asarray(b)), (a, b)
 
 
+@pytest.mark.parametrize("dshape", [(4, 2), (2, 2)])
+def test_sharded_hll_packed_and_hoisted_bit_identical(dshape):
+    """ISSUE 7 wire packing for the sketch engines: the packed HLL
+    step/scan (3 data-axis gathers instead of 5) and the hoisted scans
+    (gathers + drop psum once per dispatch) must match the unpacked
+    per-batch kernels register for register."""
+    from streambench_tpu.ops import windowcount as wc
+    from streambench_tpu.parallel.sketches import (
+        _build_hll_scan,
+        _build_hll_scan_packed,
+        _build_hll_step_packed,
+    )
+
+    d, c = dshape
+    mesh = build_mesh(data=d, campaign=c, devices=jax.devices()[:d * c])
+    rng = np.random.default_rng(23)
+    C, W, A, B, K, U = 16, 8, 64, 8 * d, 3, 48
+    jt = jnp.asarray(np.concatenate(
+        [rng.integers(0, C, A).astype(np.int32), [-1]]))
+    batches = rand_batches(rng, K, B, A + 1, U)
+
+    ground = sharded_hll_init(C, W, mesh, num_registers=16)
+    psteps = sharded_hll_init(C, W, mesh, num_registers=16)
+    pfn = _build_hll_step_packed(mesh, 10_000, 60_000, 0)
+    for ad, user, et, tm, va in batches:
+        ground = sharded_hll_step(mesh, ground, jt, ad, user, et, tm, va)
+        word = wc.pack_columns(ad, et, va)
+        regs, ids, wm, dr = pfn(
+            psteps.registers, psteps.window_ids, psteps.watermark,
+            psteps.dropped, jt, word, user, tm)
+        psteps = hll.HLLState(regs, ids, wm, dr)
+
+    def eq(state, arms_name):
+        assert np.array_equal(np.asarray(ground.registers),
+                              np.asarray(state[0])), arms_name
+        assert np.array_equal(np.asarray(ground.window_ids),
+                              np.asarray(state[1])), arms_name
+        assert int(ground.watermark) == int(state[2]), arms_name
+        assert int(ground.dropped) == int(state[3]), arms_name
+
+    eq(psteps, "packed step sequence")
+
+    stack = lambda i: np.stack([b[i] for b in batches])  # noqa: E731
+    words = np.stack([wc.pack_columns(ad, et, va)
+                      for ad, user, et, tm, va in batches])
+    arms = {
+        "scan_perbatch": (_build_hll_scan(mesh, 10_000, 60_000, 0, False),
+                          (stack(0), stack(1), stack(2), stack(3),
+                           stack(4))),
+        "scan_hoisted": (_build_hll_scan(mesh, 10_000, 60_000, 0, True),
+                         (stack(0), stack(1), stack(2), stack(3),
+                          stack(4))),
+        "packed_scan_perbatch": (
+            _build_hll_scan_packed(mesh, 10_000, 60_000, 0, False),
+            (words, stack(1), stack(3))),
+        "packed_scan_hoisted": (
+            _build_hll_scan_packed(mesh, 10_000, 60_000, 0, True),
+            (words, stack(1), stack(3))),
+    }
+    for name, (fn, cols) in arms.items():
+        s = sharded_hll_init(C, W, mesh, num_registers=16)
+        out = fn(s.registers, s.window_ids, s.watermark, s.dropped, jt,
+                 *cols)
+        eq(out, name)
+
+
+def test_sharded_hll_engine_packed_scan_and_padding(tmp_path):
+    """The engine dispatches the packed scan (PACKED_EXTRA_COLS carries
+    user ids) and pads a non-divisible batch size — estimates still
+    equal the single-device engine's on the same journal."""
+    cfg = default_config(jax_batch_size=250, jax_window_slots=16)
+    broker = FileBroker(str(tmp_path / "broker"))
+    r1 = as_redis(FakeRedisStore())
+    gen.do_setup(r1, cfg, broker=broker, events_num=6_000,
+                 rng=random.Random(21), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+
+    mesh = build_mesh(data=4, campaign=2)
+    eng = ShardedHLLEngine(cfg, mapping, mesh, redis=r1)
+    assert eng._packed_scan, "packed scan must be eligible"
+    assert eng._data_pad == 2  # 250 % 4
+    stats = StreamRunner(eng, broker.reader(cfg.kafka_topic)).run_catchup()
+    eng.close()
+    assert stats.events == 6_000 and eng.dropped == 0
+
+    r2 = as_redis(FakeRedisStore())
+    from streambench_tpu.io.redis_schema import seed_campaigns
+    seed_campaigns(r2, gen.load_ids(str(tmp_path))[0])
+    ref = HLLDistinctEngine(cfg, mapping, redis=r2)
+    StreamRunner(ref, broker.reader(cfg.kafka_topic)).run_catchup()
+    ref.close()
+
+    from streambench_tpu.io.redis_schema import read_seen_counts
+    assert read_seen_counts(r1) == read_seen_counts(r2)
+
+
 def test_sharded_hll_engine_end_to_end(tmp_path):
     """ShardedHLLEngine through the real runner: estimates equal the
     single-device HLL engine's on the same journal."""
