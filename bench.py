@@ -181,6 +181,8 @@ class HeadlineEmitter:
             "unit": h.get("unit"),
             "vs_baseline": h.get("vs_baseline"),
             "platform": h.get("platform"),
+            "device_busy_ratio": (h.get("occupancy") or {}).get(
+                "device_busy_ratio"),
             "max_sustained_rate": sweep.get("max_sustained_rate"),
             "configs": rows,
             "device": {k: dev[k] for k in (
@@ -222,6 +224,11 @@ class HeadlineEmitter:
             "attribution": self.headline.get("attribution"),
             "device_occupancy_meas": self.headline.get(
                 "device_occupancy_meas"),
+            # obs.occupancy sampled measurement (device_busy_ratio +
+            # dispatch histogram + recompile counters) and the
+            # perfetto-loadable span trace (obs.spans)
+            "occupancy": self.headline.get("occupancy"),
+            "span_trace": self.headline.get("span_trace"),
             "trace": self.headline.get("trace"),
             **(self.headline.get("latency_sweep") or {}),
         }
@@ -473,14 +480,18 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
                          expect_windows: bool = True,
                          flush_interval_ms: int | None = None,
                          latency_from_engine: bool = False,
-                         producer_args: list | None = None) -> dict:
+                         producer_args: list | None = None,
+                         slo_p99_ms: int | None = None) -> dict:
     """Pace events in real time at ``rate`` ev/s and report the canonical
     latency metric from what landed in Redis (``core.clj:130-149``),
     with ONE sample per unique window (not per campaign-window row).
 
     ``engine_factory(redis)`` swaps the engine family (config rows reuse
     this phase); ``expect_windows=False`` skips the canonical-schema
-    latency read for engines that write no window rows (session/CMS)."""
+    latency read for engines that write no window rows (session/CMS);
+    ``slo_p99_ms`` arms live burn-rate SLO tracking (obs.slo) over the
+    run's writeback-latency histogram and records the verdict under
+    ``"slo"`` — the machine-checked form of the SLA judgment."""
     from streambench_tpu.datagen import gen
     from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
     from streambench_tpu.io.redis_schema import (
@@ -518,6 +529,30 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
               else broker.reader(topic))
     runner = StreamRunner(engine, reader,
                           flush_interval_ms=flush_interval_ms)
+    # Live SLO gate (obs.slo): a background sampler ticks the burn-rate
+    # tracker over the writeback-latency histogram once a second; the
+    # verdict block lands in the rung result (and so in the artifact).
+    slo = slo_sampler = None
+    if slo_p99_ms:
+        from streambench_tpu.obs import (
+            MetricsRegistry,
+            MetricsSampler,
+            SloTracker,
+            engine_collector,
+        )
+
+        slo_reg = MetricsRegistry()
+        engine.attach_obs(slo_reg)
+        slo = SloTracker(slo_reg, p99_ms=slo_p99_ms,
+                         rate_evps=0, budget=0.01,
+                         fast_s=15.0, slow_s=60.0)
+        slo_sampler = MetricsSampler(
+            os.path.join(workdir, f"paced-slo-{run_id}-{rate}.jsonl"),
+            interval_ms=1000, registry=slo_reg)
+        slo_sampler.add_collector(engine_collector(
+            engine, reader=reader, runner=runner, registry=slo_reg))
+        slo_sampler.add_collector(slo.collect)
+        slo_sampler.start()
 
     # Producers run as their OWN processes (the reference's generator is a
     # separate JVM, stream-bench.sh:229): in-process they contend with the
@@ -600,6 +635,10 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
         raise RuntimeError(
             f"{len(failures)} paced producer(s) failed: {failures[0]}")
     engine.close()
+    if slo_sampler is not None:
+        # closed AFTER engine.close(): the writer has drained, so the
+        # final tick sees every written window before the verdict
+        slo_sampler.close(final=None)
     wall = time.monotonic() - t0
     log(engine.tracer.report())
     if runner.stats.events == 0 and sent.get("n"):
@@ -656,6 +695,8 @@ def _paced_latency_phase(cfg, mapping, broker, r, workdir,
         "flush_stalls": runner.stall_detector.stalls,
         "flush_stall_max_ms": int(runner.stall_detector.max_gap_ms),
     }
+    if slo is not None:
+        out["slo"] = slo.verdict()
     log(f"paced phase: rate={rate}/s sent={sent.get('n')} "
         f"processed={runner.stats.events} wall={wall:.1f}s "
         f"unique_windows={len(lats)} behind={behind['n']} "
@@ -860,7 +901,8 @@ def _latency_sweep(cfg, mapping, broker, workdir, start_rate: int,
                 "bench time budget")
         res = _paced_latency_phase(cfg, mapping, broker,
                                    as_redis(make_store()), workdir,
-                                   rate, rung_s, run_id=run_id)
+                                   rate, rung_s, run_id=run_id,
+                                   slo_p99_ms=sla_ms)
         if rung_s < duration_s:
             res["duration_clamped_s"] = round(rung_s, 1)
         run_id += 1
@@ -1354,8 +1396,25 @@ def main() -> int:
         # headline throughput must not carry silent instrumentation.
         want_attr = bool(metrics_dir) or os.environ.get(
             "STREAMBENCH_BENCH_ATTRIBUTION", "0") == "1"
+        # MEASURED device occupancy + span tracing (obs.occupancy /
+        # obs.spans) ride every catchup rep by default.  Sampling is
+        # 1-in-4 HERE (not the config default 32): a catchup rep folds
+        # K=16-batch scan groups, so a 2M-event run is only ~16
+        # dispatches — a sparser cadence measures nothing.  Each sample
+        # syncs a scan-group boundary the async queue would have
+        # reached within one chunk anyway.  The span ring is a
+        # lock+append per stage span.  The README's occupancy claim
+        # comes from THIS gauge now, not the pipelined-minus-encode
+        # estimate.
+        want_occ = os.environ.get("STREAMBENCH_BENCH_OCCUPANCY",
+                                  "1") == "1"
+        occ_sample = max(int(os.environ.get(
+            "STREAMBENCH_BENCH_OCCUPANCY_SAMPLE", "4")), 1)
+        want_spans = os.environ.get("STREAMBENCH_BENCH_SPANS",
+                                    "1") == "1"
 
         best = None  # (value, stats, engine, store, total_s, attribution)
+        best_obs = (None, None)   # (occupancy summary, span tracer)
         trace_occ = None
         rep_cost_s = 0.0
         for rep in range(reps):
@@ -1373,27 +1432,40 @@ def main() -> int:
             engine = AdAnalyticsEngine(cfg, mapping, redis=r_rep,
                                        method=method)
             rep_reader = broker.reader(cfg.kafka_topic)
+            from streambench_tpu.obs import (
+                MetricsRegistry,
+                OccupancySampler,
+                SpanTracer,
+            )
+
+            obs_reg = MetricsRegistry()
+            occ = spans_tr = None
+            if want_occ:
+                occ = OccupancySampler(obs_reg, sample_every=occ_sample)
+                # every program was compiled by the device probe above;
+                # any compile from here on is a mid-run stall the
+                # artifact should show (steady-state-zero invariant)
+                occ.mark_steady()
+            if want_spans:
+                spans_tr = SpanTracer(capacity=8192, registry=obs_reg)
             # STREAMBENCH_BENCH_INGEST=off|on|auto overrides the staged
             # ingest pipeline for the headline catchup (default: config)
             runner = StreamRunner(
                 engine, rep_reader,
                 ingest_pipeline=os.environ.get(
-                    "STREAMBENCH_BENCH_INGEST", "").strip().lower() or None)
+                    "STREAMBENCH_BENCH_INGEST", "").strip().lower() or None,
+                spans=spans_tr)
             obs_sampler = None
-            if want_attr and not metrics_dir:
-                # attribution without a journal: registry only
-                from streambench_tpu.obs import MetricsRegistry
-
-                engine.attach_obs(MetricsRegistry(), lifecycle=True)
+            if (want_attr or occ is not None or spans_tr is not None
+                    or metrics_dir):
+                engine.attach_obs(obs_reg, lifecycle=want_attr,
+                                  spans=spans_tr, occupancy=occ)
             if metrics_dir:
                 from streambench_tpu.obs import (
-                    MetricsRegistry,
                     MetricsSampler,
                     engine_collector,
                 )
 
-                obs_reg = MetricsRegistry()
-                engine.attach_obs(obs_reg, lifecycle=want_attr)
                 obs_sampler = MetricsSampler(
                     os.path.join(metrics_dir,
                                  f"bench-metrics-rep{rep + 1}.jsonl"),
@@ -1442,10 +1514,34 @@ def main() -> int:
                         f"{trace_occ['occupancy']:.1%} occupancy")
             rep_cost_s = max(rep_cost_s, total_s)
             lc = getattr(engine, "_obs_lifecycle", None)
+            occ_summary = occ.summary() if occ is not None else None
+            if occ is not None:
+                occ.close()   # stop counting compiles for this rep
+            if occ_summary is not None:
+                log(f"occupancy rep {rep + 1}: device_busy_ratio="
+                    f"{occ_summary['device_busy_ratio']:.4f} "
+                    f"({occ_summary['sampled']} sampled of "
+                    f"{occ_summary['dispatches']} dispatches, "
+                    f"steady compiles "
+                    f"{(occ_summary.get('compiles') or {}).get('compiles_steady')})")
             if best is None or v > best[0]:
                 best = (v, stats, engine, r_rep, total_s,
                         lc.summary() if lc is not None else None)
+                best_obs = (occ_summary, spans_tr)
         value, stats, engine, r_best, total_s, attribution = best
+        occupancy_meas, best_spans = best_obs
+        span_trace = None
+        if best_spans is not None and len(best_spans):
+            trace_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "trace_bench.json")
+            best_spans.dump(trace_path, run="bench-catchup")
+            span_trace = {"path": os.path.basename(trace_path),
+                          "spans": len(best_spans),
+                          "dropped": best_spans.dropped}
+            log(f"span trace: {trace_path} ({span_trace['spans']} "
+                f"spans) — perfetto-loadable; `python -m "
+                f"streambench_tpu.obs trace` summarizes it")
         value = round(value, 1)
         log(f"engine: method={engine.method} W={engine.W} "
             f"B={engine.batch_size} K={engine.scan_batches} "
@@ -1481,6 +1577,11 @@ def main() -> int:
             device=device or None,
             attribution=attribution,
             device_occupancy_meas=round(util, 4) if util else None,
+            # the sampled-dispatch measurement (obs.occupancy): the
+            # device_busy_ratio key README quotes, next to the older
+            # fold-time extrapolation above for continuity
+            occupancy=occupancy_meas,
+            span_trace=span_trace,
             trace=trace_occ,
             latency_sweep=None,
             configs=[exact_row],

@@ -82,6 +82,16 @@ METRICS_INTERVAL_MS = int(os.environ.get("METRICS_INTERVAL_MS", "0"))
 OBS_LIFECYCLE = os.environ.get("OBS_LIFECYCLE", "") not in (
     "", "0", "false", "no")
 FLIGHTREC = os.environ.get("FLIGHTREC", "") not in ("", "0", "false", "no")
+# OBS_SPANS=1 arms span tracing (<workdir>/trace_<pid>.json, perfetto-
+# loadable); OBS_OCCUPANCY=1 measures device occupancy (sampled
+# block_until_ready -> device_busy_ratio in the engine's stats line);
+# SLO_P99_MS / SLO_RATE_EVPS set objectives whose burn-rate breaches
+# are journaled and whose pass/fail verdict rides the stats line.
+OBS_SPANS = os.environ.get("OBS_SPANS", "") not in ("", "0", "false", "no")
+OBS_OCCUPANCY = os.environ.get("OBS_OCCUPANCY", "") not in (
+    "", "0", "false", "no")
+SLO_P99_MS = int(os.environ.get("SLO_P99_MS", "0"))
+SLO_RATE_EVPS = int(os.environ.get("SLO_RATE_EVPS", "0"))
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -255,6 +265,10 @@ def op_setup() -> None:
         "jax.metrics.interval.ms": METRICS_INTERVAL_MS,
         "jax.obs.lifecycle": OBS_LIFECYCLE,
         "jax.obs.flightrec.enabled": FLIGHTREC,
+        "jax.obs.spans": OBS_SPANS,
+        "jax.obs.occupancy": OBS_OCCUPANCY,
+        "jax.slo.p99.ms": SLO_P99_MS,
+        "jax.slo.rate.evps": SLO_RATE_EVPS,
     })
     log(f"wrote {CONF_FILE}")
     try:

@@ -173,6 +173,32 @@ class BenchmarkConfig:
     #   annotations) dumped to <workdir>/flight_<reason>.jsonl on crash,
     #   give_up, fatal exception, or SIGTERM
     jax_obs_flightrec_capacity: int = 512  # flight-ring record capacity
+    # --- span tracing + measured occupancy + SLO gates (obs/; ISSUE 8 —
+    # all default-off: the serial hot path stays byte-identical) ---
+    jax_obs_spans: bool = False            # bounded thread-aware span ring
+    #   (every Tracer stage span + ingest read spans), dumped as Chrome
+    #   trace-event JSON <workdir>/trace_<pid>.json at exit — loadable
+    #   in perfetto; flight-recorder dumps embed the last closed spans
+    jax_obs_spans_capacity: int = 4096     # span-ring capacity (evictions
+    #   are counted, never silent)
+    jax_obs_occupancy: bool = False        # MEASURED device occupancy:
+    #   1-in-N dispatches are timed to block_until_ready completion and
+    #   extrapolated into streambench_device_busy_ratio + a per-dispatch
+    #   device-time histogram; also arms the recompile detector
+    #   (streambench_compiles_total, steady-state-zero after warmup)
+    jax_obs_occupancy_sample: int = 32     # the N in 1-in-N dispatch
+    #   sampling (1 = time every dispatch; bench probes only)
+    jax_slo_p99_ms: int = 0                # >0: window-latency objective —
+    #   a written window whose e2e latency exceeds this is "bad"; burn
+    #   rate of the error budget is tracked over fast+slow windows and
+    #   breaches are journaled + gauged (streambench_slo_*), with a
+    #   pass/fail verdict in the RunStats close line
+    jax_slo_rate_evps: int = 0             # >0: ingest-rate objective —
+    #   a sample interval below this rate (while events flow) is "bad"
+    jax_slo_budget: float = 0.01           # error budget: fraction of
+    #   windows/intervals allowed to be bad before the burn rate hits 1
+    jax_slo_fast_s: int = 30               # fast burn window (onset)
+    jax_slo_slow_s: int = 180              # slow burn window (confirmation)
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -209,6 +235,14 @@ class BenchmarkConfig:
         def gets(key: str, default: str) -> str:
             v = conf.get(key, default)
             return default if v is None else str(v)
+
+        def getf(key: str, default: float) -> float:
+            v = conf.get(key, default)
+            try:
+                return float(v)
+            except (TypeError, ValueError) as e:
+                raise ConfigError(
+                    f"config key {key!r} is not a number: {v!r}") from e
 
         def getb(key: str, default: bool) -> bool:
             v = conf.get(key, default)
@@ -299,6 +333,17 @@ class BenchmarkConfig:
             jax_obs_flightrec=getb("jax.obs.flightrec.enabled", False),
             jax_obs_flightrec_capacity=max(
                 geti("jax.obs.flightrec.capacity", 512), 8),
+            jax_obs_spans=getb("jax.obs.spans", False),
+            jax_obs_spans_capacity=max(
+                geti("jax.obs.spans.capacity", 4096), 16),
+            jax_obs_occupancy=getb("jax.obs.occupancy", False),
+            jax_obs_occupancy_sample=max(
+                geti("jax.obs.occupancy.sample", 32), 1),
+            jax_slo_p99_ms=max(geti("jax.slo.p99.ms", 0), 0),
+            jax_slo_rate_evps=max(geti("jax.slo.rate.evps", 0), 0),
+            jax_slo_budget=getf("jax.slo.budget", 0.01),
+            jax_slo_fast_s=max(geti("jax.slo.window.fast.s", 30), 1),
+            jax_slo_slow_s=max(geti("jax.slo.window.slow.s", 180), 1),
             raw=dict(conf),
         )
 

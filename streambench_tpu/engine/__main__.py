@@ -191,8 +191,19 @@ def main(argv: list[str] | None = None) -> int:
 
         flightrec = FlightRecorder(
             args.workdir, capacity=cfg.jax_obs_flightrec_capacity)
+    # Span tracer (obs.spans, default-off): bounded thread-aware ring of
+    # closed stage/read spans, dumped as perfetto-loadable Chrome trace
+    # JSON at exit; flight-recorder dumps embed its tail so a crash
+    # postmortem carries the final seconds' timing context.
+    spans = None
+    if cfg.jax_obs_spans:
+        from streambench_tpu.obs import SpanTracer
+
+        spans = SpanTracer(capacity=cfg.jax_obs_spans_capacity)
+        if flightrec is not None:
+            flightrec.span_source = spans.tail
     runner = StreamRunner(engine, reader, checkpointer=checkpointer,
-                          flightrec=flightrec)
+                          flightrec=flightrec, spans=spans)
     if runner.resume():
         print(f"resumed from checkpoint: offset={runner._reader_position()} "
               f"events={engine.events_processed}", flush=True)
@@ -222,22 +233,36 @@ def main(argv: list[str] | None = None) -> int:
     # jax.metrics.port >= 0 serves the localhost Prometheus endpoint
     # (0 = ephemeral, the chosen port is printed below so harnesses and
     # the smoke test can scrape without a race).
-    sampler = metrics_server = None
+    sampler = metrics_server = occupancy = slo = None
+    slo_wanted = cfg.jax_slo_p99_ms > 0 or cfg.jax_slo_rate_evps > 0
     if (cfg.jax_metrics_interval_ms > 0 or cfg.jax_metrics_port >= 0
-            or cfg.jax_obs_lifecycle):
+            or cfg.jax_obs_lifecycle or cfg.jax_obs_spans
+            or cfg.jax_obs_occupancy or slo_wanted):
         from streambench_tpu.obs import (
             MetricsRegistry,
             MetricsSampler,
             MetricsServer,
+            OccupancySampler,
+            SloTracker,
             engine_collector,
         )
 
         registry = MetricsRegistry()
+        # jax.obs.occupancy: sampled block_until_ready-timed dispatches
+        # -> the MEASURED device_busy_ratio, plus the recompile
+        # detector.  Everything is compiled (warmup above), so the
+        # steady-state compile counter starts now — its invariant value
+        # is zero.
+        if cfg.jax_obs_occupancy:
+            occupancy = OccupancySampler(
+                registry, sample_every=cfg.jax_obs_occupancy_sample)
+            occupancy.mark_steady()
         # jax.obs.lifecycle additionally attaches the per-window
         # attribution tracker (and, set alone, turns the sampler on at
         # its default cadence — attribution with no journal to land in
-        # would be pointless)
-        engine.attach_obs(registry, lifecycle=cfg.jax_obs_lifecycle)
+        # would be pointless; spans/occupancy/SLO likewise imply it)
+        engine.attach_obs(registry, lifecycle=cfg.jax_obs_lifecycle,
+                          spans=spans, occupancy=occupancy)
         metrics_path = os.path.join(args.workdir, "metrics.jsonl")
         sampler = MetricsSampler(
             metrics_path,
@@ -246,6 +271,19 @@ def main(argv: list[str] | None = None) -> int:
             max_bytes=cfg.jax_metrics_max_bytes)
         sampler.add_collector(engine_collector(
             engine, reader=reader, runner=runner, registry=registry))
+        # SLO burn-rate tracking (obs.slo): collects AFTER the engine
+        # collector so rec["events"]/["events_per_s"] feed the rate
+        # objective; breach transitions are journaled as event records
+        # and ticked into the flight recorder.
+        if slo_wanted:
+            slo = SloTracker(
+                registry, p99_ms=cfg.jax_slo_p99_ms,
+                rate_evps=cfg.jax_slo_rate_evps,
+                budget=cfg.jax_slo_budget, fast_s=cfg.jax_slo_fast_s,
+                slow_s=cfg.jax_slo_slow_s,
+                use_lifecycle=cfg.jax_obs_lifecycle,
+                annotate=sampler.annotate, flightrec=flightrec)
+            sampler.add_collector(slo.collect)
         sampler.start()
         endpoint = ""
         if cfg.jax_metrics_port >= 0:
@@ -302,6 +340,31 @@ def main(argv: list[str] | None = None) -> int:
         "dropped": engine.dropped, "wall_s": round(stats.wall_s, 2),
         "faults": stats.faults,
     }
+    if occupancy is not None:
+        # the MEASURED busy ratio (sampled block_until_ready, not the
+        # old pipelined-minus-encode estimate) + the steady-state
+        # compile invariant — nonzero steady compiles is a mid-run
+        # stall worth a loud line
+        occ_sum = occupancy.summary()
+        stats_line["device_busy_ratio"] = occ_sum["device_busy_ratio"]
+        stats_line["occupancy"] = occ_sum
+        steady = (occ_sum.get("compiles") or {}).get("compiles_steady")
+        if steady:
+            print(f"WARNING: {steady} XLA compile(s) landed after "
+                  "warmup — a program shape escaped warmup or "
+                  "something compiled on the hot path",
+                  file=sys.stderr, flush=True)
+            if flightrec is not None:
+                flightrec.record("steady_compiles", count=steady)
+        occupancy.close()
+    if slo is not None:
+        stats_line["slo"] = slo.verdict()
+    if spans is not None:
+        trace_path = os.path.join(args.workdir,
+                                  f"trace_{os.getpid()}.json")
+        spans.dump(trace_path, run=cfg.kafka_topic)
+        print(f"trace: {trace_path} ({len(spans)} spans, "
+              f"{spans.dropped} dropped)", file=sys.stderr, flush=True)
     if sampler is not None:
         # final telemetry record AFTER close(): the writer has drained,
         # so the record's cumulative counters and the run_stats it

@@ -112,12 +112,18 @@ class IngestPipeline:
                  block_queue: int = 4,
                  batch_queue: int = 4,
                  poll_interval_s: float = 0.001,
-                 flightrec=None) -> None:
+                 flightrec=None, spans=None) -> None:
         self.engine = engine
         self.reader = reader
         # crash flight recorder (obs.flightrec or None): stage errors
         # and first-stall events land in the postmortem ring
         self.flightrec = flightrec
+        # span tracer (obs.spans or None): non-empty reads and encode
+        # stage work land as "ingest_read"/"ingest_encode" spans on
+        # their own threads — the encode spans the engine's Tracer sink
+        # already forwards show WHAT was encoded; these show the stage
+        # residency around it
+        self.spans = spans
         self.batch_size = max(int(batch_size), 1)
         self.chunk_records = max(int(chunk_records), self.batch_size)
         self.buffer_timeout_ms = buffer_timeout_ms
@@ -213,6 +219,7 @@ class IngestPipeline:
         must never count as full, or a tiny budget at room == 1 would
         busy-spin on an idle stream)."""
         t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
         with self._reader_lock:
             if self.block_mode:
                 budget = room * self.est_event_bytes
@@ -225,6 +232,12 @@ class IngestPipeline:
                 got = len(data)
                 full = got >= room
         self.read_ms_total += (time.perf_counter() - t0) * 1e3
+        if self.spans is not None and got:
+            # only non-empty reads: at the 1 ms poll cadence, empty
+            # polls would flood the bounded ring with nothing
+            self.spans.add("ingest_read",
+                           t0_ns, time.perf_counter_ns() - t0_ns,
+                           cat="ingest", args={"records": got})
         return data, got, full
 
     def _reader_catchup(self) -> None:
@@ -314,6 +327,7 @@ class IngestPipeline:
                     self._put(self._batch_q, EOF, None)
                     return
                 t0 = time.perf_counter()
+                t0_ns = time.perf_counter_ns()
                 with self._encode_lock:
                     if self.block_mode:
                         item.batches = self.engine.encode_raw_block(
@@ -323,6 +337,11 @@ class IngestPipeline:
                             item.payload)
                 item.payload = None   # free the raw bytes early
                 self.encode_ms_total += (time.perf_counter() - t0) * 1e3
+                if self.spans is not None:
+                    self.spans.add(
+                        "ingest_encode",
+                        t0_ns, time.perf_counter_ns() - t0_ns,
+                        cat="ingest", args={"records": item.records})
                 if item.read_ms is not None and item.batches:
                     # attribution stamps (obs.lifecycle): the engine's
                     # encode halves default the read stamp to encode

@@ -558,6 +558,9 @@ class AdAnalyticsEngine:
         # attribution) is likewise None unless attach_obs opted in.
         self._obs_hist = None
         self._obs_lifecycle = None
+        # measured device occupancy (obs.occupancy): None unless
+        # attach_obs opted in — one None check per dispatch otherwise
+        self._obs_occupancy = None
         self._writer: _RedisWriter | None = None
         # Parallel encode pool (multi-core hosts): per-thread encoders,
         # sound only for engines whose kernel never reads the interned
@@ -877,6 +880,8 @@ class AdAnalyticsEngine:
                 cols.append(jnp.asarray(np.stack(arrs)))
             with self.tracer.span("device_scan"):
                 self._device_scan(*cols)
+        if self._obs_occupancy is not None:
+            self._obs_occupancy.note_dispatch(self.state)
         for b in batches:
             self._note_watermark(b)
         self.events_processed += sum(b.n for b in batches)
@@ -908,6 +913,8 @@ class AdAnalyticsEngine:
         with self.tracer.span("device_decode"):
             self.state = self._devdecode.fold(self.state, pb,
                                               method=self.method)
+        if self._obs_occupancy is not None:
+            self._obs_occupancy.note_dispatch(self.state)
         self._note_watermark(pb)
         self.events_processed += pb.n
         self.last_event_ms = now_ms()
@@ -1055,6 +1062,8 @@ class AdAnalyticsEngine:
             # device completion (that overlaps the next encode — the
             # pipeline-parallel analog, SURVEY.md §2)
             self._device_step(batch)
+        if self._obs_occupancy is not None:
+            self._obs_occupancy.note_dispatch(self.state)
         self._note_watermark(batch)
         self.events_processed += batch.n
         self.last_event_ms = now_ms()
@@ -1709,7 +1718,8 @@ class AdAnalyticsEngine:
     # live telemetry (obs/): both hooks are pull-oriented — the sampler
     # thread polls host-side bookkeeping; the only pushed signal is the
     # writeback-latency histogram fed from the writer thread.
-    def attach_obs(self, registry, lifecycle: bool = False) -> None:
+    def attach_obs(self, registry, lifecycle: bool = False,
+                   spans=None, occupancy=None) -> None:
         """Opt into live telemetry: register the window-latency streaming
         histogram on ``registry`` (obs.MetricsRegistry) so p50/p95/p99
         writeback latency is queryable *during* the run — the live
@@ -1722,7 +1732,17 @@ class AdAnalyticsEngine:
         batches, the watermark-note hook records folds, and each
         writeback decomposes its latency into
         ingest/encode/fold/flush/sink segment histograms on the same
-        registry."""
+        registry.
+
+        ``spans`` (obs.spans.SpanTracer) forwards every Tracer stage
+        span — encode, device_step/scan, drain, redis_flush (the
+        writer thread's sink spans included) — into the bounded
+        thread-aware ring for Chrome-trace export.
+
+        ``occupancy`` (obs.occupancy.OccupancySampler) is called after
+        every device dispatch; 1-in-N dispatches are timed to
+        ``block_until_ready`` completion for the measured
+        device-busy ratio."""
         self._obs_hist = registry.histogram(
             "streambench_window_latency_ms",
             "window writeback latency (time_updated - window_ts), ms")
@@ -1732,6 +1752,10 @@ class AdAnalyticsEngine:
             self._obs_lifecycle = WindowLifecycle(
                 registry, divisor_ms=self.divisor,
                 lateness_ms=self.lateness)
+        if spans is not None:
+            spans.attach(self.tracer)
+        if occupancy is not None:
+            self._obs_occupancy = occupancy
 
     def telemetry(self) -> dict:
         """Point-in-time observability snapshot of host bookkeeping.

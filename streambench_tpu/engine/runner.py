@@ -62,7 +62,7 @@ class StreamRunner:
                  checkpoint_interval_ms: int | None = None,
                  crash_points=None,
                  ingest_pipeline: str | None = None,
-                 flightrec=None):
+                 flightrec=None, spans=None):
         cfg = engine.cfg
         self.engine = engine
         self.reader = reader
@@ -107,6 +107,13 @@ class StreamRunner:
         # default) costs one attribute check per flush.
         self.flightrec = flightrec
         self._flight_prev_faults: dict = {}
+        # Span tracer (obs.spans or None): the engine's Tracer spans are
+        # forwarded by attach_obs; the runner adds the READ side — the
+        # serial loops' journal polls and the staged pipeline's stage
+        # spans — so the exported timeline covers read/encode/dispatch/
+        # flush/sink end to end.  None costs one attribute check per
+        # poll.
+        self.spans = spans
 
     def stop(self) -> None:
         self._stop = True
@@ -279,7 +286,7 @@ class StreamRunner:
             est_event_bytes=self.EST_EVENT_BYTES,
             block_queue=getattr(cfg, "jax_ingest_block_queue", 4),
             batch_queue=getattr(cfg, "jax_ingest_batch_queue", 4),
-            flightrec=self.flightrec)
+            flightrec=self.flightrec, spans=self.spans)
         self._pipeline = pipe
         return pipe
 
@@ -465,6 +472,8 @@ class StreamRunner:
 
             room = target - pending_n
             full_read = False
+            spans = self.spans
+            t0_ns = time.perf_counter_ns() if spans is not None else 0
             if room <= 0:
                 got = 0
             elif block_mode:
@@ -485,6 +494,12 @@ class StreamRunner:
                 full_read = got >= room
                 if got:
                     pending.extend(lines)
+            if spans is not None and got:
+                # non-empty journal reads only: empty polls at the 1 ms
+                # yield cadence would flood the bounded ring
+                spans.add("journal_read", t0_ns,
+                          time.perf_counter_ns() - t0_ns, cat="ingest",
+                          args={"records": got})
             if got:
                 last_data = now
                 if pending_since is None:
@@ -568,17 +583,27 @@ class StreamRunner:
         block_mode = (getattr(self.engine, "supports_block_ingest", False)
                       and hasattr(self.reader, "poll_block"))
         block_bytes = chunk * self.EST_EVENT_BYTES
+        spans = self.spans
         while not self._stop:
             before = self.engine.events_processed
+            t0_ns = time.perf_counter_ns() if spans is not None else 0
             if block_mode:
                 data = self.reader.poll_block(block_bytes)
                 if not data:
                     break
+                if spans is not None:
+                    spans.add("journal_read", t0_ns,
+                              time.perf_counter_ns() - t0_ns,
+                              cat="ingest")
                 self.engine.process_block(data)
             else:
                 lines = self.reader.poll(max_records=chunk)
                 if not lines:
                     break
+                if spans is not None:
+                    spans.add("journal_read", t0_ns,
+                              time.perf_counter_ns() - t0_ns,
+                              cat="ingest", args={"records": len(lines)})
                 self.engine.process_chunk(lines)
             st.events += self.engine.events_processed - before
             st.batches += 1

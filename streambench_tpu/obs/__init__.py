@@ -16,7 +16,21 @@ recorder).  Four pieces, all stdlib, all default-off:
   (``jax.obs.lifecycle``; ``python -m streambench_tpu.obs attribution``)
 - ``flightrec`` — bounded crash flight recorder dumping
   ``flight_<reason>.jsonl`` on crash/give_up/SIGTERM
-  (``jax.obs.flightrec.enabled``)
+  (``jax.obs.flightrec.enabled``); dumps embed the last closed spans
+  when span tracing is on
+- ``spans``     — bounded thread-aware span tracer exporting Chrome
+  trace-event JSON (``jax.obs.spans``; ``trace_<run>.json`` loads in
+  perfetto; ``python -m streambench_tpu.obs trace`` validates)
+- ``occupancy`` — MEASURED device occupancy: sampled
+  ``block_until_ready``-timed dispatches -> ``device_busy_ratio`` +
+  per-dispatch device-time histogram + the ``streambench_compiles_*``
+  recompile detector (``jax.obs.occupancy``)
+- ``slo``       — config-driven objectives (``jax.slo.p99.ms``,
+  ``jax.slo.rate.evps``) with multi-window burn-rate breach gates and
+  a pass/fail verdict in the RunStats close line
+- ``regress``   — tolerance-driven A/B comparator over bench artifacts
+  or metrics journals (``python -m streambench_tpu.obs regress``, the
+  CI regression gate)
 
 Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
 > 0 and/or ``jax.metrics.port`` >= 0); embed via::
@@ -34,6 +48,10 @@ Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
 from streambench_tpu.obs.flightrec import FlightRecorder  # noqa: F401
 from streambench_tpu.obs.httpd import MetricsServer  # noqa: F401
 from streambench_tpu.obs.lifecycle import WindowLifecycle  # noqa: F401
+from streambench_tpu.obs.occupancy import (  # noqa: F401
+    CompileWatcher,
+    OccupancySampler,
+)
 from streambench_tpu.obs.registry import (  # noqa: F401
     Counter,
     Gauge,
@@ -46,3 +64,5 @@ from streambench_tpu.obs.sampler import (  # noqa: F401
     rss_bytes,
     rss_sample,
 )
+from streambench_tpu.obs.slo import SloTracker  # noqa: F401
+from streambench_tpu.obs.spans import SpanTracer  # noqa: F401

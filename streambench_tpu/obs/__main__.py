@@ -3,14 +3,21 @@
     python -m streambench_tpu.obs report RUN/metrics.jsonl
     python -m streambench_tpu.obs diff  A/metrics.jsonl B/metrics.jsonl
     python -m streambench_tpu.obs attribution RUN/metrics.jsonl [B/metrics.jsonl]
+    python -m streambench_tpu.obs trace RUN/trace_1234.json
+    python -m streambench_tpu.obs regress BASELINE.json CANDIDATE.json
 
 ``report`` renders one run's time series as a summary (throughput,
 live-latency percentiles, backlog/watermark/RSS maxima, fault counters,
 stage totals, annotations); ``diff`` lines two runs up with absolute and
 relative deltas; ``attribution`` renders the per-window latency
 attribution (obs.lifecycle: ingest/encode/fold/flush/sink segment
-percentiles and shares), diffing A/B when a second path is given.
+percentiles and shares), diffing A/B when a second path is given;
+``trace`` validates a Chrome trace-event file (obs.spans) and prints a
+per-span-name summary; ``regress`` compares two bench artifacts or
+metrics journals under per-metric tolerances and exits non-zero on a
+regression (the CI gate — ``--advisory`` reports without gating).
 ``--json`` emits the summary dict(s) instead, for harness consumption.
+Rotated journals (``metrics.jsonl.1``) are stitched in automatically.
 """
 
 from __future__ import annotations
@@ -50,12 +57,65 @@ def build_parser() -> argparse.ArgumentParser:
     att.add_argument("path_b", nargs="?", default=None)
     att.add_argument("--json", action="store_true",
                      help="emit the attribution dict(s) instead of text")
+    trc = sub.add_parser(
+        "trace", help="validate + summarize a Chrome trace-event file "
+                      "(obs.spans trace_<run>.json)")
+    trc.add_argument("path")
+    trc.add_argument("--json", action="store_true",
+                     help="emit the summary dict instead of text")
+    reg = sub.add_parser(
+        "regress",
+        help="compare candidate B against baseline A under per-metric "
+             "tolerances; exit 1 on regression (CI gate)")
+    reg.add_argument("path_a", help="baseline artifact or metrics.jsonl")
+    reg.add_argument("path_b", help="candidate artifact or metrics.jsonl")
+    reg.add_argument("--tol", action="append", default=[],
+                     metavar="METRIC=FRAC",
+                     help="override one metric's relative tolerance "
+                          "(e.g. --tol catchup_events_per_s=0.3)")
+    reg.add_argument("--advisory", action="store_true",
+                     help="report regressions but always exit 0")
+    reg.add_argument("--strict-missing", action="store_true",
+                     help="count metrics missing from B as regressions")
+    reg.add_argument("--json", action="store_true",
+                     help="emit the comparison dict instead of text")
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     try:
+        if args.cmd == "regress":
+            from streambench_tpu.obs.regress import run_cli
+
+            return run_cli(args.path_a, args.path_b, tol_args=args.tol,
+                           as_json=args.json, advisory=args.advisory,
+                           strict_missing=args.strict_missing)
+        if args.cmd == "trace":
+            from streambench_tpu.obs.spans import (
+                render_trace_summary,
+                summarize_trace,
+                validate_chrome_trace,
+            )
+
+            with open(args.path, "r", encoding="utf-8") as f:
+                try:
+                    doc = json.load(f)
+                except json.JSONDecodeError as e:
+                    print(f"error: {args.path}: not JSON: {e}",
+                          file=sys.stderr)
+                    return 2
+            problems = validate_chrome_trace(doc)
+            if problems:
+                print(f"error: {args.path}: not a loadable Chrome "
+                      "trace:", file=sys.stderr)
+                for pr in problems:
+                    print(f"  {pr}", file=sys.stderr)
+                return 2
+            s = summarize_trace(doc, path=args.path)
+            print(json.dumps(s) if args.json
+                  else render_trace_summary(s))
+            return 0
         if args.cmd == "report":
             s = summarize(load_records(args.path), path=args.path)
             print(json.dumps(s) if args.json else render_report(s))

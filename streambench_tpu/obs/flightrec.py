@@ -40,6 +40,10 @@ class FlightRecorder:
     pipeline / supervisor records read back in true order.
     """
 
+    #: Spans embedded per dump when a span source is wired (bounded so
+    #: the black box stays a black box, not a full trace file).
+    SPAN_TAIL = 64
+
     def __init__(self, workdir: str, capacity: int = 512):
         self.workdir = workdir
         self.capacity = max(int(capacity), 8)
@@ -47,6 +51,13 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0
         self.dumps: list[str] = []   # paths written, in order
+        # Optional ``tail(n) -> list[dict]`` of recently closed spans
+        # (obs.spans.SpanTracer.tail): when set, every dump embeds a
+        # ``spans`` record just before the terminal one, so the crash
+        # postmortem carries the timing context of the final seconds —
+        # what the engine was actually DOING when it died, not only
+        # what its counters said.
+        self.span_source = None
 
     # ------------------------------------------------------------------
     def _stamp(self, kind: str, fields: dict) -> dict:
@@ -80,12 +91,25 @@ class FlightRecorder:
         tmp + rename, so a half-written dump is never mistaken for a
         complete one.
         """
+        spans = None
+        if self.span_source is not None:
+            try:
+                spans = list(self.span_source(self.SPAN_TAIL))
+            except Exception:
+                spans = None   # a broken tracer must not eat the dump
         with self._lock:
+            records = list(self._buf)
+            if spans is not None:
+                # dump-only record (never enters the ring: a later dump
+                # for a different reason gets ITS OWN fresh span tail,
+                # and the bounded ring keeps its capacity for feeders)
+                records.append(self._stamp("spans", {"spans": spans}))
             if terminal is not None:
                 t = dict(terminal)
                 kind = t.pop("kind", "fault")
-                self._buf.append(self._stamp(kind, t))
-            records = list(self._buf)
+                term = self._stamp(kind, t)
+                self._buf.append(term)
+                records.append(term)
         safe = re.sub(r"[^A-Za-z0-9_.-]+", "_", str(reason)) or "unknown"
         os.makedirs(self.workdir, exist_ok=True)
         path = os.path.join(self.workdir, f"flight_{safe}.jsonl")

@@ -1,0 +1,245 @@
+"""Measured device occupancy + recompile detection.
+
+The README's "~5% device occupancy" was an *estimate* (pipelined chunk
+time minus host encode time); the async hot path never observes device
+completion, so nothing on the default path can measure how busy the
+chip actually is.  This module measures it the only way an async
+dispatch stream allows — by *sampling*: one dispatch in ``sample_every``
+is timed to ``jax.block_until_ready`` completion; every other dispatch
+stays fully async, so the hot path keeps its pipelining (at the default
+1/32 the sync cost is amortized to noise).  The accumulated sampled
+busy time, extrapolated by the sampling factor and divided by wall
+time, is ``device_busy_ratio`` — a measured figure that replaces the
+estimate, with its bias stated rather than hidden: a sampled wait
+covers the device finishing everything enqueued up to that dispatch,
+so each sample is an upper bound on that dispatch alone, and the ratio
+reads as "fraction of wall time the device had work in flight."
+
+Per-dispatch sampled device times also land in a
+``streambench_device_dispatch_ms`` histogram (tail visibility: one slow
+dispatch under a backed-up transfer queue is a different disease than a
+uniformly slow kernel).
+
+The recompile detector rides ``jax.monitoring``: every XLA backend
+compile fires ``/jax/core/compile/backend_compile_duration``, which the
+:class:`CompileWatcher` counts into ``streambench_compiles_total``.
+``mark_steady()`` (call it after ``engine.warmup()``) starts the
+``streambench_compiles_steady_total`` counter — the PR 7 gotcha
+("``fn.lower().compile()`` does not share the jit call cache; the
+collective report costs an extra compile") becomes an asserted
+invariant: a warmed steady-state run must show ZERO steady compiles,
+and the engine CLI/bench surface any violation instead of silently
+stalling for seconds mid-run.
+
+Default-off like the rest of obs/: the engine carries a ``None``
+attribute and one None check per dispatch until ``attach_obs(...,
+occupancy=OccupancySampler(...))``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# jax.monitoring listeners cannot be unregistered individually (only a
+# global clear exists), so ONE module-level listener dispatches to the
+# live watchers — watchers come and go (tests, bench reps) without
+# stacking listeners.
+_watchers: "set[CompileWatcher]" = set()
+_listener_registered = False
+_listener_lock = threading.Lock()
+
+
+def _dispatch_compile_event(event: str, duration_secs: float,
+                            **_kw) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    for w in list(_watchers):
+        w._on_compile(duration_secs)
+
+
+def _ensure_listener() -> bool:
+    """Register the module listener once.  False when jax.monitoring is
+    unavailable (compile counting then reports ``supported: False``
+    instead of silently showing zero)."""
+    global _listener_registered
+    with _listener_lock:
+        if _listener_registered:
+            return True
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _dispatch_compile_event)
+        except Exception:
+            return False
+        _listener_registered = True
+        return True
+
+
+class CompileWatcher:
+    """Counts XLA backend compiles; ``mark_steady`` starts the
+    steady-state counter whose invariant value is zero."""
+
+    def __init__(self, registry=None):
+        self.supported = _ensure_listener()
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.steady_compiles = 0
+        self._steady = False
+        self._lock = threading.Lock()
+        self._c_total = self._c_steady = None
+        if registry is not None:
+            self._c_total = registry.counter(
+                "streambench_compiles_total",
+                "XLA backend compiles observed in this process")
+            self._c_steady = registry.counter(
+                "streambench_compiles_steady_total",
+                "backend compiles AFTER mark_steady (warmup) — the "
+                "steady-state invariant value is zero")
+        if self.supported:
+            _watchers.add(self)
+
+    def _on_compile(self, duration_secs: float) -> None:
+        with self._lock:
+            self.compiles += 1
+            self.compile_s += duration_secs
+            if self._steady:
+                self.steady_compiles += 1
+        if self._c_total is not None:
+            self._c_total.inc()
+            if self._steady:
+                self._c_steady.inc()
+
+    def mark_steady(self) -> None:
+        """Everything is compiled now (post-warmup); any compile from
+        here on is a mid-run stall worth flagging."""
+        with self._lock:
+            self._steady = True
+
+    def assert_steady_zero(self) -> None:
+        """Raise if a compile landed after ``mark_steady`` — the
+        executable form of the steady-state-zero invariant."""
+        with self._lock:
+            n = self.steady_compiles
+        if n:
+            raise AssertionError(
+                f"{n} XLA compile(s) landed after warmup — a program "
+                "shape escaped warmup or something called "
+                "lower().compile() on the hot path")
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"supported": self.supported,
+                    "compiles_total": self.compiles,
+                    "compile_s": round(self.compile_s, 3),
+                    "compiles_steady": self.steady_compiles}
+
+    def close(self) -> None:
+        _watchers.discard(self)
+
+
+class OccupancySampler:
+    """Sampled ``block_until_ready``-timed dispatches -> busy ratio.
+
+    The engine calls ``note_dispatch(state)`` after every device
+    dispatch (one None check + one counter increment off-sample); one
+    dispatch in ``sample_every`` blocks on ``state`` and times the
+    wait.  ``sample_every=1`` times every dispatch (bench probes);
+    the default 32 keeps the hot path effectively async.
+    """
+
+    def __init__(self, registry=None, sample_every: int = 32,
+                 watch_compiles: bool = True):
+        self.sample_every = max(int(sample_every), 1)
+        self.dispatches = 0
+        self.sampled = 0
+        self.busy_ns = 0
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._hist = self._g_ratio = None
+        self._c_disp = self._c_sampled = None
+        if registry is not None:
+            self._hist = registry.histogram(
+                "streambench_device_dispatch_ms",
+                "sampled dispatch-to-completion device time (ms)",
+                lo=0.001, hi=1e5)
+            self._g_ratio = registry.gauge(
+                "streambench_device_busy_ratio",
+                "measured device-busy / wall-time ratio (sampled "
+                "block_until_ready extrapolated by the sampling factor)")
+            self._c_disp = registry.counter(
+                "streambench_device_dispatches_total",
+                "device dispatches seen by the occupancy sampler")
+            self._c_sampled = registry.counter(
+                "streambench_device_sampled_dispatches_total",
+                "dispatches timed to completion (1/N sampling)")
+        self.compile_watcher = (CompileWatcher(registry)
+                                if watch_compiles else None)
+
+    # ------------------------------------------------------------------
+    def note_dispatch(self, state) -> None:
+        """One device dispatch just happened; sample 1-in-N to
+        completion.  Host-loop thread only (the counter is unlocked by
+        design — the single-writer rule the ingest counters also use)."""
+        self.dispatches += 1
+        if self._c_disp is not None:
+            self._c_disp.set_total(self.dispatches)
+        if self.dispatches % self.sample_every:
+            return
+        import jax
+
+        t0 = time.perf_counter_ns()
+        jax.block_until_ready(state)
+        dt = time.perf_counter_ns() - t0
+        with self._lock:
+            self.sampled += 1
+            self.busy_ns += dt
+        if self._hist is not None:
+            self._hist.observe(dt / 1e6)
+            self._c_sampled.set_total(self.sampled)
+            self._g_ratio.set(self.busy_ratio())
+
+    def mark_steady(self) -> None:
+        if self.compile_watcher is not None:
+            self.compile_watcher.mark_steady()
+
+    # ------------------------------------------------------------------
+    def wall_ms(self) -> float:
+        return (time.monotonic() - self._t0) * 1e3
+
+    def busy_ratio(self) -> float:
+        """Extrapolated device-busy / wall ratio (0.0 before the first
+        sample)."""
+        wall = self.wall_ms()
+        if wall <= 0:
+            return 0.0
+        with self._lock:
+            busy_ms = self.busy_ns / 1e6 * self.sample_every
+        return busy_ms / wall
+
+    def summary(self) -> dict:
+        """The ``"occupancy"`` block a metrics.jsonl snapshot / bench
+        artifact carries."""
+        with self._lock:
+            sampled = self.sampled
+            busy_ms = round(self.busy_ns / 1e6, 3)
+        out = {
+            "dispatches": self.dispatches,
+            "sampled": sampled,
+            "sample_every": self.sample_every,
+            "device_busy_ms_sampled": busy_ms,
+            "wall_ms": round(self.wall_ms(), 1),
+            "device_busy_ratio": round(self.busy_ratio(), 4),
+        }
+        if self._hist is not None and self._hist.count:
+            out["dispatch_ms"] = self._hist.summary()
+        if self.compile_watcher is not None:
+            out["compiles"] = self.compile_watcher.summary()
+        return out
+
+    def close(self) -> None:
+        if self.compile_watcher is not None:
+            self.compile_watcher.close()

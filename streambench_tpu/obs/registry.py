@@ -168,6 +168,16 @@ class StreamingHistogram:
         with self._lock:
             return self._count
 
+    def count_le(self, x: float) -> int:
+        """Samples at or below ``x``, at bucket resolution: the bucket
+        containing ``x`` is counted whole (its upper bound is the first
+        one >= x), so the answer can over-include by at most one
+        bucket's worth — the same one-bucket error contract as the
+        quantiles.  The SLO burn-rate tracker's good/bad split."""
+        i = self._index(x)
+        with self._lock:
+            return sum(self._counts[:i + 1])
+
     def _upper(self, i: int) -> float:
         if i == 0:
             return self._lo
@@ -214,6 +224,19 @@ class StreamingHistogram:
                     "p50": p50, "p95": p95, "p99": p99}
 
     def render(self) -> list[str]:
+        """Real Prometheus histogram exposition: cumulative ``_bucket``
+        lines + ``_sum``/``_count``.  Bucket lines are emitted sparsely
+        — every OCCUPIED bucket, the immediate lower neighbor of each
+        occupied bucket (the lower edge of every occupied range stays
+        on record, so quantile interpolation keeps its one-bucket
+        resolution), plus the first and the ``+Inf`` bucket.
+        Semantically identical to full emission (each bucket is its
+        own cumulative series; an omitted bound between two emitted
+        ones whose cumulative equals its lower neighbor's carries no
+        information) but keeps a ~190-bucket segment-histogram family
+        from dominating every scrape with runs of repeated numbers.
+        The quantile summaries the report CLI reads (``summary()``)
+        are unchanged."""
         with self._lock:
             counts = list(self._counts)
             total = self._count
@@ -221,8 +244,12 @@ class StreamingHistogram:
         lines = []
         acc = 0
         base = dict(self.labels)
+        last = len(counts) - 1
         for i, c in enumerate(counts):
             acc += c
+            nxt = counts[i + 1] if i < last else 0
+            if not (i == 0 or i == last or c or nxt):
+                continue
             ub = self._upper(i)
             le = "+Inf" if ub == math.inf else _fmt_value(round(ub, 6))
             lines.append(f"{self.name}_bucket"

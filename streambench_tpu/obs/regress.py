@@ -1,0 +1,262 @@
+"""Tolerance-driven regression comparison of two run artifacts.
+
+Six committed ``BENCH_*.json`` artifacts exist with no machine-checked
+comparison between them — every "did this PR regress the bench?" answer
+has been a human eyeballing numbers.  ``obs regress A B`` makes the
+comparison executable: A is the baseline, B the candidate; each metric
+is judged against a per-metric relative tolerance with a declared
+direction (throughput regresses DOWN, latency/RSS regress UP), and the
+exit code is the CI gate (0 = within tolerance, 1 = regressed,
+2 = unusable input).  ``--advisory`` reports but always exits 0 — the
+right mode on hosts whose run-to-run variance exceeds any honest
+tolerance (this repo's 1-core container shows 2-4x swings; see
+ADVICE.md).
+
+Inputs are auto-detected per file:
+
+- a **bench artifact** (``bench_latency.json`` / committed ``BENCH_*``
+  shape): one JSON object — catchup throughput, sweep, config rows,
+  occupancy;
+- a **metrics journal** (``metrics.jsonl``): line-JSON; summarized via
+  ``obs.report`` (rotated ``.1`` stitched in), compared on its final
+  throughput/latency/RSS numbers.
+
+Both normalize into one flat metric dict, so a bench artifact can even
+be compared against a telemetry journal where their metrics overlap.
+Metrics present in A but missing in B are reported (``missing``) and
+count as regressions only with ``--strict-missing``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: metric -> (direction, default relative tolerance).  direction
+#: "higher" = bigger is better (regression when B < A*(1-tol));
+#: "lower" = smaller is better (regression when B > A*(1+tol)).
+#: Tolerances are deliberately generous: the gate exists to catch
+#: collapses (a 2x loss), not noise (see module docstring).
+DEFAULT_TOLERANCES: dict = {
+    "catchup_events_per_s": ("higher", 0.5),
+    "max_sustained_rate": ("higher", 0.5),
+    "events_per_s_mean": ("higher", 0.5),
+    "events_per_s_max": ("higher", 0.5),
+    "paced_p50_ms": ("lower", 1.0),
+    "paced_p99_ms": ("lower", 1.0),
+    "latency_p50_ms": ("lower", 1.0),
+    "latency_p99_ms": ("lower", 1.0),
+    "device_busy_ratio": ("higher", 0.8),
+    "windows_written": ("higher", 0.5),
+    "rss_bytes_max": ("lower", 1.0),
+}
+
+
+def _first(d: dict, *keys, default=None):
+    for k in keys:
+        v = d.get(k)
+        if v is not None:
+            return v
+    return default
+
+
+def _num(v):
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def normalize_bench(doc: dict, path: str = "") -> dict:
+    """Flatten a bench artifact into the comparable metric dict."""
+    out: dict = {"kind": "bench", "path": path}
+    out["catchup_events_per_s"] = _num(
+        _first(doc, "catchup_events_per_s", "value"))
+    out["max_sustained_rate"] = _num(doc.get("max_sustained_rate"))
+    out["device_busy_ratio"] = _num(
+        (doc.get("occupancy") or {}).get("device_busy_ratio")
+        if isinstance(doc.get("occupancy"), dict)
+        else doc.get("device_busy_ratio"))
+    # the exact-count row's paced run (first sustained sweep rung falls
+    # back to the exact config row's paced block)
+    paced = None
+    for row in doc.get("configs") or []:
+        if row.get("config") == "exact_count":
+            paced = row.get("paced")
+            break
+    if paced is None:
+        sustained = [x for x in (doc.get("rates") or [])
+                     if x.get("sustained")]
+        paced = sustained[-1] if sustained else None
+    if isinstance(paced, dict):
+        out["paced_p50_ms"] = _num(paced.get("p50_ms"))
+        out["paced_p99_ms"] = _num(paced.get("p99_ms"))
+        slo = paced.get("slo")
+        if isinstance(slo, dict):
+            out["slo_pass"] = bool(slo.get("pass"))
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def normalize_metrics(records: list, path: str = "") -> dict:
+    """Flatten a metrics.jsonl record stream (obs.report summary)."""
+    from streambench_tpu.obs.report import summarize
+
+    s = summarize(records, path=path)
+    lat = s.get("latency_ms") or {}
+    out = {
+        "kind": "metrics", "path": path,
+        "events_per_s_mean": _num(s.get("events_per_s_mean")),
+        "events_per_s_max": _num(s.get("events_per_s_max")),
+        "windows_written": _num(s.get("windows_written")),
+        "latency_p50_ms": _num(lat.get("p50")),
+        "latency_p99_ms": _num(lat.get("p99")),
+        "rss_bytes_max": _num(s.get("rss_bytes_max")),
+    }
+    rs = s.get("run_stats")
+    if isinstance(rs, dict):
+        if rs.get("events_per_s") is not None:
+            out["catchup_events_per_s"] = _num(rs["events_per_s"])
+        if rs.get("device_busy_ratio") is not None:
+            out["device_busy_ratio"] = _num(rs["device_busy_ratio"])
+        if isinstance(rs.get("slo"), dict):
+            out["slo_pass"] = bool(rs["slo"].get("pass"))
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def load_artifact(path: str) -> dict:
+    """Load + normalize one input, auto-detecting its shape."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            return normalize_bench(doc, path=path)
+    except json.JSONDecodeError:
+        pass
+    # line-JSON journal: reuse the report loader (stitches .1 rotation)
+    from streambench_tpu.obs.report import load_records
+
+    records = load_records(path)
+    if not records:
+        raise ValueError(f"{path}: neither a JSON artifact nor a "
+                         "metrics.jsonl journal")
+    return normalize_metrics(records, path=path)
+
+
+# ----------------------------------------------------------------------
+def compare(a: dict, b: dict,
+            tolerances: "dict | None" = None,
+            strict_missing: bool = False) -> dict:
+    """Judge candidate ``b`` against baseline ``a``.
+
+    Returns {"rows": [...], "regressions": n, "missing": n,
+    "pass": bool}; each row is {metric, a, b, delta_pct, tol_pct,
+    direction, verdict} with verdict in OK / IMPROVED / REGRESSED /
+    MISSING.  ``slo_pass`` is boolean-compared: True -> False is a
+    regression outright.
+    """
+    tols = dict(DEFAULT_TOLERANCES)
+    for k, v in (tolerances or {}).items():
+        direction = tols.get(k, ("higher", None))[0]
+        tols[k] = (direction, float(v))
+    rows: list[dict] = []
+    regressions = missing = 0
+    keys = [k for k in a if k not in ("kind", "path")]
+    for k in keys:
+        va = a[k]
+        vb = b.get(k)
+        if k == "slo_pass":
+            if vb is None:
+                continue
+            bad = bool(va) and not bool(vb)
+            rows.append({"metric": k, "a": va, "b": vb,
+                         "verdict": "REGRESSED" if bad else "OK"})
+            regressions += bad
+            continue
+        direction, tol = tols.get(k, ("higher", 0.5))
+        if vb is None:
+            missing += 1
+            rows.append({"metric": k, "a": va, "b": None,
+                         "tol_pct": round(tol * 100, 1),
+                         "direction": direction, "verdict": "MISSING"})
+            if strict_missing:
+                regressions += 1
+            continue
+        delta = (vb - va) / va if va else 0.0
+        if direction == "higher":
+            verdict = ("REGRESSED" if delta < -tol
+                       else "IMPROVED" if delta > tol else "OK")
+        else:
+            verdict = ("REGRESSED" if delta > tol
+                       else "IMPROVED" if delta < -tol else "OK")
+        regressions += verdict == "REGRESSED"
+        rows.append({"metric": k, "a": va, "b": vb,
+                     "delta_pct": round(delta * 100, 1),
+                     "tol_pct": round(tol * 100, 1),
+                     "direction": direction, "verdict": verdict})
+    return {"a": a.get("path"), "b": b.get("path"), "rows": rows,
+            "regressions": regressions, "missing": missing,
+            "pass": regressions == 0}
+
+
+def render(result: dict) -> str:
+    lines = ["regression gate:",
+             f"  A (baseline):  {result['a']}",
+             f"  B (candidate): {result['b']}",
+             f"  {'metric':<24} {'A':>14} {'B':>14} {'delta':>9} "
+             f"{'tol':>7}  verdict"]
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, bool):
+            return str(v)
+        return f"{v:,.1f}" if isinstance(v, float) else str(v)
+
+    for r in result["rows"]:
+        delta = (f"{r['delta_pct']:+.1f}%"
+                 if r.get("delta_pct") is not None else "-")
+        tol = (f"{r['tol_pct']:.0f}%"
+               if r.get("tol_pct") is not None else "-")
+        lines.append(f"  {r['metric']:<24} {fmt(r.get('a')):>14} "
+                     f"{fmt(r.get('b')):>14} {delta:>9} {tol:>7}  "
+                     f"{r['verdict']}")
+    lines.append(f"  => {'PASS' if result['pass'] else 'FAIL'} "
+                 f"({result['regressions']} regressed, "
+                 f"{result['missing']} missing)")
+    return "\n".join(lines)
+
+
+def run_cli(path_a: str, path_b: str, tol_args: "list[str] | None" = None,
+            as_json: bool = False, advisory: bool = False,
+            strict_missing: bool = False, out=print) -> int:
+    """The ``obs regress`` entry: load, compare, render, gate."""
+    tols: dict = {}
+    for spec in tol_args or []:
+        if "=" not in spec:
+            out(f"error: --tol expects metric=frac, got {spec!r}")
+            return 2
+        k, _, v = spec.partition("=")
+        try:
+            tols[k.strip()] = float(v)
+        except ValueError:
+            out(f"error: --tol {spec!r}: not a number")
+            return 2
+    try:
+        a = load_artifact(path_a)
+        b = load_artifact(path_b)
+    except (OSError, ValueError) as e:
+        out(f"error: {e}")
+        return 2
+    result = compare(a, b, tolerances=tols,
+                     strict_missing=strict_missing)
+    out(json.dumps(result) if as_json else render(result))
+    if advisory and not result["pass"]:
+        out("advisory mode: regressions reported, exit forced 0")
+        return 0
+    return 0 if result["pass"] else 1
+
+
+def _default_baseline() -> "str | None":
+    """The committed smoke baseline, when running from a checkout."""
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    p = os.path.join(root, "BASELINE_bench_smoke.json")
+    return p if os.path.exists(p) else None

@@ -13,22 +13,35 @@ from __future__ import annotations
 import json
 
 
-def load_records(path: str) -> list[dict]:
+def load_records(path: str, stitch_rotated: bool = True) -> list[dict]:
     """Parse a metrics.jsonl file, skipping torn/blank lines (a killed
     run can leave a partial last record; the series before it is still
-    a valid report)."""
+    a valid report).
+
+    ``jax.metrics.max.bytes`` rotation moves the OLDER half of a long
+    run to ``<path>.1`` — when that file exists its records are
+    stitched in FIRST, so ``report``/``diff`` cover the whole run
+    instead of silently summarizing only the post-rotation tail
+    (events/s means and fault totals were wrong for exactly the long
+    chaos sweeps the rotation exists for)."""
+    import os
+
+    paths = [path]
+    if stitch_rotated and os.path.exists(path + ".1"):
+        paths.insert(0, path + ".1")
     out: list[dict] = []
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(rec, dict):
-                out.append(rec)
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
     return out
 
 

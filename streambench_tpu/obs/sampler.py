@@ -164,6 +164,11 @@ def engine_collector(engine, reader=None, runner=None, registry=None):
         lc = getattr(engine, "_obs_lifecycle", None)
         if lc is not None:
             rec["attribution"] = lc.summary()
+        # measured device occupancy (obs.occupancy): sampled busy ratio
+        # + recompile counters, present only when attached
+        occ = getattr(engine, "_obs_occupancy", None)
+        if occ is not None:
+            rec["occupancy"] = occ.summary()
         rss, rss_label = rss_sample()
         rec[rss_label] = rss
         if reg is not None:

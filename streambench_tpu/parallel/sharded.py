@@ -501,8 +501,10 @@ class ShardedWindowEngine(AdAnalyticsEngine):
 
     # ------------------------------------------------------------------
     # collective-cost accounting (parallel.collectives)
-    def attach_obs(self, registry, lifecycle: bool = False) -> None:
-        super().attach_obs(registry, lifecycle)
+    def attach_obs(self, registry, lifecycle: bool = False,
+                   spans=None, occupancy=None) -> None:
+        super().attach_obs(registry, lifecycle, spans=spans,
+                           occupancy=occupancy)
         self._obs_reg = registry
 
     def collective_report(self, k: int | None = None) -> dict:

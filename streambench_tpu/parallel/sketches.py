@@ -449,8 +449,10 @@ class ShardedHLLEngine(HLLDistinctEngine):
             packed, user_idx, event_time)
         self.state = hll.HLLState(regs, ids, wm, dropped)
 
-    def attach_obs(self, registry, lifecycle: bool = False) -> None:
-        super().attach_obs(registry, lifecycle)
+    def attach_obs(self, registry, lifecycle: bool = False,
+                   spans=None, occupancy=None) -> None:
+        super().attach_obs(registry, lifecycle, spans=spans,
+                           occupancy=occupancy)
         self._obs_reg = registry
 
     def collective_report(self, k: int | None = None) -> dict:

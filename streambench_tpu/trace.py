@@ -54,6 +54,13 @@ class Tracer:
     enabled: bool = True
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
+    # Optional per-span forwarder ``sink(stage, start_ns, dur_ns)``
+    # (obs.spans.SpanTracer wires itself here): None by default, so the
+    # uninstrumented path pays one attribute check per span — the same
+    # price as the lifecycle/flightrec hooks.  Called OUTSIDE the lock,
+    # on the thread that ran the span (span tracing is thread-aware).
+    sink: "object | None" = field(default=None, repr=False,
+                                  compare=False)
 
     def _record(self, stage: str, duration_ns: int) -> None:
         with self._lock:
@@ -73,7 +80,10 @@ class Tracer:
         try:
             yield
         finally:
-            self._record(stage, time.perf_counter_ns() - t0)
+            dur = time.perf_counter_ns() - t0
+            self._record(stage, dur)
+            if self.sink is not None:
+                self.sink(stage, t0, dur)
 
     def add(self, stage: str, duration_ns: int) -> None:
         self._record(stage, duration_ns)

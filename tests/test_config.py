@@ -165,8 +165,8 @@ def test_committed_reference_conf_roundtrip():
     # the whole from_mapping body (it nests geti/gets/getb helper defs,
     # so cut at the next MODULE-LEVEL def)
     body = src.split("def from_mapping", 1)[1].split("\ndef ", 1)[0]
-    honored = set(re.findall(r"""(?:conf\.get|geti|gets|getb)\(\s*['"]"""
-                             r"""([a-z_.]+)['"]""", body))
+    honored = set(re.findall(r"""(?:conf\.get|geti|gets|getb|getf)"""
+                             r"""\(\s*['"]([a-z0-9_.]+)['"]""", body))
     assert honored, "key scan found nothing — regex drifted from config.py"
     documented = open(path, encoding="utf-8").read()
     missing = {k for k in honored if k not in documented}

@@ -596,3 +596,50 @@ def test_cli_metrics_jsonl_and_prometheus_scrape(tmp_path):
     # folds encoder/reader counters on top of the engine's)
     for k, v in final["faults"].items():
         assert stats_line["faults"].get(k) == v, (k, v, stats_line)
+
+
+# ----------------------------------------------------------------------
+# real Prometheus histogram exposition (ISSUE 8 satellite): cumulative
+# _bucket series + _sum/_count, conformant and compact
+def test_histogram_exposition_is_conformant_and_compact():
+    import math
+
+    reg = MetricsRegistry()
+    h = reg.histogram("streambench_window_segment_ms",
+                      "segmented", lo=0.1, hi=1e7,
+                      growth=2 ** 0.125, labels={"segment": "ingest"})
+    for v in (0.5, 0.5, 3.0, 9_000.0, 5e8):   # 5e8 -> overflow bucket
+        h.observe(v)
+    lines = h.render()
+    buckets = [l for l in lines if "_bucket" in l]
+    # sparse: occupied buckets + their lower edges + first + Inf, NOT
+    # one line per geometric bucket (~190 at this growth)
+    assert 4 <= len(buckets) <= 12, buckets
+    # cumulative counts are monotone nondecreasing in bound order
+    def bound(line):
+        le = line.split('le="')[1].split('"')[0]
+        return math.inf if le == "+Inf" else float(le)
+    parsed = [(bound(l), int(l.rsplit(" ", 1)[1])) for l in buckets]
+    assert parsed == sorted(parsed, key=lambda p: p[0])
+    counts = [c for _, c in parsed]
+    assert counts == sorted(counts)
+    # the +Inf bucket equals _count (the exposition-format invariant)
+    assert parsed[-1][0] == math.inf and parsed[-1][1] == 5
+    count_line = next(l for l in lines if "_count" in l)
+    assert count_line.endswith(" 5")
+    sum_line = next(l for l in lines if "_sum" in l)
+    assert float(sum_line.rsplit(" ", 1)[1]) == 500009004.0
+    # labels ride every series of the family
+    assert all('segment="ingest"' in l for l in buckets)
+    # every occupied bucket's LOWER edge is also emitted (quantile
+    # interpolation keeps one-bucket resolution): each jump in the
+    # cumulative series starts from an explicitly emitted bound
+    jumps = [i for i in range(1, len(parsed))
+             if parsed[i][1] > parsed[i - 1][1]]
+    for i in jumps:
+        # the preceding emitted bound is the true geometric neighbor:
+        # its bound * growth ~= this bound (no gap was skipped)
+        lo_b, hi_b = parsed[i - 1][0], parsed[i][0]
+        if math.isinf(hi_b):
+            continue
+        assert hi_b / lo_b == pytest.approx(2 ** 0.125, rel=1e-6)

@@ -1,0 +1,168 @@
+"""Measured device occupancy (ISSUE 8 tentpole, obs.occupancy): 1-in-N
+sampling cadence, busy-ratio extrapolation, the recompile detector's
+steady-state-zero invariant, engine integration (sampling must not
+change a single count), and the sampler journal block."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import (
+    as_redis,
+    read_seen_counts,
+    seed_campaigns,
+)
+from streambench_tpu.obs import (
+    CompileWatcher,
+    MetricsRegistry,
+    OccupancySampler,
+)
+
+
+def test_sampling_cadence_and_counters():
+    reg = MetricsRegistry()
+    occ = OccupancySampler(reg, sample_every=4, watch_compiles=False)
+    x = jnp.ones(8)
+    for _ in range(10):
+        occ.note_dispatch(x)
+    assert occ.dispatches == 10
+    assert occ.sampled == 2          # dispatches 4 and 8
+    s = occ.summary()
+    assert s["sample_every"] == 4
+    assert s["device_busy_ms_sampled"] > 0
+    assert 0.0 <= s["device_busy_ratio"]
+    assert s["dispatch_ms"]["count"] == 2
+    assert reg.counter(
+        "streambench_device_dispatches_total").value == 10
+    assert reg.counter(
+        "streambench_device_sampled_dispatches_total").value == 2
+    assert (reg.gauge("streambench_device_busy_ratio").value
+            == pytest.approx(occ.busy_ratio(), rel=0.5))
+    occ.close()
+
+
+def test_sample_every_one_times_every_dispatch():
+    occ = OccupancySampler(None, sample_every=1, watch_compiles=False)
+    x = jnp.ones(4)
+    for _ in range(3):
+        occ.note_dispatch(x)
+    assert occ.dispatches == 3 and occ.sampled == 3
+    s = occ.summary()
+    assert s["device_busy_ms_sampled"] > 0
+    assert s["device_busy_ratio"] > 0
+    # extrapolation factor 1: the ratio never exceeds busy/wall by more
+    # than clock skew between the two monotonic reads
+    assert (s["device_busy_ms_sampled"]
+            <= occ.busy_ratio() * occ.wall_ms() * 1.5 + 0.01)
+    # no registry: summary still works, just without the histogram
+    assert "dispatch_ms" not in s
+
+
+def test_compile_watcher_steady_state_zero_invariant():
+    reg = MetricsRegistry()
+    w = CompileWatcher(reg)
+    if not w.supported:
+        pytest.skip("jax.monitoring unavailable")
+    # a fresh shape compiles and is counted pre-steady
+    f = jax.jit(lambda v: v + 7)
+    f(jnp.ones(3))
+    pre = w.summary()["compiles_total"]
+    assert pre >= 1
+    w.mark_steady()
+    # cache hit on the SAME jitted callable: NOT a compile
+    f(jnp.ones(3))
+    w.assert_steady_zero()
+    # a new shape after steady: the PR 7 gotcha made executable
+    jax.jit(lambda v: v * 9)(jnp.ones(5))
+    s = w.summary()
+    assert s["compiles_steady"] >= 1
+    with pytest.raises(AssertionError):
+        w.assert_steady_zero()
+    assert reg.counter("streambench_compiles_total").value >= pre + 1
+    assert reg.counter(
+        "streambench_compiles_steady_total").value >= 1
+    w.close()
+    # closed watchers no longer count
+    before = w.summary()["compiles_total"]
+    jax.jit(lambda v: v - 2)(jnp.ones(6))
+    assert w.summary()["compiles_total"] == before
+
+
+def test_engine_sampling_bit_identity_of_counts(tmp_path):
+    """The occupancy sampler only OBSERVES: replaying the SAME journal
+    with sampling on, every window count written to the sink is
+    identical to the unsampled run, event and window totals included."""
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(as_redis(FakeRedisStore()), cfg, broker=broker,
+                 events_num=6000, rng=random.Random(9),
+                 workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+
+    def run(occupancy):
+        r = as_redis(FakeRedisStore())
+        seed_campaigns(r, sorted(set(mapping.values())))
+        engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+        if occupancy is not None:
+            engine.attach_obs(MetricsRegistry(), occupancy=occupancy)
+        runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+        stats = runner.run_catchup()
+        engine.close()
+        return stats, r
+
+    occ = OccupancySampler(MetricsRegistry(), sample_every=2,
+                           watch_compiles=False)
+    stats_on, r_on = run(occ)
+    stats_off, r_off = run(None)
+    assert occ.dispatches > 0 and occ.sampled > 0
+    assert stats_on.events == stats_off.events
+    assert stats_on.windows_written == stats_off.windows_written
+    # canonical-schema equality: every (campaign, window) seen_count
+    counts_on = read_seen_counts(r_on)
+    counts_off = read_seen_counts(r_off)
+    assert counts_on == counts_off
+    assert any(counts_on.values())   # the comparison saw real windows
+    occ.close()
+
+
+def test_collector_journals_occupancy_block(tmp_path):
+    from streambench_tpu.metrics import FaultCounters
+    from streambench_tpu.obs import engine_collector
+    from streambench_tpu.trace import Tracer
+
+    class _Eng:
+        tracer = Tracer()
+        faults = FaultCounters()
+        events_processed = 0
+        _obs_hist = None
+
+        def telemetry(self):
+            return {"events": 0, "windows_written": 0,
+                    "watermark_lag_ms": None, "sink_dirty_rows": 0,
+                    "pending_rows": 0}
+
+    eng = _Eng()
+    reg = MetricsRegistry()
+    occ = OccupancySampler(reg, sample_every=2, watch_compiles=False)
+    occ.note_dispatch(jnp.ones(2))
+    occ.note_dispatch(jnp.ones(2))
+    eng._obs_occupancy = occ
+    rec: dict = {}
+    engine_collector(eng, registry=reg)(rec, 1.0)
+    assert rec["occupancy"]["dispatches"] == 2
+    assert rec["occupancy"]["sampled"] == 1
+    occ.close()
+    # without the sampler the key is absent — old journals unchanged
+    eng2 = _Eng()
+    rec2: dict = {}
+    engine_collector(eng2, registry=MetricsRegistry())(rec2, 1.0)
+    assert "occupancy" not in rec2

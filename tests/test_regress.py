@@ -1,0 +1,182 @@
+"""Regression comparator (ISSUE 8 tentpole, obs.regress): artifact and
+metrics.jsonl normalization, tolerance/direction verdicts, the CLI's
+exit codes (the CI gate contract), and rotated-journal stitching."""
+
+import json
+
+import pytest
+
+from streambench_tpu.obs.regress import (
+    compare,
+    load_artifact,
+    normalize_bench,
+)
+
+
+def _bench_doc(evps=2_000_000.0, p99=13_000.0, busy=0.05,
+               slo_pass=True):
+    return {
+        "platform": "cpu",
+        "catchup_events_per_s": evps,
+        "max_sustained_rate": 100_000,
+        "occupancy": {"device_busy_ratio": busy},
+        "configs": [{"config": "exact_count",
+                     "paced": {"p50_ms": 11_000.0, "p99_ms": p99,
+                               "slo": {"pass": slo_pass}}}],
+    }
+
+
+def test_normalize_bench_extracts_comparables():
+    n = normalize_bench(_bench_doc(), path="x.json")
+    assert n["catchup_events_per_s"] == 2_000_000.0
+    assert n["max_sustained_rate"] == 100_000
+    assert n["device_busy_ratio"] == 0.05
+    assert n["paced_p99_ms"] == 13_000.0
+    assert n["slo_pass"] is True
+
+
+def test_compare_directions_and_tolerances():
+    a = normalize_bench(_bench_doc())
+    # within every (generous) default tolerance
+    ok = compare(a, normalize_bench(_bench_doc(evps=1_800_000.0,
+                                               p99=14_000.0)))
+    assert ok["pass"] and ok["regressions"] == 0
+    # throughput collapse: higher-is-better direction
+    worse = compare(a, normalize_bench(_bench_doc(evps=500_000.0)))
+    assert not worse["pass"]
+    row = next(r for r in worse["rows"]
+               if r["metric"] == "catchup_events_per_s")
+    assert row["verdict"] == "REGRESSED" and row["delta_pct"] == -75.0
+    # latency blowout: lower-is-better direction
+    slow = compare(a, normalize_bench(_bench_doc(p99=40_000.0)))
+    assert not slow["pass"]
+    assert any(r["metric"] == "paced_p99_ms"
+               and r["verdict"] == "REGRESSED" for r in slow["rows"])
+    # big improvement is labeled, not failed
+    fast = compare(a, normalize_bench(_bench_doc(evps=9_000_000.0)))
+    assert fast["pass"]
+    assert any(r["verdict"] == "IMPROVED" for r in fast["rows"])
+    # slo flip True -> False is a regression outright
+    flipped = compare(a, normalize_bench(_bench_doc(slo_pass=False)))
+    assert not flipped["pass"]
+    # per-metric tolerance override loosens the gate
+    loose = compare(a, normalize_bench(_bench_doc(evps=500_000.0)),
+                    tolerances={"catchup_events_per_s": 0.9})
+    assert loose["pass"]
+
+
+def test_missing_metrics_reported_and_optionally_gated():
+    a = normalize_bench(_bench_doc())
+    b = {"kind": "bench", "path": "b",
+         "catchup_events_per_s": 2_000_000.0}
+    r = compare(a, b)
+    assert r["missing"] > 0 and r["pass"]
+    r2 = compare(a, b, strict_missing=True)
+    assert not r2["pass"]
+
+
+def test_load_artifact_detects_metrics_jsonl(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "snapshot", "seq": 0, "ts_ms": 1,
+                            "uptime_ms": 1000, "events": 1000,
+                            "events_per_s": 1000.0,
+                            "windows_written": 3}) + "\n")
+        f.write(json.dumps({"kind": "final", "seq": 1, "ts_ms": 2,
+                            "uptime_ms": 2000, "events": 5000,
+                            "events_per_s": 4000.0,
+                            "windows_written": 9,
+                            "latency_ms": {"count": 9, "p50": 11_000,
+                                           "p95": 12_000,
+                                           "p99": 12_500},
+                            "run_stats": {
+                                "events_per_s": 2500.0,
+                                "device_busy_ratio": 0.04,
+                                "slo": {"pass": True}}}) + "\n")
+    n = load_artifact(str(p))
+    assert n["kind"] == "metrics"
+    assert n["events_per_s_max"] == 4000.0
+    assert n["latency_p99_ms"] == 12_500
+    assert n["windows_written"] == 9
+    assert n["catchup_events_per_s"] == 2500.0
+    assert n["device_busy_ratio"] == 0.04
+    assert n["slo_pass"] is True
+
+
+def test_load_artifact_stitches_rotated_journal(tmp_path):
+    """The rotation satellite: metrics.jsonl.1 (the OLDER half) is
+    stitched in ahead of metrics.jsonl, so summaries cover the whole
+    run, not the post-rotation tail."""
+    old = tmp_path / "metrics.jsonl.1"
+    new = tmp_path / "metrics.jsonl"
+    with open(old, "w") as f:
+        for seq in range(5):
+            f.write(json.dumps({"kind": "snapshot", "seq": seq,
+                                "ts_ms": seq, "uptime_ms": seq * 1000,
+                                "events": seq * 100,
+                                "events_per_s": 9000.0}) + "\n")
+    with open(new, "w") as f:
+        f.write(json.dumps({"kind": "final", "seq": 5, "ts_ms": 5,
+                            "uptime_ms": 5000, "events": 500,
+                            "events_per_s": 10.0,
+                            "windows_written": 1}) + "\n")
+    from streambench_tpu.obs.report import load_records, summarize
+
+    recs = load_records(str(new))
+    assert len(recs) == 6            # both halves, oldest first
+    assert recs[0]["seq"] == 0 and recs[-1]["kind"] == "final"
+    s = summarize(recs, path=str(new))
+    # the pre-rotation rates are part of the summary again
+    assert s["events_per_s_max"] == 9000.0
+    # stitching is opt-out for callers that want one file only
+    assert len(load_records(str(new), stitch_rotated=False)) == 1
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    from streambench_tpu.obs.__main__ import main as obs_main
+
+    a = tmp_path / "a.json"
+    b_ok = tmp_path / "b_ok.json"
+    b_bad = tmp_path / "b_bad.json"
+    a.write_text(json.dumps(_bench_doc()))
+    b_ok.write_text(json.dumps(_bench_doc(evps=1_900_000.0)))
+    b_bad.write_text(json.dumps(_bench_doc(evps=100_000.0)))
+    assert obs_main(["regress", str(a), str(b_ok)]) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out
+    assert obs_main(["regress", str(a), str(b_bad)]) == 1
+    assert "FAIL" in capsys.readouterr().out
+    # advisory mode reports but never gates
+    assert obs_main(["regress", str(a), str(b_bad),
+                     "--advisory"]) == 0
+    capsys.readouterr()
+    # --json emits the machine-readable comparison
+    assert obs_main(["regress", str(a), str(b_ok), "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["pass"] is True and parsed["rows"]
+    # tolerance override via CLI
+    assert obs_main(["regress", str(a), str(b_bad),
+                     "--tol", "catchup_events_per_s=0.99"]) == 0
+    capsys.readouterr()
+    # malformed tolerance / unusable input -> exit 2
+    assert obs_main(["regress", str(a), str(b_ok),
+                     "--tol", "nonsense"]) == 2
+    capsys.readouterr()
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert obs_main(["regress", str(a), str(empty)]) == 2
+    capsys.readouterr()
+
+
+def test_committed_baseline_loads_when_present():
+    """The committed CI baseline must stay parseable by the gate."""
+    import os
+
+    from streambench_tpu.obs.regress import _default_baseline
+
+    p = _default_baseline()
+    if p is None:
+        pytest.skip("no committed baseline in this checkout")
+    n = load_artifact(p)
+    assert n.get("catchup_events_per_s"), n
+    assert os.path.basename(p) == "BASELINE_bench_smoke.json"

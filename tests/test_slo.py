@@ -1,0 +1,154 @@
+"""SLO burn-rate gates (ISSUE 8 tentpole, obs.slo): count_le bucket
+arithmetic, fast/slow window burn rates, breach/recovery transitions
+journaled + gauged, the rate objective, and the verdict block."""
+
+import pytest
+
+from streambench_tpu.obs import MetricsRegistry, SloTracker
+from streambench_tpu.obs.flightrec import FlightRecorder
+from streambench_tpu.obs.registry import StreamingHistogram
+
+
+def test_histogram_count_le_bucket_resolution():
+    h = StreamingHistogram("h", lo=1, hi=1000, growth=2.0)
+    # buckets: <=1, (1,2], (2,4], ... (512,1024], overflow
+    for v in (0.5, 1.0, 3.0, 100.0, 5000.0):
+        h.observe(v)
+    assert h.count_le(1) == 2
+    assert h.count_le(4) == 3
+    assert h.count_le(1e9) == 5      # everything, overflow included
+    # bucket resolution: x inside a bucket counts the whole bucket
+    assert h.count_le(2.5) == 3      # the (2,4] bucket is included
+    assert h.count == 5
+
+
+def _tracker(p99=100, rate=0, budget=0.1, fast=5, slow=20,
+             flightrec=None, annotate=None):
+    clock = {"t": 0.0}
+    reg = MetricsRegistry()
+    slo = SloTracker(reg, p99_ms=p99, rate_evps=rate, budget=budget,
+                     fast_s=fast, slow_s=slow, annotate=annotate,
+                     flightrec=flightrec, clock=lambda: clock["t"])
+    hist = reg.histogram(
+        "streambench_window_latency_ms",
+        "window writeback latency (time_updated - window_ts), ms")
+    return reg, slo, hist, clock
+
+
+def test_latency_burn_rates_fast_vs_slow_windows():
+    reg, slo, hist, clock = _tracker(budget=0.1, fast=5, slow=20)
+    # 20 good ticks, then bad ones: fast window saturates first
+    for i in range(20):
+        clock["t"] += 1
+        hist.observe(10)
+        rec: dict = {}
+        slo.collect(rec, 1.0)
+        assert rec["slo"]["burn"]["latency"]["fast"] == 0.0
+    for i in range(4):
+        clock["t"] += 1
+        hist.observe(10_000)         # way over the 100 ms objective
+        rec = {}
+        slo.collect(rec, 1.0)
+    burns = rec["slo"]["burn"]["latency"]
+    # fast window (last 5 s): 4 bad of 5 new windows -> 0.8/0.1 = 8
+    assert burns["fast"] == pytest.approx(8.0, rel=0.01)
+    # slow window (last 20 s): 4 bad of 20 -> 0.2/0.1 = 2
+    assert burns["slow"] == pytest.approx(2.0, rel=0.01)
+    # both over 1.0 -> breach counted once, gauges live
+    assert rec["slo"]["in_breach"] and slo.breaches == 1
+    g = reg.gauge("streambench_slo_burn_rate",
+                  labels={"objective": "latency", "window": "fast"})
+    assert g.value == pytest.approx(8.0, rel=0.01)
+    assert reg.counter("streambench_slo_breaches_total").value == 1
+
+
+def test_breach_transitions_journal_and_flightrec(tmp_path):
+    events = []
+    fr = FlightRecorder(str(tmp_path))
+    reg, slo, hist, clock = _tracker(
+        budget=0.5, fast=3, slow=6, flightrec=fr,
+        annotate=lambda ev, **kw: events.append((ev, kw)))
+    # drive into breach: every window bad
+    for _ in range(8):
+        clock["t"] += 1
+        hist.observe(10_000)
+        slo.collect({}, 1.0)
+    assert slo.breaches == 1
+    assert events and events[0][0] == "slo_breach"
+    assert events[0][1]["bad_windows"] == pytest.approx(
+        events[0][1]["total_windows"], abs=2)
+    kinds = [r["kind"] for r in fr.snapshot()]
+    assert "slo_breach" in kinds
+    # recover: all-good windows flush the fast+slow windows
+    for _ in range(10):
+        clock["t"] += 1
+        for _ in range(30):
+            hist.observe(1)
+        slo.collect({}, 1.0)
+    assert any(ev == "slo_recovered" for ev, _ in events)
+    assert "slo_recovered" in [r["kind"] for r in fr.snapshot()]
+    assert slo.breaches == 1        # transition-counted, not per-tick
+    v = slo.verdict()
+    assert v["pass"] is False        # a breached run can never pass
+    assert v["breaches"] == 1
+
+
+def test_rate_objective_judges_only_flowing_intervals():
+    reg, slo, hist, clock = _tracker(p99=0, rate=1000, budget=0.25,
+                                     fast=4, slow=8)
+    assert slo.active
+    # before any events flow, low rate is NOT bad
+    for _ in range(5):
+        clock["t"] += 1
+        slo.collect({"events": 0, "events_per_s": 0.0}, 1.0)
+    assert slo.breaches == 0
+    ev = 0
+    # healthy flow
+    for _ in range(8):
+        clock["t"] += 1
+        ev += 2000
+        rec = {"events": ev, "events_per_s": 2000.0}
+        slo.collect(rec, 1.0)
+    assert rec["slo"]["burn"]["rate"]["fast"] == 0.0
+    # sustained under-rate while events still trickle
+    for _ in range(8):
+        clock["t"] += 1
+        ev += 10
+        rec = {"events": ev, "events_per_s": 10.0}
+        slo.collect(rec, 1.0)
+    assert rec["slo"]["burn"]["rate"]["fast"] > 1.0
+    assert slo.breaches == 1
+
+
+def test_inactive_tracker_is_inert():
+    reg = MetricsRegistry()
+    slo = SloTracker(reg, p99_ms=0, rate_evps=0)
+    assert not slo.active
+    rec: dict = {}
+    slo.collect(rec, 1.0)
+    assert "slo" not in rec
+    v = slo.verdict()
+    assert v["pass"] is True and v["objectives"] == {}
+
+
+def test_verdict_pass_on_clean_run():
+    reg, slo, hist, clock = _tracker(budget=0.01)
+    for _ in range(50):
+        clock["t"] += 1
+        hist.observe(5)
+        slo.collect({}, 1.0)
+    v = slo.verdict()
+    assert v["pass"] is True
+    assert v["bad_windows"] == 0 and v["total_windows"] == 50
+    assert v["objectives"] == {"p99_ms": 100}
+
+
+def test_uses_lifecycle_e2e_histogram_when_asked():
+    reg = MetricsRegistry()
+    # the lifecycle's geometry — the tracker must share the instrument
+    e2e = reg.histogram(
+        "streambench_window_e2e_ms",
+        "end-to-end latency of attribution-tracked windows (ms)",
+        lo=0.1, hi=1e7, growth=2 ** 0.125)
+    slo = SloTracker(reg, p99_ms=100, use_lifecycle=True)
+    assert slo._hist is e2e

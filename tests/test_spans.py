@@ -1,0 +1,205 @@
+"""Span tracing (ISSUE 8 tentpole, obs.spans): the bounded ring stays
+bounded with counted evictions, Tracer spans forward thread-aware, the
+Chrome trace export validates against the perfetto-required schema,
+span totals agree with the Tracer's aggregate stage table on a real
+run, the flight recorder embeds the span tail, and the ``obs trace``
+CLI validates/summarizes."""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.obs import MetricsRegistry, SpanTracer
+from streambench_tpu.obs.spans import (
+    summarize_trace,
+    validate_chrome_trace,
+)
+from streambench_tpu.trace import Tracer
+
+
+def test_ring_bounded_and_evictions_counted():
+    sp = SpanTracer(capacity=16)
+    for i in range(40):
+        sp.add(f"s{i}", i * 1000, 500)
+    assert len(sp) == 16
+    assert sp.dropped == 24
+    # oldest evicted, newest kept
+    names = [s["name"] for s in sp.snapshot()]
+    assert names[0] == "s24" and names[-1] == "s39"
+    assert [s["name"] for s in sp.tail(3)] == ["s37", "s38", "s39"]
+
+
+def test_tracer_sink_forwards_with_thread_identity():
+    sp = SpanTracer(capacity=64)
+    tr = Tracer()
+    sp.attach(tr)
+    with tr.span("encode"):
+        pass
+
+    def other():
+        with tr.span("redis_flush"):
+            pass
+
+    t = threading.Thread(target=other, name="fake-writer")
+    t.start()
+    t.join()
+    spans = sp.snapshot()
+    assert [s["name"] for s in spans] == ["encode", "redis_flush"]
+    assert spans[0]["cat"] == "stage"
+    assert spans[1]["thread"] == "fake-writer"
+    assert spans[0]["tid"] != spans[1]["tid"]
+    # the aggregate table recorded the same spans (sink is additive)
+    snap = tr.snapshot()
+    assert snap["encode"][0] == 1 and snap["redis_flush"][0] == 1
+    # an unattached tracer stays sink-less (the default-off contract)
+    assert Tracer().sink is None
+
+
+def test_chrome_trace_schema_and_thread_metadata():
+    sp = SpanTracer(capacity=64)
+    # start stamps are perf_counter_ns values; ts is relative to the
+    # tracer's construction epoch
+    sp.add("encode", sp._t0_ns + 1_000_000, 250_000, cat="stage")
+    sp.add("device_step", sp._t0_ns + 2_000_000, 100_000, cat="stage",
+           args={"batch": 1})
+    doc = sp.chrome_trace(run="unit")
+    assert validate_chrome_trace(doc) == []
+    evs = doc["traceEvents"]
+    ms = [e for e in evs if e["ph"] == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(ms) == 1 and ms[0]["name"] == "thread_name"
+    assert len(xs) == 2
+    # microsecond clock: 250000 ns span -> 250 us dur
+    enc = next(e for e in xs if e["name"] == "encode")
+    assert enc["dur"] == pytest.approx(250.0)
+    assert enc["ts"] == pytest.approx(1000.0)
+    assert doc["otherData"]["run"] == "unit"
+    # every X event's tid has a thread_name metadata row
+    assert {e["tid"] for e in xs} <= {e["tid"] for e in ms}
+
+
+def test_validate_rejects_malformed_docs():
+    assert validate_chrome_trace([]) != []
+    assert validate_chrome_trace({"no": 1}) != []
+    assert validate_chrome_trace(
+        {"traceEvents": [{"name": "x", "ph": "X", "pid": 1,
+                          "tid": 1}]}) != []   # X without ts/dur
+    assert validate_chrome_trace(
+        {"traceEvents": [{"name": "x", "ph": "Q", "pid": 1, "tid": 1,
+                          "ts": 0, "dur": 1}]}) != []  # unknown phase
+
+
+def test_trace_cli_summarizes_and_rejects(tmp_path, capsys):
+    from streambench_tpu.obs.__main__ import main as obs_main
+
+    sp = SpanTracer(capacity=64)
+    with sp.span("encode"):
+        time.sleep(0.002)
+    sp.add("device_step", 0, 1_000_000, cat="stage")
+    path = str(tmp_path / "trace_unit.json")
+    sp.dump(path, run="cli")
+    assert obs_main(["trace", path]) == 0
+    out = capsys.readouterr().out
+    assert "span trace" in out and "encode" in out
+    assert obs_main(["trace", path, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["events"] == 2 and "encode" in parsed["by_name"]
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write('{"traceEvents": "nope"}')
+    assert obs_main(["trace", bad]) == 2
+    notjson = str(tmp_path / "notjson.json")
+    with open(notjson, "w") as f:
+        f.write("}{")
+    assert obs_main(["trace", notjson]) == 2
+
+
+def test_flightrec_dump_embeds_span_tail(tmp_path):
+    from streambench_tpu.obs import FlightRecorder
+
+    sp = SpanTracer(capacity=256)
+    for i in range(100):
+        sp.add(f"s{i}", i * 1000, 10)
+    fr = FlightRecorder(str(tmp_path), capacity=32)
+    fr.record("tick", events=1)
+    fr.span_source = sp.tail
+    path = fr.dump("crash", terminal={"event": "crash", "error": "x"})
+    recs = [json.loads(l) for l in open(path)]
+    # spans block sits just before the terminal record
+    assert recs[-1]["kind"] == "fault"
+    assert recs[-2]["kind"] == "spans"
+    spans = recs[-2]["spans"]
+    assert len(spans) == FlightRecorder.SPAN_TAIL
+    assert spans[-1]["name"] == "s99"
+    # the spans record is dump-only: the ring itself keeps capacity
+    # for feeder records and a second dump gets a FRESH tail
+    sp.add("s100", 1, 1)
+    path2 = fr.dump("crash")
+    recs2 = [json.loads(l) for l in open(path2)]
+    span_recs = [r for r in recs2 if r["kind"] == "spans"]
+    assert len(span_recs) == 1
+    assert span_recs[0]["spans"][-1]["name"] == "s100"
+    # a broken span source must not eat the dump
+    fr.span_source = lambda n: (_ for _ in ()).throw(RuntimeError())
+    path3 = fr.dump("crash")
+    assert [json.loads(l) for l in open(path3)]
+
+
+def test_engine_run_spans_match_tracer_aggregates(tmp_path):
+    """Catchup run with spans attached: the per-stage sum of exported
+    spans equals the Tracer's aggregate table (same clock, same spans
+    — the consistency contract between the timeline and the stage
+    report), and the trace file validates."""
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=6000,
+                 rng=random.Random(5), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+    reg = MetricsRegistry()
+    spans = SpanTracer(capacity=65536, registry=reg)
+    engine.attach_obs(reg, spans=spans)
+    runner = StreamRunner(engine, broker.reader(cfg.kafka_topic),
+                          spans=spans)
+    runner.run_catchup()
+    engine.close()
+    assert spans.dropped == 0   # capacity sized to hold the whole run
+    doc = spans.chrome_trace(run="test")
+    assert validate_chrome_trace(doc) == []
+    s = summarize_trace(doc)
+    # read/encode/dispatch/flush/sink all present on the timeline
+    assert "journal_read" in s["by_name"]
+    assert "encode" in s["by_name"]
+    assert "device_step" in s["by_name"] or "device_scan" in s["by_name"]
+    assert "drain" in s["by_name"]
+    assert "redis_flush" in s["by_name"]
+    # span-sum vs aggregate-segment consistency: for every stage the
+    # Tracer counted, the exported spans carry the same call count and
+    # the same total time (one clock, one recording — only float
+    # rounding of ns -> us apart)
+    for stage, (calls, total_ns, _mx) in engine.tracer.snapshot().items():
+        agg = s["by_name"][stage]
+        assert agg["count"] == calls, stage
+        assert agg["total_ms"] == pytest.approx(total_ns / 1e6,
+                                                rel=1e-3, abs=0.01)
+    # the writer thread's sink spans are on their own thread
+    by_tid = {}
+    for e in doc["traceEvents"]:
+        if e["ph"] == "X":
+            by_tid.setdefault(e["name"], set()).add(e["tid"])
+    assert by_tid["redis_flush"] != by_tid["device_step" if "device_step"
+                                           in by_tid else "device_scan"]
+    # registry counters track the ring
+    assert reg.counter("streambench_spans_total").value == len(spans)
