@@ -173,6 +173,10 @@ class HeadlineEmitter:
             rows.append(row)
         dev = h.get("device") or {}
         sweep = h.get("latency_sweep") or {}
+        xfer = h.get("xfer") or {}
+        bpe = {f: d.get("bytes_per_event")
+               for f, d in (xfer.get("formats") or {}).items()
+               if d.get("bytes_per_event") is not None}
         compact = {
             "compact": True,
             "phase": h.get("phase"),
@@ -192,11 +196,15 @@ class HeadlineEmitter:
                 if k in dev} or None,
             "methods": h.get("methods_compact"),
             "device_decode": h.get("device_decode_ab"),
+            # measured bytes/event per wire format + the col-basis
+            # packed/unpacked ratio (the MULTICHIP packed_col_ratio peer)
+            "bytes_per_event": bpe or None,
+            "packed_unpacked_ratio": xfer.get("packed_unpacked_ratio"),
             "artifact": os.path.basename(self.latency_path),
         }
         line = json.dumps(compact)
-        for drop in ("device_decode", "methods", "device", "configs",
-                     "max_sustained_rate"):
+        for drop in ("bytes_per_event", "device_decode", "methods",
+                     "device", "configs", "max_sustained_rate"):
             if len(line) <= COMPACT_LINE_MAX:
                 break
             compact.pop(drop, None)
@@ -230,6 +238,10 @@ class HeadlineEmitter:
             "occupancy": self.headline.get("occupancy"),
             "span_trace": self.headline.get("span_trace"),
             "trace": self.headline.get("trace"),
+            # data-path obs (ISSUE 9): measured host->device bytes per
+            # wire format + the compiled kernels' memory footprints
+            "xfer": self.headline.get("xfer"),
+            "devmem": self.headline.get("devmem"),
             **(self.headline.get("latency_sweep") or {}),
         }
         try:
@@ -471,6 +483,71 @@ def _measure_device_time(cfg, mapping, broker) -> dict:
         "device_ns_per_event_meas": round(
             device_meas_s * 1e9 / max(group_n, 1), 1),
     }
+
+
+def _xfer_probe(cfg, mapping, broker, max_events: int) -> tuple:
+    """Measured host->device bytes per wire format (obs.xfer) + the
+    device-memory ledger (obs.devmem) — ISSUE 9's data-path numbers.
+
+    Replays a bounded slice of the SAME journal through two fresh
+    engines sharing ONE TransferLedger: the natural arm (packed where
+    eligible) and a forced separate-column arm
+    (``STREAMBENCH_WIRE_FORMAT=unpacked``), so the artifact's
+    ``bytes_per_event`` per format and the packed/unpacked ratio are
+    MEASURED on real dispatches — the static "8 B/ev packed vs 13 B/ev
+    columns" comment made a column.  The packed arm also runs the
+    memory_analysis ledger (out-of-line compiles: probe-only, exactly
+    the PR 7 rule).  Engine output is identical in both arms (the
+    packed path is bit-equal by construction and tested), so no oracle
+    pass is spent here."""
+    from streambench_tpu.engine import AdAnalyticsEngine, StreamRunner
+    from streambench_tpu.io.fakeredis import make_store
+    from streambench_tpu.io.redis_schema import as_redis, seed_campaigns
+    from streambench_tpu.obs import (
+        DeviceMemoryLedger,
+        MetricsRegistry,
+        TransferLedger,
+    )
+
+    ledger = TransferLedger(MetricsRegistry(), sample_every=4)
+    devmem = None
+    for wire in ("packed", "unpacked"):
+        prev = os.environ.pop("STREAMBENCH_WIRE_FORMAT", None)
+        if wire == "unpacked":
+            os.environ["STREAMBENCH_WIRE_FORMAT"] = "unpacked"
+        try:
+            r = as_redis(make_store())
+            seed_campaigns(r, sorted(set(mapping.values())))
+            engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+            engine.attach_obs(MetricsRegistry(), xfer=ledger)
+            runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+            runner.run_catchup(max_events=max_events)
+            if wire == "packed":
+                devmem = DeviceMemoryLedger()
+                devmem.analyze_engine(engine)
+                devmem.refresh_census()
+            engine.close()
+        finally:
+            os.environ.pop("STREAMBENCH_WIRE_FORMAT", None)
+            if prev is not None:
+                os.environ["STREAMBENCH_WIRE_FORMAT"] = prev
+    # third arm, best-effort: the raw-bytes device-decode wire format —
+    # the ~250 B/ev the chip-session experiment (ROADMAP item 2) is
+    # about.  Ineligible configs just skip the arm.
+    try:
+        r = as_redis(make_store())
+        seed_campaigns(r, sorted(set(mapping.values())))
+        engine = AdAnalyticsEngine(
+            dataclasses.replace(cfg, jax_decode_device="on"),
+            mapping, redis=r)
+        if engine._devdecode is not None:
+            engine.attach_obs(MetricsRegistry(), xfer=ledger)
+            runner = StreamRunner(engine, broker.reader(cfg.kafka_topic))
+            runner.run_catchup(max_events=max_events)
+        engine.close()
+    except Exception:
+        pass
+    return ledger.summary(), (devmem.summary() if devmem else None)
 
 
 def _paced_latency_phase(cfg, mapping, broker, r, workdir,
@@ -1659,6 +1736,40 @@ def main() -> int:
                 log(f"device-decode A/B failed (non-fatal): {e!r}")
                 dd_ab = {"error": repr(e)}
         emitter.update(device_decode_ab=dd_ab, phase="device_decode_ab")
+        emitter.emit()
+
+        # Data-path transfer + memory probe (ISSUE 9): measured
+        # bytes/event per wire format on real dispatches + the compiled
+        # kernels' memory_analysis footprints — the columns ROADMAP
+        # items 1-2 gate the chip session on.  Bounded replay, never
+        # fatal, skipped when the envelope is short.
+        xfer_block = devmem_block = None
+        if (os.environ.get("STREAMBENCH_BENCH_XFER", "1") != "0"
+                and time.monotonic() + 150 < bench_deadline):
+            try:
+                xfer_events = int(os.environ.get(
+                    "STREAMBENCH_BENCH_XFER_EVENTS", "200000"))
+                xfer_block, devmem_block = _xfer_probe(
+                    cfg, mapping, broker, xfer_events)
+                fmts = (xfer_block or {}).get("formats") or {}
+                log("xfer probe: " + ", ".join(
+                    f"{f} {d['bytes_per_event']} B/ev"
+                    for f, d in sorted(fmts.items())
+                    if d.get("bytes_per_event") is not None)
+                    + (f"; packed/unpacked ratio "
+                       f"{xfer_block['packed_unpacked_ratio']} "
+                       f"({xfer_block.get('ratio_basis')})"
+                       if xfer_block.get("packed_unpacked_ratio")
+                       is not None else ""))
+                if devmem_block:
+                    log(f"devmem: peak footprint "
+                        f"{devmem_block['peak_footprint_bytes']:,} B "
+                        f"(state {devmem_block['state_bytes']:,} B + "
+                        f"largest kernel)")
+            except Exception as e:
+                log(f"xfer probe failed (non-fatal): {e!r}")
+        emitter.update(xfer=xfer_block, devmem=devmem_block,
+                       phase="xfer_probe")
         emitter.emit()
 
         # Phase 2: the reference's real metric — p99 window-writeback

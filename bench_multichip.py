@@ -75,7 +75,8 @@ def compact_line(obj: dict) -> str:
     obj = json.loads(line)  # deep copy before mutating
     # progressively shed: per-arm by_kind, step arms, per-arm ms, runs'
     # hll block — the scan collective table is the last thing to go
-    for strip in ("by_kind", "step", "ms_per_dispatch", "hll"):
+    for strip in ("by_kind", "device_wait_ms", "step",
+                  "straggler_spread_ms", "ms_per_dispatch", "hll"):
         for run in obj.get("runs", []):
             if strip in ("step", "hll"):
                 run.pop(strip, None)
@@ -253,6 +254,21 @@ def _worker(args) -> int:
         dt = (time.perf_counter() - t0) / max(done, 1)
         arm["ms_per_dispatch"] = round(dt * 1e3, 2)
         arm["ev_s"] = round(K * args.batch / dt)
+        # Per-device dispatch-time spread (ISSUE 9 straggler column):
+        # one more dispatch, then observe each counts shard's readiness
+        # time in device order.  max-min is the straggler evidence a
+        # real mesh needs next to the collective table; on THIS virtual
+        # mesh (thread slices of one core) it mostly measures the
+        # sequential emulation, which the artifact note already states.
+        o = fn(*o, jt, *cols)
+        t0 = time.perf_counter()
+        waits = []
+        for sh in o[0].addressable_shards:
+            jax.block_until_ready(sh.data)
+            waits.append(time.perf_counter() - t0)
+        arm["device_wait_ms"] = [round(w * 1e3, 3) for w in waits]
+        arm["straggler_spread_ms"] = round(
+            (max(waits) - min(waits)) * 1e3, 3) if waits else None
         out["scan"][name] = arm
 
     # headline ratios the artifact cites (collective structure is the

@@ -92,6 +92,17 @@ OBS_OCCUPANCY = os.environ.get("OBS_OCCUPANCY", "") not in (
     "", "0", "false", "no")
 SLO_P99_MS = int(os.environ.get("SLO_P99_MS", "0"))
 SLO_RATE_EVPS = int(os.environ.get("SLO_RATE_EVPS", "0"))
+# Data-path obs (obs layer 4): OBS_XFER=1 measures host->device bytes
+# per wire format, OBS_DEVMEM=1 the compiled-kernel memory footprints +
+# live-array census, OBS_SHARD=1 per-shard skew gauges (with SHARDED=1),
+# OBS_CAPTURE=1 arms triggered profiler capture with a startup one-shot
+# (<workdir>/xprof_<ms>_<reason>/).
+OBS_XFER = os.environ.get("OBS_XFER", "") not in ("", "0", "false", "no")
+OBS_DEVMEM = os.environ.get("OBS_DEVMEM", "") not in (
+    "", "0", "false", "no")
+OBS_SHARD = os.environ.get("OBS_SHARD", "") not in ("", "0", "false", "no")
+OBS_CAPTURE = os.environ.get("OBS_CAPTURE", "") not in (
+    "", "0", "false", "no")
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -269,6 +280,13 @@ def op_setup() -> None:
         "jax.obs.occupancy": OBS_OCCUPANCY,
         "jax.slo.p99.ms": SLO_P99_MS,
         "jax.slo.rate.evps": SLO_RATE_EVPS,
+        "jax.obs.xfer": OBS_XFER,
+        "jax.obs.devmem": OBS_DEVMEM,
+        "jax.obs.shard": OBS_SHARD,
+        "jax.obs.capture.enabled": OBS_CAPTURE,
+        # the env knob means "prove capture works": fire one bounded
+        # window at startup so smoke runs always produce an xprof dir
+        "jax.obs.capture.oneshot": OBS_CAPTURE,
     })
     log(f"wrote {CONF_FILE}")
     try:

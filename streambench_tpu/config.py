@@ -199,6 +199,28 @@ class BenchmarkConfig:
     #   windows/intervals allowed to be bad before the burn rate hits 1
     jax_slo_fast_s: int = 30               # fast burn window (onset)
     jax_slo_slow_s: int = 180              # slow burn window (confirmation)
+    # --- data-path observability (obs/; ISSUE 9 — transfer + device
+    # memory ledgers, shard skew, triggered profiler capture; all
+    # default-off: the hot path stays byte-identical) ---
+    jax_obs_xfer: bool = False             # host->device transfer ledger:
+    #   exact payload bytes per dispatch keyed by wire format (packed/
+    #   unpacked/devdecode) -> streambench_xfer_* + measured bytes/event
+    jax_obs_xfer_sample: int = 32          # the N in 1-in-N timed
+    #   device_put+block_until_ready transfer samples (0 = bytes only)
+    jax_obs_devmem: bool = False           # device-memory ledger: compiled
+    #   kernel memory_analysis footprints (once, post-warmup) + a sampled
+    #   jax.live_arrays census -> "devmem" block + streambench_devmem_*
+    jax_obs_shard: bool = False            # per-shard routed-row/drop skew
+    #   gauges for the sharded engines (streambench_shard_rows{shard=},
+    #   imbalance ratio); needs --sharded
+    jax_obs_capture: bool = False          # bounded TRIGGERED profiler
+    #   capture: SLO breach transition / SIGUSR2 / one-shot fires a short
+    #   jax.profiler window into <workdir>/xprof_<ms>_<reason>/
+    jax_obs_capture_cooldown_s: float = 60.0  # min seconds between captures
+    jax_obs_capture_max: int = 3           # hard cap on captures per run
+    jax_obs_capture_window_s: float = 3.0  # seconds each capture records
+    jax_obs_capture_oneshot: bool = False  # fire one capture at startup
+    #   (smoke tests / "trace the warm ramp" runs)
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -344,6 +366,17 @@ class BenchmarkConfig:
             jax_slo_budget=getf("jax.slo.budget", 0.01),
             jax_slo_fast_s=max(geti("jax.slo.window.fast.s", 30), 1),
             jax_slo_slow_s=max(geti("jax.slo.window.slow.s", 180), 1),
+            jax_obs_xfer=getb("jax.obs.xfer", False),
+            jax_obs_xfer_sample=max(geti("jax.obs.xfer.sample", 32), 0),
+            jax_obs_devmem=getb("jax.obs.devmem", False),
+            jax_obs_shard=getb("jax.obs.shard", False),
+            jax_obs_capture=getb("jax.obs.capture.enabled", False),
+            jax_obs_capture_cooldown_s=max(
+                getf("jax.obs.capture.cooldown.s", 60.0), 0.0),
+            jax_obs_capture_max=max(geti("jax.obs.capture.max", 3), 1),
+            jax_obs_capture_window_s=max(
+                getf("jax.obs.capture.window.s", 3.0), 0.1),
+            jax_obs_capture_oneshot=getb("jax.obs.capture.oneshot", False),
             raw=dict(conf),
         )
 

@@ -234,35 +234,67 @@ def main(argv: list[str] | None = None) -> int:
     # (0 = ephemeral, the chosen port is printed below so harnesses and
     # the smoke test can scrape without a race).
     sampler = metrics_server = occupancy = slo = None
+    xfer = shard = devmem = capture = None
     slo_wanted = cfg.jax_slo_p99_ms > 0 or cfg.jax_slo_rate_evps > 0
     if (cfg.jax_metrics_interval_ms > 0 or cfg.jax_metrics_port >= 0
             or cfg.jax_obs_lifecycle or cfg.jax_obs_spans
-            or cfg.jax_obs_occupancy or slo_wanted):
+            or cfg.jax_obs_occupancy or slo_wanted
+            or cfg.jax_obs_xfer or cfg.jax_obs_devmem
+            or cfg.jax_obs_shard or cfg.jax_obs_capture):
         from streambench_tpu.obs import (
+            CaptureManager,
+            DeviceMemoryLedger,
             MetricsRegistry,
             MetricsSampler,
             MetricsServer,
             OccupancySampler,
+            ShardSkew,
             SloTracker,
+            TransferLedger,
             engine_collector,
         )
 
         registry = MetricsRegistry()
         # jax.obs.occupancy: sampled block_until_ready-timed dispatches
         # -> the MEASURED device_busy_ratio, plus the recompile
-        # detector.  Everything is compiled (warmup above), so the
-        # steady-state compile counter starts now — its invariant value
-        # is zero.
+        # detector.  mark_steady() waits until the data-path obs below
+        # finish THEIR compiles (shard-stats kernel variants, devmem
+        # analysis) so the steady-state counter's invariant stays zero.
         if cfg.jax_obs_occupancy:
             occupancy = OccupancySampler(
                 registry, sample_every=cfg.jax_obs_occupancy_sample)
-            occupancy.mark_steady()
+        # jax.obs.xfer: host->device transfer ledger — exact payload
+        # bytes per dispatch by wire format + 1-in-N timed transfers
+        if cfg.jax_obs_xfer:
+            xfer = TransferLedger(registry,
+                                  sample_every=cfg.jax_obs_xfer_sample)
+        # jax.obs.shard: per-shard routed-row skew gauges (sharded
+        # engines only — the flag is inert without --sharded)
+        if cfg.jax_obs_shard and args.sharded:
+            from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS
+
+            shard = ShardSkew(
+                registry, n_shards=engine.mesh.shape[CAMPAIGN_AXIS])
         # jax.obs.lifecycle additionally attaches the per-window
         # attribution tracker (and, set alone, turns the sampler on at
         # its default cadence — attribution with no journal to land in
         # would be pointless; spans/occupancy/SLO likewise imply it)
         engine.attach_obs(registry, lifecycle=cfg.jax_obs_lifecycle,
-                          spans=spans, occupancy=occupancy)
+                          spans=spans, occupancy=occupancy, xfer=xfer,
+                          shard=shard)
+        if shard is not None:
+            # the shard-stats kernels are SEPARATE compiled programs the
+            # throwaway warmup above never dispatched; compile them now
+            # (warmup is state-neutral) so they can't land mid-run
+            engine.warmup()
+        # jax.obs.devmem: compiled-kernel memory_analysis footprints —
+        # each costs an out-of-line compile (never shares the jit call
+        # cache), so this runs exactly once, here, before mark_steady
+        if cfg.jax_obs_devmem:
+            devmem = DeviceMemoryLedger(registry)
+            devmem.analyze_engine(engine)
+        if occupancy is not None:
+            occupancy.mark_steady()
         metrics_path = os.path.join(args.workdir, "metrics.jsonl")
         sampler = MetricsSampler(
             metrics_path,
@@ -271,6 +303,21 @@ def main(argv: list[str] | None = None) -> int:
             max_bytes=cfg.jax_metrics_max_bytes)
         sampler.add_collector(engine_collector(
             engine, reader=reader, runner=runner, registry=registry))
+        if devmem is not None:
+            sampler.add_collector(devmem.collect)
+        # jax.obs.capture.*: bounded triggered profiler capture — SLO
+        # breach transitions, SIGUSR2, or the startup one-shot fire a
+        # short jax.profiler window into <workdir>/xprof_<ms>_<reason>/
+        if cfg.jax_obs_capture:
+            capture = CaptureManager(
+                args.workdir,
+                cooldown_s=cfg.jax_obs_capture_cooldown_s,
+                max_captures=cfg.jax_obs_capture_max,
+                window_s=cfg.jax_obs_capture_window_s,
+                registry=registry, flightrec=flightrec,
+                annotate=sampler.annotate)
+            signal.signal(signal.SIGUSR2,
+                          lambda *_: capture.trigger("sigusr2"))
         # SLO burn-rate tracking (obs.slo): collects AFTER the engine
         # collector so rec["events"]/["events_per_s"] feed the rate
         # objective; breach transitions are journaled as event records
@@ -282,7 +329,8 @@ def main(argv: list[str] | None = None) -> int:
                 budget=cfg.jax_slo_budget, fast_s=cfg.jax_slo_fast_s,
                 slow_s=cfg.jax_slo_slow_s,
                 use_lifecycle=cfg.jax_obs_lifecycle,
-                annotate=sampler.annotate, flightrec=flightrec)
+                annotate=sampler.annotate, flightrec=flightrec,
+                capture=capture)
             sampler.add_collector(slo.collect)
         sampler.start()
         endpoint = ""
@@ -297,6 +345,11 @@ def main(argv: list[str] | None = None) -> int:
     xo = " exactly_once=on" if cfg.jax_sink_exactly_once else ""
     print(f"engine up: topic={cfg.kafka_topic} redis={cfg.redis_host}:"
           f"{cfg.redis_port} batch={engine.batch_size}{xo}", flush=True)
+
+    if capture is not None and cfg.jax_obs_capture_oneshot:
+        # config one-shot: trace the first window_s of the run (smoke
+        # tests, "trace the warm ramp"); counts against the capture cap
+        capture.trigger("oneshot")
 
     from streambench_tpu.trace import device_trace
 
@@ -359,6 +412,22 @@ def main(argv: list[str] | None = None) -> int:
         occupancy.close()
     if slo is not None:
         stats_line["slo"] = slo.verdict()
+    if xfer is not None:
+        # measured host->device bytes per wire format — the data-path
+        # numbers the chip session needs next to the compute ratios
+        stats_line["xfer"] = xfer.summary()
+    if shard is not None:
+        shard_sum = shard.summary()
+        if shard_sum is not None:
+            stats_line["shard_skew"] = shard_sum
+    if devmem is not None:
+        devmem.refresh_census()
+        stats_line["devmem"] = devmem.summary()
+    if capture is not None:
+        # stop any in-flight capture (a dangling profiler drops its
+        # trace at interpreter exit) and record where the evidence lives
+        capture.close()
+        stats_line["capture"] = capture.summary()
     if spans is not None:
         trace_path = os.path.join(args.workdir,
                                   f"trace_{os.getpid()}.json")

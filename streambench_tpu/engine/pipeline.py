@@ -561,6 +561,23 @@ class AdAnalyticsEngine:
         # measured device occupancy (obs.occupancy): None unless
         # attach_obs opted in — one None check per dispatch otherwise
         self._obs_occupancy = None
+        # host->device transfer ledger (obs.xfer) + per-shard skew
+        # tracker (sharded engines feed it via shard_stats kernels):
+        # same contract — None and one check per dispatch until
+        # attach_obs opts in
+        self._obs_xfer = None
+        self._obs_shard = None
+        self._xfer_seen_buf = None     # devdecode buf attribution memo
+        # bench/debug knob: force the separate-column wire format even
+        # where the packed word is eligible, so the transfer ledger can
+        # MEASURE both formats on the same journal (the bench xfer
+        # probe and tests/test_xfer.py use it; engine output is
+        # identical either way — the packed path is bit-equal by
+        # construction and tested)
+        if os.environ.get("STREAMBENCH_WIRE_FORMAT", "").strip().lower() \
+                == "unpacked":
+            self._pack_ok = False
+            self._packed_scan = False
         self._writer: _RedisWriter | None = None
         # Parallel encode pool (multi-core hosts): per-thread encoders,
         # sound only for engines whose kernel never reads the interned
@@ -612,6 +629,15 @@ class AdAnalyticsEngine:
     # identity (HLL): consistent across pool workers and restarts, no
     # intern table in snapshots, parallel encode stays sound.
     HASHED_IDS = False
+    # Invalid rows appended per batch at dispatch so a device mesh's
+    # data axis divides B (the sharded engines set an instance value);
+    # the transfer ledger scales its per-dispatch byte accounting by it
+    # because the pad rows really do cross the host->device link.
+    _data_pad = 0
+    # Whether _device_step packs the wire word when _pack_ok (the base
+    # exact engine does; sketch steps always ship separate columns) —
+    # read by the transfer ledger's _xfer_step_cols, never the hot path
+    STEP_PACKS = True
 
     # ------------------------------------------------------------------
     def _maybe_device_decoder(self, mode: str):
@@ -866,22 +892,31 @@ class AdAnalyticsEngine:
                 for arrs in extras:
                     arrs += [np.zeros_like(arrs[0])] * pad
                 times += [np.zeros_like(times[0])] * pad
-            cols = ([jnp.asarray(np.stack(packs))]
-                    + [jnp.asarray(np.stack(a)) for a in extras]
-                    + [jnp.asarray(np.stack(times))])
+            stacks = ([np.stack(packs)]
+                      + [np.stack(a) for a in extras]
+                      + [np.stack(times)])
+            cols = [jnp.asarray(s) for s in stacks]
             with self.tracer.span("device_scan"):
                 self._device_scan_packed(*cols)
         else:
-            cols = []
+            stacks = []
             for name in self.SCAN_COLUMNS:
                 arrs = [getattr(b, name) for b in batches]
                 if pad:
                     arrs += [np.zeros_like(arrs[0])] * pad
-                cols.append(jnp.asarray(np.stack(arrs)))
+                stacks.append(np.stack(arrs))
+            cols = [jnp.asarray(s) for s in stacks]
             with self.tracer.span("device_scan"):
                 self._device_scan(*cols)
         if self._obs_occupancy is not None:
             self._obs_occupancy.note_dispatch(self.state)
+        if self._obs_xfer is not None:
+            # the numpy stacks ARE the dispatched host payload; the
+            # trailing axis is the per-batch row count the mesh pad
+            # scales
+            self._note_xfer(
+                "packed" if self._packed_scan else "unpacked",
+                sum(b.n for b in batches), stacks, stacks[0].shape[-1])
         for b in batches:
             self._note_watermark(b)
         self.events_processed += sum(b.n for b in batches)
@@ -915,6 +950,15 @@ class AdAnalyticsEngine:
                                               method=self.method)
         if self._obs_occupancy is not None:
             self._obs_occupancy.note_dispatch(self.state)
+        if self._obs_xfer is not None:
+            # the raw byte buffer crossed at prepare() (device_put once
+            # per block); attribute it to the FIRST fold that uses it —
+            # span-guard halves share it — plus each fold's row vectors
+            wire = pb.starts.nbytes + pb.lens.nbytes
+            if id(pb.buf_dev) != self._xfer_seen_buf:
+                self._xfer_seen_buf = id(pb.buf_dev)
+                wire += int(pb.buf_dev.nbytes)
+            self._obs_xfer.note_dispatch("devdecode", pb.n, wire)
         self._note_watermark(pb)
         self.events_processed += pb.n
         self.last_event_ms = now_ms()
@@ -1064,6 +1108,9 @@ class AdAnalyticsEngine:
             self._device_step(batch)
         if self._obs_occupancy is not None:
             self._obs_occupancy.note_dispatch(self.state)
+        if self._obs_xfer is not None:
+            fmt, cols = self._xfer_step_cols(batch)
+            self._note_xfer(fmt, batch.n, cols, batch.batch_size)
         self._note_watermark(batch)
         self.events_processed += batch.n
         self.last_event_ms = now_ms()
@@ -1087,6 +1134,89 @@ class AdAnalyticsEngine:
         mx = int(vt.max() if v.all() else vt[v].max()) + batch.base_time_ms
         if self._host_wm is None or mx > self._host_wm:
             self._host_wm = mx
+
+    # ------------------------------------------------------------------
+    # host->device transfer accounting (obs.xfer) — called only when
+    # attach_obs handed over a TransferLedger; never on the default path
+    def _xfer_step_cols(self, batch):
+        """``(fmt, cols)`` describing what ``_device_step`` ships for
+        one batch: the column buffers at their wire dtypes, with
+        ``batch.ad_idx`` standing in for the packed word (same int32
+        ``[B]`` shape).  Mirrors the base step's packing decision;
+        engines whose step never packs (single-device sketches)
+        override — the introspection rule ``_packed_scan`` applies to
+        the scan path only."""
+        if self._pack_ok and self.STEP_PACKS:
+            return "packed", ([batch.ad_idx]
+                              + [getattr(batch, c)
+                                 for c in self.PACKED_EXTRA_COLS]
+                              + [batch.event_time])
+        return "unpacked", [getattr(batch, c) for c in self.SCAN_COLUMNS]
+
+    def _note_xfer(self, fmt: str, events: int, cols, rows: int) -> None:
+        """Account one dispatch's payload: exact wire bytes from the
+        dispatched buffers' dtypes (trailing axis = ``rows`` data rows,
+        scaled by the mesh data-axis pad), int32-normalized column
+        bytes alongside (see obs.xfer).  ``cols`` double as the timed
+        device_put sample payload."""
+        pad = self._data_pad
+        wire = sum((c.nbytes // rows) * (rows + pad) for c in cols)
+        colb = sum((c.size // rows) * (rows + pad) * 4 for c in cols)
+        self._obs_xfer.note_dispatch(fmt, events, wire, colb,
+                                     sample_arrays=cols)
+
+    # ------------------------------------------------------------------
+    # device-memory accounting (obs.devmem) — analysis-time only; each
+    # entry costs one out-of-line compile (lower().compile() does not
+    # share the jit call cache), so this runs once post-warmup
+    def _devmem_kernels(self) -> list:
+        """``(name, jitted_fn, args, statics)`` for the device programs
+        this engine dispatches, built from an all-invalid batch (the
+        warmup shapes).  Fails CLOSED like ``_packed_scan`` /
+        ``_maybe_device_decoder``: a subclass that overrides the device
+        hooks dispatches programs this base list cannot describe, so it
+        returns [] unless the subclass ships its own list — the
+        memory report then carries state + census only, never a wrong
+        kernel table."""
+        if not (type(self)._device_step is AdAnalyticsEngine._device_step
+                and type(self)._device_scan
+                is AdAnalyticsEngine._device_scan):
+            return []
+        zb = self._encode([], self.batch_size)
+        statics = dict(divisor_ms=self.divisor, lateness_ms=self.lateness,
+                       method=self.method)
+        out: list = []
+        if self._pack_ok:
+            pk = wc.pack_columns(zb.ad_idx, zb.event_type, zb.valid)
+            out.append(("step_packed", wc.step_packed,
+                        (self.state, self.join_table, jnp.asarray(pk),
+                         jnp.asarray(zb.event_time)), statics))
+        else:
+            out.append(("step", wc.step,
+                        (self.state, self.join_table,
+                         jnp.asarray(zb.ad_idx),
+                         jnp.asarray(zb.event_type),
+                         jnp.asarray(zb.event_time),
+                         jnp.asarray(zb.valid)), statics))
+        if self.SCAN_SUPPORTED and self.scan_batches > 1:
+            K = self.scan_batches
+            if self._packed_scan:
+                pk = wc.pack_columns(zb.ad_idx, zb.event_type, zb.valid)
+                out.append(("scan_packed", wc.scan_steps_packed,
+                            (self.state, self.join_table,
+                             jnp.asarray(np.stack([pk] * K)),
+                             jnp.asarray(np.stack([zb.event_time] * K))),
+                            statics))
+            else:
+                cols = tuple(jnp.asarray(np.stack([getattr(zb, c)] * K))
+                             for c in self.SCAN_COLUMNS)
+                out.append(("scan", wc.scan_steps,
+                            (self.state, self.join_table) + cols,
+                            statics))
+        out.append(("drain", wc.flush_deltas, (self.state,),
+                    dict(divisor_ms=self.divisor,
+                         lateness_ms=self.lateness)))
+        return out
 
     @staticmethod
     def _halves(batch):
@@ -1719,7 +1849,8 @@ class AdAnalyticsEngine:
     # thread polls host-side bookkeeping; the only pushed signal is the
     # writeback-latency histogram fed from the writer thread.
     def attach_obs(self, registry, lifecycle: bool = False,
-                   spans=None, occupancy=None) -> None:
+                   spans=None, occupancy=None, xfer=None,
+                   shard=None) -> None:
         """Opt into live telemetry: register the window-latency streaming
         histogram on ``registry`` (obs.MetricsRegistry) so p50/p95/p99
         writeback latency is queryable *during* the run — the live
@@ -1742,7 +1873,15 @@ class AdAnalyticsEngine:
         ``occupancy`` (obs.occupancy.OccupancySampler) is called after
         every device dispatch; 1-in-N dispatches are timed to
         ``block_until_ready`` completion for the measured
-        device-busy ratio."""
+        device-busy ratio.
+
+        ``xfer`` (obs.xfer.TransferLedger) accounts every dispatch's
+        host->device payload bytes by wire format, with 1-in-N timed
+        transfer samples.
+
+        ``shard`` (obs.xfer.ShardSkew) receives per-shard routed/wanted
+        row vectors from the sharded engines' shard-stats kernels (the
+        single-device engines accept and ignore it)."""
         self._obs_hist = registry.histogram(
             "streambench_window_latency_ms",
             "window writeback latency (time_updated - window_ts), ms")
@@ -1756,6 +1895,10 @@ class AdAnalyticsEngine:
             spans.attach(self.tracer)
         if occupancy is not None:
             self._obs_occupancy = occupancy
+        if xfer is not None:
+            self._obs_xfer = xfer
+        if shard is not None:
+            self._obs_shard = shard
 
     def telemetry(self) -> dict:
         """Point-in-time observability snapshot of host bookkeeping.

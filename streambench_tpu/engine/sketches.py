@@ -50,6 +50,10 @@ class _SketchEngineBase(AdAnalyticsEngine):
     # Sketch kernels have no scanned form yet; process_chunk folds
     # per-batch (deferred drains still apply).
     SCAN_SUPPORTED = False
+    # Sketch _device_step implementations always ship separate columns
+    # (only their scans have packed forms) — keeps the transfer ledger's
+    # per-format accounting honest.
+    STEP_PACKS = False
     # Sketch device state is keyed by interned indices: one consistent
     # intern table is mandatory, so no per-thread parallel encoders and
     # interning stays ON.

@@ -31,6 +31,17 @@ recorder).  Four pieces, all stdlib, all default-off:
 - ``regress``   — tolerance-driven A/B comparator over bench artifacts
   or metrics journals (``python -m streambench_tpu.obs regress``, the
   CI regression gate)
+- ``xfer``      — host->device transfer ledger (exact payload bytes per
+  dispatch by wire format + sampled timed transfers;
+  ``jax.obs.xfer``) and the per-shard routed-row skew tracker for the
+  sharded engines (``jax.obs.shard``)
+- ``devmem``    — device-memory ledger: compiled-kernel
+  ``memory_analysis`` footprints + a sampled ``jax.live_arrays``
+  census (``jax.obs.devmem``)
+- ``capture``   — bounded TRIGGERED profiler capture (SLO breach /
+  SIGUSR2 / config one-shot, with cooldown + cap;
+  ``jax.obs.capture.*``); also owns the one process-global profiler
+  start/stop path ``trace.device_trace`` delegates to
 
 Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
 > 0 and/or ``jax.metrics.port`` >= 0); embed via::
@@ -45,6 +56,11 @@ Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
     server = MetricsServer(registry, port=0, refresh=sampler.collect_now)
 """
 
+from streambench_tpu.obs.capture import (  # noqa: F401
+    CaptureManager,
+    profiler_window,
+)
+from streambench_tpu.obs.devmem import DeviceMemoryLedger  # noqa: F401
 from streambench_tpu.obs.flightrec import FlightRecorder  # noqa: F401
 from streambench_tpu.obs.httpd import MetricsServer  # noqa: F401
 from streambench_tpu.obs.lifecycle import WindowLifecycle  # noqa: F401
@@ -66,3 +82,7 @@ from streambench_tpu.obs.sampler import (  # noqa: F401
 )
 from streambench_tpu.obs.slo import SloTracker  # noqa: F401
 from streambench_tpu.obs.spans import SpanTracer  # noqa: F401
+from streambench_tpu.obs.xfer import (  # noqa: F401
+    ShardSkew,
+    TransferLedger,
+)

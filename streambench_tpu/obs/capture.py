@@ -1,0 +1,206 @@
+"""Bounded triggered profiler capture: deep evidence when a run goes bad.
+
+Before this module the repo had exactly one profiler entry point — the
+ad-hoc ``jax.profiler.start_trace`` hook in ``trace.py`` (``--traceDir``
+traces a WHOLE run) — and an SLO breach (PR 8) left only a flight dump:
+counters that say a dispatch was slow, nothing that says WHY.  This
+module makes profiler capture *triggered and bounded*:
+
+- :class:`CaptureManager` fires a short ``jax.profiler.start_trace`` /
+  ``stop_trace`` window (``window_s``) into
+  ``<workdir>/xprof_<ms>_<reason>/`` when an SLO breach transitions on
+  (``obs/slo.py`` hook), on SIGUSR2 (the operator's "grab me a trace
+  NOW" signal, wired in the engine CLI), or as a config one-shot at
+  startup.  A cooldown and a max-capture cap bound the disk and
+  profiler overhead no matter how often the trigger fires; suppressed
+  triggers are counted, never silent.  Every capture is recorded in the
+  flight recorder and the metrics journal, and the capture dirs ride
+  the RunStats close line — a postmortem knows exactly where its deep
+  evidence lives.
+
+- :func:`profiler_window` is the ONE low-level start/stop path.
+  ``jax.profiler`` is a process-global singleton (a second
+  ``start_trace`` raises), so every profiler user — this manager AND
+  ``trace.device_trace`` (which now delegates here) — goes through the
+  same lock; a capture requested while another is active is counted as
+  suppressed instead of crashing the run.
+
+Default-off (``jax.obs.capture.enabled``): nothing is constructed, no
+signal handler installed, the hot path unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from streambench_tpu.utils.ids import now_ms
+
+# process-global profiler ownership: jax.profiler allows ONE active
+# trace; all start/stop goes through this lock + flag.
+_profiler_lock = threading.Lock()
+_active_logdir: "str | None" = None
+
+
+def _begin(logdir: str) -> bool:
+    """Start a profiler trace if none is active.  False (no-op) when
+    the profiler is busy or unavailable."""
+    global _active_logdir
+    with _profiler_lock:
+        if _active_logdir is not None:
+            return False
+        try:
+            import jax
+
+            jax.profiler.start_trace(logdir)
+        except Exception:
+            return False
+        _active_logdir = logdir
+        return True
+
+
+def _end(logdir: str) -> None:
+    global _active_logdir
+    with _profiler_lock:
+        if _active_logdir != logdir:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _active_logdir = None
+
+
+@contextlib.contextmanager
+def profiler_window(logdir: "str | None"):
+    """Scoped profiler trace under ``logdir`` (no-op if None or if the
+    profiler is already owned by a triggered capture).  The single
+    start/stop path — ``trace.device_trace`` delegates here."""
+    if not logdir:
+        yield
+        return
+    started = _begin(logdir)
+    try:
+        yield
+    finally:
+        if started:
+            _end(logdir)
+
+
+class CaptureManager:
+    """Trigger-driven bounded profiler captures.
+
+    ``trigger(reason)`` is safe from any thread (SLO collector, signal
+    handler, host loop): under the policy lock it checks the cap, the
+    cooldown, and profiler availability, then starts a capture whose
+    ``stop`` is scheduled on a daemon timer ``window_s`` later — the
+    triggering thread never blocks on the capture.
+    """
+
+    def __init__(self, workdir: str, *, cooldown_s: float = 60.0,
+                 max_captures: int = 3, window_s: float = 3.0,
+                 registry=None, flightrec=None, annotate=None,
+                 clock=time.monotonic):
+        self.workdir = workdir
+        self.cooldown_s = max(float(cooldown_s), 0.0)
+        self.max_captures = max(int(max_captures), 1)
+        self.window_s = max(float(window_s), 0.1)
+        self.flightrec = flightrec
+        self.annotate = annotate          # sampler.annotate or None
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_end: "float | None" = None
+        self._current: "str | None" = None
+        self._timer: "threading.Timer | None" = None
+        self.captures: list[dict] = []
+        self.suppressed = 0
+        self._c_caps = self._c_supp = None
+        if registry is not None:
+            self._c_caps = registry.counter(
+                "streambench_captures_total",
+                "triggered profiler captures started")
+            self._c_supp = registry.counter(
+                "streambench_captures_suppressed_total",
+                "capture triggers suppressed by cooldown/cap/busy")
+
+    # ------------------------------------------------------------------
+    def trigger(self, reason: str) -> "str | None":
+        """Request a capture; returns its directory, or None when
+        suppressed (cap reached, cooling down, or profiler busy)."""
+        with self._lock:
+            now = self._clock()
+            if (self._current is not None
+                    or len(self.captures) >= self.max_captures
+                    or (self._last_end is not None
+                        and now - self._last_end < self.cooldown_s)):
+                self.suppressed += 1
+                if self._c_supp is not None:
+                    self._c_supp.inc()
+                return None
+            logdir = os.path.join(
+                self.workdir, f"xprof_{now_ms()}_{reason}")
+            os.makedirs(logdir, exist_ok=True)
+            if not _begin(logdir):
+                self.suppressed += 1
+                if self._c_supp is not None:
+                    self._c_supp.inc()
+                return None
+            self._current = logdir
+            rec = {"dir": logdir, "reason": reason, "ts_ms": now_ms(),
+                   "window_s": self.window_s}
+            self.captures.append(rec)
+            if self._c_caps is not None:
+                self._c_caps.inc()
+            self._timer = threading.Timer(self.window_s, self._finish,
+                                          args=(logdir,))
+            self._timer.daemon = True
+            self._timer.start()
+        if self.flightrec is not None:
+            self.flightrec.record("profiler_capture", dir=logdir,
+                                  reason=reason)
+        if self.annotate is not None:
+            try:
+                self.annotate("profiler_capture", dir=logdir,
+                              reason=reason)
+            except Exception:
+                pass   # a closing sampler must not kill the trigger
+        return logdir
+
+    def _finish(self, logdir: str) -> None:
+        _end(logdir)
+        with self._lock:
+            if self._current == logdir:
+                self._current = None
+                self._last_end = self._clock()
+
+    # ------------------------------------------------------------------
+    @property
+    def active(self) -> "str | None":
+        with self._lock:
+            return self._current
+
+    def close(self) -> None:
+        """Stop any in-flight capture NOW (run is ending; a dangling
+        profiler would drop its trace on interpreter exit)."""
+        with self._lock:
+            timer, current = self._timer, self._current
+            self._timer = None
+        if timer is not None:
+            timer.cancel()
+        if current is not None:
+            self._finish(current)
+
+    def summary(self) -> dict:
+        """The ``"capture"`` block for the RunStats close line."""
+        with self._lock:
+            return {
+                "captures": [dict(c) for c in self.captures],
+                "suppressed": self.suppressed,
+                "cooldown_s": self.cooldown_s,
+                "max_captures": self.max_captures,
+                "window_s": self.window_s,
+            }

@@ -1,0 +1,202 @@
+"""Device-memory ledger: compiled-kernel footprints + live-array census.
+
+HBM claims in this repo were comments and arithmetic ("config #5's
+C=1e6 would be a [8192, 1e6] f32 operand — 32 GB",
+``engine/pipeline.py``); nothing measured what the compiled programs
+actually reserve or what the process actually holds on device.  Two
+measured signals, both off the hot path:
+
+- **per-kernel footprints** — :func:`kernel_memory` runs
+  ``fn.lower(*args).compile().memory_analysis()`` and reports XLA's own
+  argument/output/temp/alias byte accounting for that executable.
+  :meth:`DeviceMemoryLedger.analyze_engine` does it for every hot
+  kernel an engine dispatches (the engine's ``_devmem_kernels`` hook,
+  which fails CLOSED for subclasses with overridden device hooks) and
+  folds them into a per-engine **peak-footprint estimate**: persistent
+  state bytes + the largest single kernel's (argument + output + temp).
+  CAUTION (the PR 7 gotcha as a design rule): ``lower().compile()``
+  does NOT share the jit call cache — each analysis costs one extra
+  compile, so analysis runs once, after warmup construction and BEFORE
+  ``mark_steady()``, never per tick.
+
+- **live-array census** — a sampled ``jax.live_arrays()`` walk (count +
+  bytes, bucketed by power-of-two array size) journaled by the existing
+  ``MetricsSampler`` via :meth:`DeviceMemoryLedger.collect`.  The
+  census is O(live arrays) per sample, so it runs every
+  ``census_every`` ticks, not every tick.
+
+Default-off: nothing here is constructed unless ``jax.obs.devmem``
+(engine CLI) or a bench phase asks for it.
+"""
+
+from __future__ import annotations
+
+_MA_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "code_bytes"),
+)
+
+
+def kernel_memory(fn, *args, **kwargs) -> dict:
+    """One compiled kernel's memory analysis as a plain dict.
+
+    ``fn`` is a jitted callable; statics go in ``kwargs``.  Returns
+    ``{"supported": False, "error": ...}`` when the backend has no
+    ``memory_analysis`` (never raises into obs callers).  NOTE: costs
+    one out-of-line compile (see module docstring)."""
+    try:
+        ma = fn.lower(*args, **kwargs).compile().memory_analysis()
+    except Exception as e:
+        return {"supported": False, "error": repr(e)}
+    if ma is None:
+        return {"supported": False, "error": "memory_analysis() is None"}
+    out: dict = {"supported": True}
+    for attr, key in _MA_FIELDS:
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    # transient working set of one dispatch: inputs + outputs + scratch
+    # (aliased/donated bytes are counted inside argument_size already)
+    out["total_bytes"] = (out.get("argument_bytes", 0)
+                          + out.get("output_bytes", 0)
+                          + out.get("temp_bytes", 0))
+    return out
+
+
+def state_nbytes(state) -> int:
+    """Bytes of a pytree of device arrays (an engine's persistent
+    state)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+def live_array_census(buckets: int = 24) -> dict:
+    """One ``jax.live_arrays()`` walk: count + bytes, bucketed by
+    power-of-two array size (bucket label = upper bound in bytes)."""
+    import jax
+
+    count = 0
+    total = 0
+    by_bucket: dict[str, list] = {}
+    try:
+        arrays = jax.live_arrays()
+    except Exception as e:
+        return {"supported": False, "error": repr(e)}
+    for a in arrays:
+        nb = int(getattr(a, "nbytes", 0) or 0)
+        count += 1
+        total += nb
+        b = 1
+        while b < nb:
+            b <<= 1
+        key = str(b)
+        slot = by_bucket.get(key)
+        if slot is None:
+            slot = by_bucket[key] = [0, 0]
+        slot[0] += 1
+        slot[1] += nb
+    top = sorted(by_bucket.items(), key=lambda kv: -kv[1][1])[:buckets]
+    return {
+        "supported": True,
+        "count": count,
+        "bytes": total,
+        "buckets": {k: {"count": c, "bytes": nb} for k, (c, nb) in top},
+    }
+
+
+class DeviceMemoryLedger:
+    """Aggregates kernel footprints + the sampled live-array census.
+
+    ``analyze_engine(engine)`` runs once (post-warmup, pre-steady);
+    ``collect(rec, dt_s)`` has the MetricsSampler collector signature
+    and puts the ``"devmem"`` block on snapshot records, refreshing the
+    census every ``census_every`` ticks.
+    """
+
+    def __init__(self, registry=None, census_every: int = 8):
+        self.census_every = max(int(census_every), 1)
+        self.kernels: dict[str, dict] = {}
+        self.state_bytes = 0
+        self._ticks = 0
+        self._census: "dict | None" = None
+        self._g_live = self._g_live_bytes = self._g_peak = None
+        if registry is not None:
+            self._g_live = registry.gauge(
+                "streambench_devmem_live_arrays",
+                "jax.live_arrays() count at the last census")
+            self._g_live_bytes = registry.gauge(
+                "streambench_devmem_live_bytes",
+                "bytes held by live jax arrays at the last census")
+            self._g_peak = registry.gauge(
+                "streambench_devmem_peak_footprint_bytes",
+                "persistent state + largest compiled kernel's "
+                "argument+output+temp bytes (memory_analysis)")
+
+    # ------------------------------------------------------------------
+    def note_kernel(self, name: str, fn, *args, **kwargs) -> dict:
+        """Analyze one kernel and record it under ``name``."""
+        rep = kernel_memory(fn, *args, **kwargs)
+        self.kernels[name] = rep
+        if self._g_peak is not None:
+            self._g_peak.set(self.peak_footprint_bytes())
+        return rep
+
+    def analyze_engine(self, engine) -> dict:
+        """Analyze every hot kernel ``engine`` exposes via its
+        ``_devmem_kernels()`` hook (fails closed: engines whose device
+        hooks this ledger cannot describe return an empty list) and
+        record the persistent state footprint."""
+        self.state_bytes = state_nbytes(getattr(engine, "state", None))
+        try:
+            kernels = engine._devmem_kernels()
+        except Exception as e:
+            self.kernels["_error"] = {"supported": False,
+                                      "error": repr(e)}
+            kernels = []
+        for name, fn, args, statics in kernels:
+            self.note_kernel(name, fn, *args, **statics)
+        return self.summary(census=False)
+
+    def peak_footprint_bytes(self) -> int:
+        """Persistent state + the largest single kernel working set —
+        the per-engine peak-footprint ESTIMATE (concurrent in-flight
+        dispatches can stack temps beyond it; stated, not hidden)."""
+        worst = max((k.get("total_bytes", 0)
+                     for k in self.kernels.values()
+                     if k.get("supported")), default=0)
+        return self.state_bytes + worst
+
+    # ------------------------------------------------------------------
+    def refresh_census(self) -> "dict | None":
+        self._census = live_array_census()
+        if self._g_live is not None and self._census.get("supported"):
+            self._g_live.set(self._census["count"])
+            self._g_live_bytes.set(self._census["bytes"])
+        return self._census
+
+    def collect(self, rec: dict, dt_s: float) -> None:
+        """MetricsSampler collector: ``rec["devmem"]`` every tick, with
+        the census refreshed every ``census_every`` ticks."""
+        if self._ticks % self.census_every == 0:
+            self.refresh_census()
+        self._ticks += 1
+        rec["devmem"] = self.summary()
+
+    def summary(self, census: bool = True) -> dict:
+        out: dict = {
+            "state_bytes": self.state_bytes,
+            "peak_footprint_bytes": self.peak_footprint_bytes(),
+            "kernels": self.kernels,
+        }
+        if census and self._census is not None:
+            out["live"] = self._census
+        return out

@@ -49,6 +49,17 @@ DEFAULT_TOLERANCES: dict = {
     "device_busy_ratio": ("higher", 0.8),
     "windows_written": ("higher", 0.5),
     "rss_bytes_max": ("lower", 1.0),
+    # data-path obs (ISSUE 9): measured host->device bytes per event
+    # are near-deterministic for a fixed config (padding is the only
+    # nondeterminism), so tolerances are tighter than the timing rows;
+    # direction-aware — MORE bytes per event is the regression.
+    "xfer_packed_bytes_per_event": ("lower", 0.25),
+    "xfer_unpacked_bytes_per_event": ("lower", 0.25),
+    "xfer_devdecode_bytes_per_event": ("lower", 0.25),
+    # col-basis packed/unpacked ratio: 0.5 by construction; drifting UP
+    # means the packed word stopped halving the wire
+    "packed_unpacked_ratio": ("lower", 0.15),
+    "devmem_peak_footprint_bytes": ("lower", 1.0),
 }
 
 
@@ -91,6 +102,20 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
         slo = paced.get("slo")
         if isinstance(slo, dict):
             out["slo_pass"] = bool(slo.get("pass"))
+    # data-path obs blocks (ISSUE 9): per-format measured bytes/event
+    # + the packed/unpacked ratio + the devmem peak footprint
+    xfer = doc.get("xfer")
+    if isinstance(xfer, dict):
+        for fmt, d in (xfer.get("formats") or {}).items():
+            if isinstance(d, dict):
+                out[f"xfer_{fmt}_bytes_per_event"] = _num(
+                    d.get("bytes_per_event"))
+        out["packed_unpacked_ratio"] = _num(
+            xfer.get("packed_unpacked_ratio"))
+    dm = doc.get("devmem")
+    if isinstance(dm, dict):
+        out["devmem_peak_footprint_bytes"] = _num(
+            dm.get("peak_footprint_bytes"))
     return {k: v for k, v in out.items() if v is not None}
 
 
@@ -109,6 +134,18 @@ def normalize_metrics(records: list, path: str = "") -> dict:
         "latency_p99_ms": _num(lat.get("p99")),
         "rss_bytes_max": _num(s.get("rss_bytes_max")),
     }
+    xfer = s.get("xfer")
+    if isinstance(xfer, dict):
+        for fmt, d in (xfer.get("formats") or {}).items():
+            if isinstance(d, dict):
+                out[f"xfer_{fmt}_bytes_per_event"] = _num(
+                    d.get("bytes_per_event"))
+        out["packed_unpacked_ratio"] = _num(
+            xfer.get("packed_unpacked_ratio"))
+    dm = s.get("devmem")
+    if isinstance(dm, dict):
+        out["devmem_peak_footprint_bytes"] = _num(
+            dm.get("peak_footprint_bytes"))
     rs = s.get("run_stats")
     if isinstance(rs, dict):
         if rs.get("events_per_s") is not None:

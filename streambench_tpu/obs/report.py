@@ -72,6 +72,13 @@ def summarize(records: list[dict], path: str = "") -> dict:
         if r.get("latency_ms"):
             latency = r["latency_ms"]
             break
+
+    def last_block(key):
+        for r in reversed(snaps):
+            if isinstance(r.get(key), dict):
+                return r[key]
+        return None
+
     return {
         "path": path,
         "snapshots": len(snaps),
@@ -89,6 +96,11 @@ def summarize(records: list[dict], path: str = "") -> dict:
         # key (obs.sampler.rss_sample) — keep the two apart here too
         "rss_peak_bytes_max": col_max("rss_peak_bytes"),
         "latency_ms": latency,
+        # data-path obs (layer 4): newest transfer / device-memory /
+        # shard-skew blocks, when those ledgers were armed
+        "xfer": last_block("xfer"),
+        "devmem": last_block("devmem"),
+        "shard_skew": last_block("shard_skew"),
         "faults": last.get("faults") or {},
         "stages": stages,
         "annotations": [{k: r.get(k) for k in ("event", "uptime_ms")}
@@ -138,6 +150,48 @@ def render_report(s: dict) -> str:
         lines.append(f"  {label:<22} {_fmt(s.get(key))}")
     for label, v in _latency_rows(s):
         lines.append(f"  {label:<22} {_fmt(v)}")
+    xfer = s.get("xfer")
+    if xfer and xfer.get("formats"):
+        lines.append("  transfer (host->device bytes, measured):")
+        for fmt, d in sorted(xfer["formats"].items()):
+            lines.append(
+                f"    {fmt:<10} {_fmt(d.get('dispatches')):>8} disp "
+                f"{_fmt(d.get('events')):>12} ev "
+                f"{_fmt(d.get('bytes_per_event')):>10} B/ev "
+                f"({_fmt(d.get('col_bytes_per_event'))} B/ev int32)")
+        if xfer.get("packed_unpacked_ratio") is not None:
+            lines.append(f"    packed/unpacked ratio  "
+                         f"{xfer['packed_unpacked_ratio']} "
+                         f"({xfer.get('ratio_basis')})")
+        if xfer.get("xfer_mb_s") is not None:
+            lines.append(f"    sampled link rate      "
+                         f"{_fmt(xfer['xfer_mb_s'])} MB/s over "
+                         f"{_fmt(xfer.get('sampled'))} timed transfers")
+    dm = s.get("devmem")
+    if dm:
+        lines.append("  memory (device, measured):")
+        lines.append(f"    state bytes            "
+                     f"{_fmt(dm.get('state_bytes'))}")
+        lines.append(f"    peak footprint bytes   "
+                     f"{_fmt(dm.get('peak_footprint_bytes'))}")
+        for name, k in sorted((dm.get("kernels") or {}).items()):
+            if k.get("supported"):
+                lines.append(f"    kernel {name:<16} "
+                             f"{_fmt(k.get('total_bytes')):>12} B "
+                             f"(arg {_fmt(k.get('argument_bytes'))} + "
+                             f"out {_fmt(k.get('output_bytes'))} + "
+                             f"tmp {_fmt(k.get('temp_bytes'))})")
+        live = dm.get("live")
+        if live and live.get("supported"):
+            lines.append(f"    live arrays            "
+                         f"{_fmt(live.get('count'))} holding "
+                         f"{_fmt(live.get('bytes'))} B")
+    sk = s.get("shard_skew")
+    if sk:
+        lines.append("  shard skew (routed rows per campaign shard):")
+        lines.append(f"    rows {sk.get('rows')}  dropped "
+                     f"{sk.get('dropped')}  imbalance "
+                     f"{_fmt(sk.get('imbalance_ratio'))}")
     if s["faults"]:
         lines.append("  faults:")
         for k in sorted(s["faults"]):
@@ -273,6 +327,17 @@ def render_diff(a: dict, b: dict) -> str:
     lb = dict(_latency_rows(b))
     for label in la:
         emit(label, la[label], lb.get(label))
+    xa = (a.get("xfer") or {}).get("formats") or {}
+    xb = (b.get("xfer") or {}).get("formats") or {}
+    for fmt in sorted(set(xa) | set(xb)):
+        emit(f"xfer {fmt} B/ev",
+             (xa.get(fmt) or {}).get("bytes_per_event"),
+             (xb.get(fmt) or {}).get("bytes_per_event"))
+    da = a.get("devmem") or {}
+    db = b.get("devmem") or {}
+    if da or db:
+        emit("devmem peak bytes", da.get("peak_footprint_bytes"),
+             db.get("peak_footprint_bytes"))
     fault_keys = sorted(set(a["faults"]) | set(b["faults"]))
     for k in fault_keys:
         emit(f"fault {k}", a["faults"].get(k, 0), b["faults"].get(k, 0))

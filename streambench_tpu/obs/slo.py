@@ -57,7 +57,7 @@ class SloTracker:
     def __init__(self, registry, p99_ms: int = 0, rate_evps: int = 0,
                  budget: float = 0.01, fast_s: float = 30.0,
                  slow_s: float = 180.0, use_lifecycle: bool = False,
-                 annotate=None, flightrec=None,
+                 annotate=None, flightrec=None, capture=None,
                  clock=time.monotonic):
         self.p99_ms = max(int(p99_ms), 0)
         self.rate_evps = max(int(rate_evps), 0)
@@ -66,6 +66,12 @@ class SloTracker:
         self.slow_s = max(float(slow_s), self.fast_s)
         self.annotate = annotate       # sampler.annotate or None
         self.flightrec = flightrec
+        # obs.capture.CaptureManager (or None): a breach TRANSITION
+        # fires a bounded profiler capture — the deep "why was the
+        # dispatch slow" evidence next to the flight dump's "that it
+        # was".  The manager owns cooldown/cap policy, so a flapping
+        # breach cannot profile the run to death.
+        self.capture = capture
         self._clock = clock
         # latency source: get-or-create with the SAME geometry as the
         # producer so the registry hands back the shared instrument
@@ -198,6 +204,11 @@ class SloTracker:
                     pass   # a closing sampler must not kill the tick
             if self.flightrec is not None:
                 self.flightrec.record("slo_breach", **fields)
+            if self.capture is not None:
+                try:
+                    self.capture.trigger("slo_breach")
+                except Exception:
+                    pass   # capture failure must not kill the tick
         elif not breaching and self._in_breach:
             if self.annotate is not None:
                 try:

@@ -1,0 +1,296 @@
+"""Host->device transfer ledger + shard-skew gauges (obs layer 4).
+
+Every transfer claim in this repo used to live in a code comment: the
+packed wire word "halves host->device bytes" (``ops/windowcount.py``),
+the device-decode raw format is "~250 B/ev vs 8 B/ev packed"
+(BENCH_r06).  Against a tunneled accelerator the host->device link is
+the throughput ceiling, so ROADMAP items 1-2 gate the next chip session
+on *measuring the data path*, not just compute.  This module is that
+measurement:
+
+- :class:`TransferLedger` — hooked at the same ``_fold`` /
+  ``_fold_group`` / ``_fold_prepared`` dispatch points as the PR 8
+  ``OccupancySampler``: every dispatch's host->device payload is
+  accounted EXACTLY (bytes computed from the dispatched buffers' dtypes
+  and shapes, sharded data-axis padding included), keyed by wire format
+  — ``packed`` (the int32 wire word + time, 8 B/ev for the exact
+  engine), ``unpacked`` (the separate columns; ``valid`` ships as
+  1-byte bools, so 13 B/ev), ``devdecode`` (the raw-bytes format: the
+  padded journal buffer + (start, len) row vectors, ~250 B/ev).  One
+  dispatch in ``sample_every`` additionally TIMES an equivalent-size
+  ``jax.device_put`` + ``block_until_ready`` round trip, so the split
+  between transfer and compute is measured, not inferred.
+
+Two byte accountings per format, both honest and clearly labeled:
+
+- ``wire_bytes`` / ``bytes_per_event`` — the exact bytes of the
+  dispatched host buffers (what the PCIe/tunnel link actually moves).
+- ``col_bytes`` / ``col_bytes_per_event`` — the same columns normalized
+  to the kernel's int32 width (4 B per column element).  This is the
+  accounting ``parallel.collectives`` uses for ICI payloads, so the
+  host-wire table and the HLO collective table are directly comparable:
+  ``packed_unpacked_ratio`` on this basis is exactly the 0.5 the
+  MULTICHIP_r06 ``packed_col_ratio`` records (the raw wire ratio is
+  8/13 ~= 0.62 only because ``valid`` travels as bools).
+
+- :class:`ShardSkew` — per-shard routed-row and drop accounting for the
+  sharded engines: the ``shard_stats`` kernel variants
+  (``parallel/sharded.py`` / ``parallel/sketches.py``) ride per-shard
+  routed/wanted vectors out of the existing scan ys, and this tracker
+  accumulates them device-side (no sync on the hot path) into
+  ``streambench_shard_rows{shard=}`` gauges plus an imbalance ratio —
+  the straggler evidence a real-mesh run needs next to the collective
+  table.
+
+Default-off like the rest of obs/: the engine carries ``None``
+attributes and one None check per dispatch until
+``attach_obs(..., xfer=TransferLedger(...))``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TransferLedger:
+    """Exact per-dispatch host->device payload accounting by wire format.
+
+    ``note_dispatch`` is called from the host loop only (single-writer
+    ints, the same rule as the occupancy/ingest counters); ``summary``
+    may be read from the sampler thread at any cadence (the per-format
+    totals are plain ints, consistent under the GIL).
+
+    ``sample_every``: one dispatch in N pays a timed ``device_put`` +
+    ``block_until_ready`` of the SAME host buffers — a redundant
+    transfer of identical size, so the recorded ``streambench_xfer_ms``
+    isolates the transfer half of a dispatch without instrumenting the
+    async hot path.  0 disables timing entirely (byte accounting only).
+    """
+
+    def __init__(self, registry=None, sample_every: int = 32):
+        self.sample_every = max(int(sample_every), 0)
+        self.dispatches = 0
+        self.sampled = 0
+        self.sampled_ns = 0
+        self.sampled_bytes = 0
+        # fmt -> [dispatches, events, wire_bytes, col_bytes]
+        self._formats: dict[str, list] = {}
+        self._reg = registry
+        self._hist = None
+        self._c_sampled = None
+        self._per_fmt: dict[str, tuple] = {}
+        if registry is not None:
+            self._hist = registry.histogram(
+                "streambench_xfer_ms",
+                "sampled host->device transfer time per dispatch "
+                "payload (device_put + block_until_ready), ms",
+                lo=0.001, hi=1e5)
+            self._c_sampled = registry.counter(
+                "streambench_xfer_sampled_total",
+                "dispatch payloads whose transfer was timed (1/N)")
+
+    # ------------------------------------------------------------------
+    def _instruments(self, fmt: str) -> tuple:
+        inst = self._per_fmt.get(fmt)
+        if inst is None and self._reg is not None:
+            inst = (
+                self._reg.counter(
+                    "streambench_xfer_bytes_total",
+                    "exact host->device payload bytes dispatched",
+                    labels={"format": fmt}),
+                self._reg.counter(
+                    "streambench_xfer_col_bytes_total",
+                    "payload bytes at kernel (int32) column width — "
+                    "the parallel.collectives accounting basis",
+                    labels={"format": fmt}),
+                self._reg.counter(
+                    "streambench_xfer_events_total",
+                    "parsed events carried by the dispatched payloads",
+                    labels={"format": fmt}),
+                self._reg.counter(
+                    "streambench_xfer_dispatches_total",
+                    "device dispatches seen by the transfer ledger",
+                    labels={"format": fmt}),
+                self._reg.gauge(
+                    "streambench_xfer_bytes_per_event",
+                    "derived wire bytes per parsed event",
+                    labels={"format": fmt}),
+            )
+            self._per_fmt[fmt] = inst
+        return inst
+
+    def note_dispatch(self, fmt: str, events: int, wire_bytes: int,
+                      col_bytes: "int | None" = None,
+                      sample_arrays=None) -> None:
+        """One device dispatch shipped ``wire_bytes`` of host buffers
+        carrying ``events`` parsed events in wire format ``fmt``.
+        ``col_bytes`` defaults to ``wire_bytes`` (formats with no bool
+        columns).  ``sample_arrays`` (host numpy buffers of the same
+        sizes as the payload) enables the 1-in-N timed transfer."""
+        if col_bytes is None:
+            col_bytes = wire_bytes
+        self.dispatches += 1
+        tot = self._formats.get(fmt)
+        if tot is None:
+            tot = self._formats[fmt] = [0, 0, 0, 0]
+        tot[0] += 1
+        tot[1] += int(events)
+        tot[2] += int(wire_bytes)
+        tot[3] += int(col_bytes)
+        inst = self._instruments(fmt)
+        if inst is not None:
+            c_wire, c_col, c_ev, c_disp, g_bpe = inst
+            c_wire.inc(int(wire_bytes))
+            c_col.inc(int(col_bytes))
+            c_ev.inc(int(events))
+            c_disp.inc()
+            if tot[1]:
+                g_bpe.set(round(tot[2] / tot[1], 3))
+        if (not self.sample_every or sample_arrays is None
+                or self.dispatches % self.sample_every):
+            return
+        import jax
+
+        arrays = list(sample_arrays)
+        t0 = time.perf_counter_ns()
+        put = [jax.device_put(a) for a in arrays]
+        jax.block_until_ready(put)
+        dt = time.perf_counter_ns() - t0
+        del put
+        self.sampled += 1
+        self.sampled_ns += dt
+        self.sampled_bytes += sum(int(a.nbytes) for a in arrays)
+        if self._hist is not None:
+            self._hist.observe(dt / 1e6)
+            self._c_sampled.set_total(self.sampled)
+
+    # ------------------------------------------------------------------
+    def bytes_per_event(self, fmt: str) -> "float | None":
+        tot = self._formats.get(fmt)
+        if not tot or not tot[1]:
+            return None
+        return tot[2] / tot[1]
+
+    def summary(self) -> dict:
+        """The ``"xfer"`` block a metrics.jsonl snapshot / bench
+        artifact carries."""
+        formats = {}
+        for fmt, (disp, ev, wire, col) in sorted(self._formats.items()):
+            formats[fmt] = {
+                "dispatches": disp,
+                "events": ev,
+                "wire_bytes": wire,
+                "col_bytes": col,
+                "bytes_per_event": round(wire / ev, 3) if ev else None,
+                "col_bytes_per_event": (round(col / ev, 3)
+                                        if ev else None),
+            }
+        out: dict = {"dispatches": self.dispatches,
+                     "sample_every": self.sample_every,
+                     "formats": formats}
+        pk, up = formats.get("packed"), formats.get("unpacked")
+        if pk and up and up["col_bytes_per_event"]:
+            # column-width-normalized, the MULTICHIP packed_col_ratio
+            # basis (module docstring): exactly 0.5 for the exact engine
+            out["packed_unpacked_ratio"] = round(
+                pk["col_bytes_per_event"] / up["col_bytes_per_event"], 4)
+            out["ratio_basis"] = "col_bytes"
+        if self.sampled:
+            ms = self.sampled_ns / 1e6
+            out["sampled"] = self.sampled
+            out["sampled_ms_total"] = round(ms, 3)
+            out["sampled_bytes"] = self.sampled_bytes
+            if ms > 0:
+                # MB/s over the timed transfers — the measured link rate
+                out["xfer_mb_s"] = round(
+                    self.sampled_bytes / 1e6 / (ms / 1e3), 2)
+        if self._hist is not None and self._hist.count:
+            out["xfer_ms"] = self._hist.summary()
+        return out
+
+
+class ShardSkew:
+    """Per-shard routed-row / drop accumulation for the sharded engines.
+
+    ``note(wanted_vec, routed_vec)`` receives two replicated ``[S]``
+    int32 DEVICE vectors from a ``shard_stats`` kernel dispatch — rows
+    whose campaign maps to each shard (pre-lateness, the same basis as
+    the global ``dropped`` accounting) and rows each shard actually
+    counted.  Accumulation is a device-side add (async, no sync on the
+    hot path); ``summary()`` materializes the totals — call it from the
+    sampler thread or at close, never the host loop.
+
+    Thread-safety: ``note`` runs on the host loop only; ``summary``
+    snapshots the accumulator references under a lock so a concurrent
+    ``note`` never interleaves mid-read.
+    """
+
+    def __init__(self, registry=None, n_shards: int = 1):
+        self.n_shards = max(int(n_shards), 1)
+        self.dispatches = 0
+        self._wanted = None      # device [S] running totals
+        self._routed = None
+        self._lock = threading.Lock()
+        self._reg = registry
+        self._g_imb = None
+        self._g_rows: list = []
+        self._g_drop: list = []
+        if registry is not None:
+            self._g_imb = registry.gauge(
+                "streambench_shard_imbalance_ratio",
+                "max/mean routed rows across campaign shards "
+                "(1.0 = perfectly balanced)")
+            for s in range(self.n_shards):
+                self._g_rows.append(registry.gauge(
+                    "streambench_shard_rows",
+                    "rows routed to (counted by) this campaign shard",
+                    labels={"shard": str(s)}))
+                self._g_drop.append(registry.gauge(
+                    "streambench_shard_dropped",
+                    "rows wanted by this shard's campaigns but not "
+                    "counted (late / lost slot)",
+                    labels={"shard": str(s)}))
+
+    def note(self, wanted_vec, routed_vec) -> None:
+        """Accumulate one dispatch's per-shard vectors (device add)."""
+        with self._lock:
+            self.dispatches += 1
+            if self._wanted is None:
+                self._wanted = wanted_vec
+                self._routed = routed_vec
+            else:
+                self._wanted = self._wanted + wanted_vec
+                self._routed = self._routed + routed_vec
+
+    def summary(self) -> "dict | None":
+        """Materialize totals (device sync — sampler/close cadence
+        only).  None until the first dispatch."""
+        import numpy as np
+
+        with self._lock:
+            if self._routed is None:
+                return None
+            wanted_d, routed_d = self._wanted, self._routed
+            dispatches = self.dispatches
+        wanted = np.asarray(wanted_d).astype(np.int64)
+        routed = np.asarray(routed_d).astype(np.int64)
+        dropped = np.maximum(wanted - routed, 0)
+        mean = routed.mean() if routed.size else 0.0
+        imbalance = float(routed.max() / mean) if mean > 0 else 1.0
+        for s, g in enumerate(self._g_rows):
+            if s < routed.size:
+                g.set(int(routed[s]))
+        for s, g in enumerate(self._g_drop):
+            if s < dropped.size:
+                g.set(int(dropped[s]))
+        if self._g_imb is not None:
+            self._g_imb.set(round(imbalance, 4))
+        return {
+            "shards": int(routed.size),
+            "dispatches": dispatches,
+            "rows": routed.tolist(),
+            "wanted": wanted.tolist(),
+            "dropped": dropped.tolist(),
+            "imbalance_ratio": round(imbalance, 4),
+        }

@@ -100,10 +100,22 @@ def sharded_init_state(num_campaigns: int, window_slots: int,
     )
 
 
+def _shard_hist(campaign, mask, Cl: int, n_shards: int):
+    """Replicated ``[S]`` histogram of ``mask`` rows by owning campaign
+    shard (``campaign // Cl``).  Computed from replicated inputs with no
+    ``axis_index``, so the shard_map replication checker can prove the
+    result unvarying over BOTH axes — the shard-skew stats ride out as
+    ``P()`` outputs with zero extra collectives."""
+    shard = jnp.clip(campaign // Cl, 0, n_shards - 1)
+    flat = jnp.where(mask, shard, n_shards)
+    return (jnp.zeros(n_shards + 1, jnp.int32)
+            .at[flat].add(1)[:n_shards])
+
+
 def _fold_one(counts, window_ids, watermark, dropped, join_table,
               ad_idx, event_type, event_time, valid,
               *, divisor_ms: int, lateness_ms: int, view_type: int,
-              n_data: int):
+              n_data: int, stats_shards: int = 0):
     """Per-batch fold, written against shard-local views inside shard_map.
     Shared by the single-batch step and the scanned multi-batch step.
 
@@ -125,7 +137,7 @@ def _fold_one(counts, window_ids, watermark, dropped, join_table,
     return _fold_core(counts, window_ids, watermark, dropped, join_table,
                       ad_idx, event_type, event_time, valid,
                       divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-                      view_type=view_type)
+                      view_type=view_type, stats_shards=stats_shards)
 
 
 def _gather_replicated(x, n_data: int):
@@ -153,7 +165,7 @@ def _gather_replicated(x, n_data: int):
 def _fold_one_packed(counts, window_ids, watermark, dropped, join_table,
                      packed, event_time,
                      *, divisor_ms: int, lateness_ms: int, view_type: int,
-                     n_data: int):
+                     n_data: int, stats_shards: int = 0):
     """``_fold_one`` consuming the packed wire word
     (``ops.windowcount.pack_columns``): two data-axis collectives per
     batch instead of four — the packing that halves host->device bytes
@@ -167,18 +179,21 @@ def _fold_one_packed(counts, window_ids, watermark, dropped, join_table,
     return _fold_core(counts, window_ids, watermark, dropped, join_table,
                       ad_idx, event_type, event_time, valid,
                       divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-                      view_type=view_type)
+                      view_type=view_type, stats_shards=stats_shards)
 
 
 def _fold_local(counts, window_ids, watermark, join_table,
                 ad_idx, event_type, event_time, valid,
-                *, divisor_ms: int, lateness_ms: int, view_type: int):
+                *, divisor_ms: int, lateness_ms: int, view_type: int,
+                stats_shards: int = 0):
     """The collective-free shard-local fold over an already-replicated
     batch.  Returns ``(counts, ids, wm, wanted_n, counted_local)``;
     the caller merges ``counted_local`` with a campaign-axis psum —
     either per batch (``_fold_core``) or ONCE per dispatch (the hoisted
     scan: psum is linear over int32 sums, so deferring the merge is
-    bit-identical)."""
+    bit-identical).  ``stats_shards > 0`` (the obs shard-skew arm)
+    appends replicated ``[S]`` per-shard (wanted, routed) row
+    histograms — see :func:`_shard_hist`."""
     Cl, W = counts.shape
 
     campaign = join_table[ad_idx]                 # [B] gather-join
@@ -217,42 +232,57 @@ def _fold_local(counts, window_ids, watermark, join_table,
 
     wanted_n = jnp.sum(wanted.astype(jnp.int32))
     counted_local = jnp.sum(in_shard.astype(jnp.int32))
-    return new_counts, new_ids, new_wm, wanted_n, counted_local
+    base = (new_counts, new_ids, new_wm, wanted_n, counted_local)
+    if not stats_shards:
+        return base
+    # per-shard skew stats (replicated, no collectives): `wanted` rows
+    # by owning shard and `count_mask` rows by owning shard — the
+    # second sums to the psum'd `counted`, so drops reconcile per shard
+    wanted_s = _shard_hist(campaign, wanted, Cl, stats_shards)
+    routed_s = _shard_hist(campaign, count_mask, Cl, stats_shards)
+    return base + (wanted_s, routed_s)
 
 
 def _fold_core(counts, window_ids, watermark, dropped, join_table,
                ad_idx, event_type, event_time, valid,
-               *, divisor_ms: int, lateness_ms: int, view_type: int):
+               *, divisor_ms: int, lateness_ms: int, view_type: int,
+               stats_shards: int = 0):
     """The shard-local fold over an already-replicated batch."""
-    new_counts, new_ids, new_wm, wanted_n, counted_local = _fold_local(
-        counts, window_ids, watermark, join_table,
-        ad_idx, event_type, event_time, valid,
-        divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-        view_type=view_type)
+    new_counts, new_ids, new_wm, wanted_n, counted_local, *stats = \
+        _fold_local(
+            counts, window_ids, watermark, join_table,
+            ad_idx, event_type, event_time, valid,
+            divisor_ms=divisor_ms, lateness_ms=lateness_ms,
+            view_type=view_type, stats_shards=stats_shards)
     counted = jax.lax.psum(counted_local, CAMPAIGN_AXIS)
     new_dropped = dropped + wanted_n - counted
-    return new_counts, new_ids, new_wm, new_dropped
+    return (new_counts, new_ids, new_wm, new_dropped) + tuple(stats)
 
 
 @functools.lru_cache(maxsize=None)
 def _build_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                view_type: int):
-    """Compile-cached sharded step for one mesh + static params."""
+                view_type: int, stats: bool = False):
+    """Compile-cached sharded step for one mesh + static params.
+    ``stats=True`` (the obs shard-skew arm) appends two replicated
+    ``[S]`` per-shard (wanted, routed) row histograms to the outputs."""
 
     n_data = mesh.shape[DATA_AXIS]
+    n_stats = mesh.shape[CAMPAIGN_AXIS] if stats else 0
 
     def body(counts, window_ids, watermark, dropped, join_table,
              ad_idx, event_type, event_time, valid):
         return _fold_one(counts, window_ids, watermark, dropped, join_table,
                          ad_idx, event_type, event_time, valid,
                          divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-                         view_type=view_type, n_data=n_data)
+                         view_type=view_type, n_data=n_data,
+                         stats_shards=n_stats)
 
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
+        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P())
+        + ((P(), P()) if stats else ()),
     )
     # Donating the counts shard is what makes the scatter-add in place:
     # without it every batch copies the whole [Cl, W] key space.
@@ -261,7 +291,8 @@ def _build_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
 
 @functools.lru_cache(maxsize=None)
 def _build_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                view_type: int, hoist: bool = True):
+                view_type: int, hoist: bool = True,
+                stats: bool = False):
     """Compile-cached scanned sharded step: fold [K, B] stacked batches in
     one dispatch (the multi-device peer of ``ops.windowcount.scan_steps``).
 
@@ -273,9 +304,15 @@ def _build_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
     the gather has no carry dependence and the psum is linear
     (integer sums are exact and associative).  ``hoist=False`` keeps
     the original per-batch collectives — the measured baseline arm
-    (``bench_multichip.py``) and the equivalence oracle in tests."""
+    (``bench_multichip.py``) and the equivalence oracle in tests.
+    ``stats=True`` (hoisted arm only) rides per-batch ``[S]`` per-shard
+    (wanted, routed) histograms out of the scan ys and appends their
+    dispatch sums to the outputs."""
 
     n_data = mesh.shape[DATA_AXIS]
+    n_stats = mesh.shape[CAMPAIGN_AXIS] if stats else 0
+    if stats and not hoist:
+        raise ValueError("shard stats ride the hoisted scan only")
 
     def body_per_batch(counts, window_ids, watermark, dropped, join_table,
                        ad_idx, event_type, event_time, valid):
@@ -304,56 +341,69 @@ def _build_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
         def one(carry, xs):
             c, ids, wm = carry
             a, e, t, v = xs
-            c, ids, wm, wn, cl = _fold_local(
+            c, ids, wm, wn, cl, *st = _fold_local(
                 c, ids, wm, join_table, a, e, t, v > 0,
                 divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-                view_type=view_type)
-            return (c, ids, wm), (wn, cl)
+                view_type=view_type, stats_shards=n_stats)
+            return (c, ids, wm), (wn, cl) + tuple(st)
 
-        (c, ids, wm), (wn, cl) = jax.lax.scan(
+        (c, ids, wm), ys = jax.lax.scan(
             one, (counts, window_ids, watermark), (ad, et, tm, va))
+        wn, cl = ys[0], ys[1]
         new_dropped = dropped + jnp.sum(wn) - jax.lax.psum(
             jnp.sum(cl), CAMPAIGN_AXIS)
-        return c, ids, wm, new_dropped
+        out = (c, ids, wm, new_dropped)
+        if n_stats:
+            # [K, S] per-batch shard histograms -> one [S] dispatch sum
+            out += (jnp.sum(ys[2], axis=0), jnp.sum(ys[3], axis=0))
+        return out
 
     mapped = shard_map(
         body_hoisted if hoist else body_per_batch, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
                   P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(None, DATA_AXIS), P(None, DATA_AXIS)),
-        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
+        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P())
+        + ((P(), P()) if stats else ()),
     )
     return jax.jit(mapped, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
 def _build_step_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                       view_type: int):
+                       view_type: int, stats: bool = False):
     """``_build_step`` consuming (packed, event_time) wire columns."""
     n_data = mesh.shape[DATA_AXIS]
+    n_stats = mesh.shape[CAMPAIGN_AXIS] if stats else 0
 
     def body(counts, window_ids, watermark, dropped, join_table,
              packed, event_time):
         return _fold_one_packed(
             counts, window_ids, watermark, dropped, join_table,
             packed, event_time, divisor_ms=divisor_ms,
-            lateness_ms=lateness_ms, view_type=view_type, n_data=n_data)
+            lateness_ms=lateness_ms, view_type=view_type, n_data=n_data,
+            stats_shards=n_stats)
 
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
                   P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
+        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P())
+        + ((P(), P()) if stats else ()),
     )
     return jax.jit(mapped, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
 def _build_scan_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                       view_type: int, hoist: bool = True):
+                       view_type: int, hoist: bool = True,
+                       stats: bool = False):
     """``_build_scan`` consuming [K, B] (packed, event_time) columns:
     2 gathers + 1 psum per dispatch hoisted, K * 3 per-batch."""
     n_data = mesh.shape[DATA_AXIS]
+    n_stats = mesh.shape[CAMPAIGN_AXIS] if stats else 0
+    if stats and not hoist:
+        raise ValueError("shard stats ride the hoisted scan only")
 
     def body_per_batch(counts, window_ids, watermark, dropped, join_table,
                        packed, event_time):
@@ -381,23 +431,28 @@ def _build_scan_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
             # unpack AFTER the gather, identically on every device;
             # per-batch elementwise work, no collectives in the body
             a, e, v = wc.unpack_columns(p)
-            c, ids, wm, wn, cl = _fold_local(
+            c, ids, wm, wn, cl, *st = _fold_local(
                 c, ids, wm, join_table, a, e, t, v,
                 divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-                view_type=view_type)
-            return (c, ids, wm), (wn, cl)
+                view_type=view_type, stats_shards=n_stats)
+            return (c, ids, wm), (wn, cl) + tuple(st)
 
-        (c, ids, wm), (wn, cl) = jax.lax.scan(
+        (c, ids, wm), ys = jax.lax.scan(
             one, (counts, window_ids, watermark), (pk, tm))
+        wn, cl = ys[0], ys[1]
         new_dropped = dropped + jnp.sum(wn) - jax.lax.psum(
             jnp.sum(cl), CAMPAIGN_AXIS)
-        return c, ids, wm, new_dropped
+        out = (c, ids, wm, new_dropped)
+        if n_stats:
+            out += (jnp.sum(ys[2], axis=0), jnp.sum(ys[3], axis=0))
+        return out
 
     mapped = shard_map(
         body_hoisted if hoist else body_per_batch, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P(), P(),
                   P(None, DATA_AXIS), P(None, DATA_AXIS)),
-        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P()),
+        out_specs=(P(CAMPAIGN_AXIS, None), P(), P(), P())
+        + ((P(), P()) if stats else ()),
     )
     return jax.jit(mapped, donate_argnums=(0,))
 
@@ -459,52 +514,80 @@ class ShardedWindowEngine(AdAnalyticsEngine):
             dropped=jax.device_put(jnp.int32(dropped), rep),
         )
 
+    def _stats_on(self) -> bool:
+        """Shard-skew stats arm: only when attach_obs handed over a
+        ShardSkew tracker (jax.obs.shard).  The off path dispatches the
+        EXACT pre-existing kernels — stats variants are separate
+        compiled programs, so the default output stays byte-identical."""
+        return self._obs_shard is not None
+
+    def _note_shard(self, out) -> tuple:
+        """Peel + accumulate the trailing (wanted_s, routed_s) stats
+        outputs when the skew tracker is attached."""
+        if self._obs_shard is None:
+            return out
+        self._obs_shard.note(out[-2], out[-1])
+        return out[:-2]
+
     def _device_step(self, batch) -> None:
+        stats = self._stats_on()
         if self._pack_ok:
             fn = _build_step_packed(self.mesh, self.divisor, self.lateness,
-                                    0)
+                                    0, stats)
             packed = wc.pack_columns(batch.ad_idx, batch.event_type,
                                      batch.valid)
             packed, tm = pad_data_cols(self._data_pad, packed,
                                        batch.event_time)
-            counts, ids, wm, dropped = fn(
+            counts, ids, wm, dropped = self._note_shard(fn(
                 self.state.counts, self.state.window_ids,
                 self.state.watermark, self.state.dropped, self.join_table,
-                packed, tm)
+                packed, tm))
             self.state = WindowState(counts, ids, wm, dropped)
             return
         ad, et, tm, va = pad_data_cols(
             self._data_pad, batch.ad_idx, batch.event_type,
             batch.event_time, batch.valid)
+        if stats:
+            fn = _build_step(self.mesh, self.divisor, self.lateness, 0,
+                             True)
+            counts, ids, wm, dropped = self._note_shard(fn(
+                self.state.counts, self.state.window_ids,
+                self.state.watermark, self.state.dropped,
+                self.join_table, ad, et, tm, va))
+            self.state = WindowState(counts, ids, wm, dropped)
+            return
         self.state = sharded_step(
             self.mesh, self.state, self.join_table, ad, et, tm, va,
             divisor_ms=self.divisor, lateness_ms=self.lateness)
 
     def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
-        fn = _build_scan(self.mesh, self.divisor, self.lateness, 0)
+        fn = _build_scan(self.mesh, self.divisor, self.lateness, 0,
+                         True, self._stats_on())
         ad_idx, event_type, event_time, valid = pad_data_cols(
             self._data_pad, ad_idx, event_type, event_time, valid)
-        counts, ids, wm, dropped = fn(
+        counts, ids, wm, dropped = self._note_shard(fn(
             self.state.counts, self.state.window_ids, self.state.watermark,
             self.state.dropped, self.join_table,
-            ad_idx, event_type, event_time, valid)
+            ad_idx, event_type, event_time, valid))
         self.state = WindowState(counts, ids, wm, dropped)
 
     def _device_scan_packed(self, packed, event_time) -> None:
-        fn = _build_scan_packed(self.mesh, self.divisor, self.lateness, 0)
+        fn = _build_scan_packed(self.mesh, self.divisor, self.lateness, 0,
+                                True, self._stats_on())
         packed, event_time = pad_data_cols(self._data_pad, packed,
                                            event_time)
-        counts, ids, wm, dropped = fn(
+        counts, ids, wm, dropped = self._note_shard(fn(
             self.state.counts, self.state.window_ids, self.state.watermark,
-            self.state.dropped, self.join_table, packed, event_time)
+            self.state.dropped, self.join_table, packed, event_time))
         self.state = WindowState(counts, ids, wm, dropped)
 
     # ------------------------------------------------------------------
     # collective-cost accounting (parallel.collectives)
     def attach_obs(self, registry, lifecycle: bool = False,
-                   spans=None, occupancy=None) -> None:
+                   spans=None, occupancy=None, xfer=None,
+                   shard=None) -> None:
         super().attach_obs(registry, lifecycle, spans=spans,
-                           occupancy=occupancy)
+                           occupancy=occupancy, xfer=xfer, shard=shard)
         self._obs_reg = registry
 
     def collective_report(self, k: int | None = None) -> dict:

@@ -108,7 +108,8 @@ def _gather_cols(*cols):
 
 def _hll_fold_local(registers, window_ids, watermark, join_table,
                     ad, user, et, tm, v,
-                    *, divisor_ms: int, lateness_ms: int, view_type: int):
+                    *, divisor_ms: int, lateness_ms: int, view_type: int,
+                    stats_shards: int = 0):
     """The collective-free HLL fold over already-replicated columns.
     Returns ``(registers, ids, wm, wanted_n, counted_local)``; the
     caller psums ``counted_local`` over the campaign axis — per batch
@@ -145,87 +146,113 @@ def _hll_fold_local(registers, window_ids, watermark, join_table,
 
     wanted_n = jnp.sum(wanted.astype(jnp.int32))
     counted_local = jnp.sum(in_shard.astype(jnp.int32))
-    return new_regs, new_ids, new_wm, wanted_n, counted_local
+    base = (new_regs, new_ids, new_wm, wanted_n, counted_local)
+    if not stats_shards:
+        return base
+    # per-shard skew stats (obs.xfer.ShardSkew): replicated [S]
+    # histograms by owning shard — see parallel.sharded._shard_hist
+    from streambench_tpu.parallel.sharded import _shard_hist
+
+    wanted_s = _shard_hist(campaign, wanted, Cl, stats_shards)
+    routed_s = _shard_hist(campaign, count_mask, Cl, stats_shards)
+    return base + (wanted_s, routed_s)
 
 
 def _hll_fold(registers, window_ids, watermark, dropped, join_table,
               ad_idx, user_idx, event_type, event_time, valid,
-              *, divisor_ms: int, lateness_ms: int, view_type: int):
+              *, divisor_ms: int, lateness_ms: int, view_type: int,
+              stats_shards: int = 0):
     """One batch folded into a campaign shard, written against shard-local
     views inside ``shard_map``.  Batch columns arrive data-sharded and are
     gathered here, so every value derived from them is replicated and the
     ring claim / watermark / drop math needs no further collectives."""
     ad, user, et, tm, v = _gather_cols(ad_idx, user_idx, event_type,
                                        event_time, valid)
-    new_regs, new_ids, new_wm, wanted_n, counted_local = _hll_fold_local(
-        registers, window_ids, watermark, join_table, ad, user, et, tm, v,
-        divisor_ms=divisor_ms, lateness_ms=lateness_ms, view_type=view_type)
+    new_regs, new_ids, new_wm, wanted_n, counted_local, *stats = \
+        _hll_fold_local(
+            registers, window_ids, watermark, join_table,
+            ad, user, et, tm, v, divisor_ms=divisor_ms,
+            lateness_ms=lateness_ms, view_type=view_type,
+            stats_shards=stats_shards)
     counted = jax.lax.psum(counted_local, CAMPAIGN_AXIS)
     new_dropped = dropped + wanted_n - counted
-    return new_regs, new_ids, new_wm, new_dropped
+    return (new_regs, new_ids, new_wm, new_dropped) + tuple(stats)
 
 
 def _hll_fold_packed(registers, window_ids, watermark, dropped, join_table,
                      packed, user_idx, event_time,
-                     *, divisor_ms: int, lateness_ms: int, view_type: int):
+                     *, divisor_ms: int, lateness_ms: int, view_type: int,
+                     stats_shards: int = 0):
     """``_hll_fold`` consuming the packed wire word: three data-axis
     gathers per batch (packed, user, time) instead of five — the ISSUE 7
     wire packing, extended to the sketch engines.  Unpacks AFTER the
     gather, so every device decodes identical replicated words."""
     pk, user, tm = _gather_cols(packed, user_idx, event_time)
     ad, et, v = wc.unpack_columns(pk)
-    new_regs, new_ids, new_wm, wanted_n, counted_local = _hll_fold_local(
-        registers, window_ids, watermark, join_table, ad, user, et, tm, v,
-        divisor_ms=divisor_ms, lateness_ms=lateness_ms, view_type=view_type)
+    new_regs, new_ids, new_wm, wanted_n, counted_local, *stats = \
+        _hll_fold_local(
+            registers, window_ids, watermark, join_table,
+            ad, user, et, tm, v, divisor_ms=divisor_ms,
+            lateness_ms=lateness_ms, view_type=view_type,
+            stats_shards=stats_shards)
     counted = jax.lax.psum(counted_local, CAMPAIGN_AXIS)
     new_dropped = dropped + wanted_n - counted
-    return new_regs, new_ids, new_wm, new_dropped
+    return (new_regs, new_ids, new_wm, new_dropped) + tuple(stats)
 
 
 @functools.lru_cache(maxsize=None)
 def _build_hll_step(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                    view_type: int):
+                    view_type: int, stats: bool = False):
+    n_stats = mesh.shape[CAMPAIGN_AXIS] if stats else 0
+
     def body(registers, window_ids, watermark, dropped, join_table,
              ad_idx, user_idx, event_type, event_time, valid):
         return _hll_fold(registers, window_ids, watermark, dropped,
                          join_table, ad_idx, user_idx, event_type,
                          event_time, valid, divisor_ms=divisor_ms,
-                         lateness_ms=lateness_ms, view_type=view_type)
+                         lateness_ms=lateness_ms, view_type=view_type,
+                         stats_shards=n_stats)
 
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P(), P(),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS)),
-        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
+        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P())
+        + ((P(), P()) if stats else ()),
     )
     return jax.jit(mapped)
 
 
 @functools.lru_cache(maxsize=None)
 def _build_hll_step_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                           view_type: int):
+                           view_type: int, stats: bool = False):
     """``_build_hll_step`` consuming (packed, user_idx, event_time) wire
     columns: three data-axis gathers per step instead of five."""
+    n_stats = mesh.shape[CAMPAIGN_AXIS] if stats else 0
+
     def body(registers, window_ids, watermark, dropped, join_table,
              packed, user_idx, event_time):
         return _hll_fold_packed(registers, window_ids, watermark, dropped,
                                 join_table, packed, user_idx, event_time,
                                 divisor_ms=divisor_ms,
                                 lateness_ms=lateness_ms,
-                                view_type=view_type)
+                                view_type=view_type,
+                                stats_shards=n_stats)
 
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P(), P(),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
+        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P())
+        + ((P(), P()) if stats else ()),
     )
     return jax.jit(mapped)
 
 
 def _hll_scan_hoisted(join_table, state4, cols, *, divisor_ms: int,
-                      lateness_ms: int, view_type: int, packed: bool):
+                      lateness_ms: int, view_type: int, packed: bool,
+                      stats_shards: int = 0):
     """Shared hoisted-scan core: ``cols`` are ALREADY-GATHERED ``[K, B]``
     stacks; the scan body is collective-free and the drop-counter psum
     merges once after the scan (bit-identical — psum is linear)."""
@@ -234,7 +261,8 @@ def _hll_scan_hoisted(join_table, state4, cols, *, divisor_ms: int,
     # Per-batch (wanted, counted_local) ride the scan's ys — see
     # parallel.sharded._build_scan: int32 sums are exact and
     # associative, so summing after the scan and psum-ing ONCE is
-    # bit-identical to the per-batch merges.
+    # bit-identical to the per-batch merges.  The shard-skew [S]
+    # histograms (stats arm) ride the same ys.
     def one(carry, xs):
         regs, ids, wm = carry
         if packed:
@@ -242,22 +270,27 @@ def _hll_scan_hoisted(join_table, state4, cols, *, divisor_ms: int,
             a, e, v = wc.unpack_columns(p)
         else:
             a, u, e, t, v = xs
-        regs, ids, wm, wn, cl = _hll_fold_local(
+        regs, ids, wm, wn, cl, *st = _hll_fold_local(
             regs, ids, wm, join_table, a, u, e, t, v,
             divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-            view_type=view_type)
-        return (regs, ids, wm), (wn, cl)
+            view_type=view_type, stats_shards=stats_shards)
+        return (regs, ids, wm), (wn, cl) + tuple(st)
 
-    (regs, ids, wm), (wn, cl) = jax.lax.scan(
+    (regs, ids, wm), ys = jax.lax.scan(
         one, (registers, window_ids, watermark), cols)
+    wn, cl = ys[0], ys[1]
     new_dropped = dropped + jnp.sum(wn) - jax.lax.psum(jnp.sum(cl),
                                                        CAMPAIGN_AXIS)
-    return regs, ids, wm, new_dropped
+    out = (regs, ids, wm, new_dropped)
+    if stats_shards:
+        out += (jnp.sum(ys[2], axis=0), jnp.sum(ys[3], axis=0))
+    return out
 
 
 @functools.lru_cache(maxsize=None)
 def _build_hll_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                    view_type: int, hoist: bool = True):
+                    view_type: int, hoist: bool = True,
+                    stats: bool = False):
     """Scanned sharded HLL: fold ``[K, B]`` stacked batches in one
     dispatch (the catchup hot path, peer of
     ``parallel.sharded._build_scan``).  ``hoist=True`` (the engine
@@ -265,6 +298,9 @@ def _build_hll_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
     drop counter once after the scan — 6 collectives per dispatch
     instead of K * 6; ``hoist=False`` keeps the per-batch collectives
     (the measured baseline arm and the equivalence oracle in tests)."""
+    n_stats = mesh.shape[CAMPAIGN_AXIS] if stats else 0
+    if stats and not hoist:
+        raise ValueError("shard stats ride the hoisted scan only")
 
     def body_per_batch(registers, window_ids, watermark, dropped,
                        join_table, ad_idx, user_idx, event_type,
@@ -290,7 +326,7 @@ def _build_hll_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
         return _hll_scan_hoisted(
             join_table, (registers, window_ids, watermark, dropped), cols,
             divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-            view_type=view_type, packed=False)
+            view_type=view_type, packed=False, stats_shards=n_stats)
 
     mapped = shard_map(
         body_hoisted if hoist else body_per_batch, mesh=mesh,
@@ -298,16 +334,21 @@ def _build_hll_scan(mesh: Mesh, divisor_ms: int, lateness_ms: int,
                   P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(None, DATA_AXIS)),
-        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
+        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P())
+        + ((P(), P()) if stats else ()),
     )
     return jax.jit(mapped)
 
 
 @functools.lru_cache(maxsize=None)
 def _build_hll_scan_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
-                           view_type: int, hoist: bool = True):
+                           view_type: int, hoist: bool = True,
+                           stats: bool = False):
     """``_build_hll_scan`` over ``[K, B]`` (packed, user_idx, event_time)
     stacks: 3 gathers + 1 psum per dispatch hoisted, K * 4 per-batch."""
+    n_stats = mesh.shape[CAMPAIGN_AXIS] if stats else 0
+    if stats and not hoist:
+        raise ValueError("shard stats ride the hoisted scan only")
 
     def body_per_batch(registers, window_ids, watermark, dropped,
                        join_table, packed, user_idx, event_time):
@@ -330,14 +371,15 @@ def _build_hll_scan_packed(mesh: Mesh, divisor_ms: int, lateness_ms: int,
         return _hll_scan_hoisted(
             join_table, (registers, window_ids, watermark, dropped), cols,
             divisor_ms=divisor_ms, lateness_ms=lateness_ms,
-            view_type=view_type, packed=True)
+            view_type=view_type, packed=True, stats_shards=n_stats)
 
     mapped = shard_map(
         body_hoisted if hoist else body_per_batch, mesh=mesh,
         in_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P(), P(),
                   P(None, DATA_AXIS), P(None, DATA_AXIS),
                   P(None, DATA_AXIS)),
-        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P()),
+        out_specs=(P(CAMPAIGN_AXIS, None, None), P(), P(), P())
+        + ((P(), P()) if stats else ()),
     )
     return jax.jit(mapped)
 
@@ -385,6 +427,11 @@ class ShardedHLLEngine(HLLDistinctEngine):
     the [C, W] estimates of closed windows.
     """
 
+    # Unlike the single-device sketch step, the sharded HLL step DOES
+    # pack the wire word when eligible (_build_hll_step_packed) — keeps
+    # the transfer ledger's per-format attribution honest.
+    STEP_PACKS = True
+
     def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
                  mesh: Mesh, campaigns: list[str] | None = None,
                  redis: RedisLike | None = None, registers: int = 128,
@@ -403,56 +450,81 @@ class ShardedHLLEngine(HLLDistinctEngine):
             jnp.asarray(self.encoder.join_table),
             NamedSharding(mesh, P()))
 
+    def _stats_on(self) -> bool:
+        """Shard-skew stats arm (jax.obs.shard) — see
+        ``ShardedWindowEngine._stats_on``: separate compiled programs,
+        default output byte-identical."""
+        return self._obs_shard is not None
+
+    def _note_shard(self, out) -> tuple:
+        if self._obs_shard is None:
+            return out
+        self._obs_shard.note(out[-2], out[-1])
+        return out[:-2]
+
     def _device_step(self, batch) -> None:
+        stats = self._stats_on()
         if self._pack_ok:
             fn = _build_hll_step_packed(self.mesh, self.divisor,
-                                        self.lateness, 0)
+                                        self.lateness, 0, stats)
             packed = wc.pack_columns(batch.ad_idx, batch.event_type,
                                      batch.valid)
             packed, user, tm = pad_data_cols(
                 self._data_pad, packed, batch.user_idx, batch.event_time)
-            regs, ids, wm, dropped = fn(
+            regs, ids, wm, dropped = self._note_shard(fn(
                 self.state.registers, self.state.window_ids,
                 self.state.watermark, self.state.dropped, self.join_table,
-                packed, user, tm)
+                packed, user, tm))
             self.state = hll.HLLState(regs, ids, wm, dropped)
             return
         ad, user, et, tm, va = pad_data_cols(
             self._data_pad, batch.ad_idx, batch.user_idx,
             batch.event_type, batch.event_time, batch.valid)
+        if stats:
+            fn = _build_hll_step(self.mesh, self.divisor, self.lateness,
+                                 0, True)
+            regs, ids, wm, dropped = self._note_shard(fn(
+                self.state.registers, self.state.window_ids,
+                self.state.watermark, self.state.dropped,
+                self.join_table, ad, user, et, tm, va))
+            self.state = hll.HLLState(regs, ids, wm, dropped)
+            return
         self.state = sharded_hll_step(
             self.mesh, self.state, self.join_table, ad, user, et, tm, va,
             divisor_ms=self.divisor, lateness_ms=self.lateness)
 
     def _device_scan(self, ad_idx, user_idx, event_type, event_time,
                      valid) -> None:
-        fn = _build_hll_scan(self.mesh, self.divisor, self.lateness, 0)
+        fn = _build_hll_scan(self.mesh, self.divisor, self.lateness, 0,
+                             True, self._stats_on())
         ad_idx, user_idx, event_type, event_time, valid = pad_data_cols(
             self._data_pad, ad_idx, user_idx, event_type, event_time,
             valid)
-        regs, ids, wm, dropped = fn(
+        regs, ids, wm, dropped = self._note_shard(fn(
             self.state.registers, self.state.window_ids,
             self.state.watermark, self.state.dropped, self.join_table,
-            ad_idx, user_idx, event_type, event_time, valid)
+            ad_idx, user_idx, event_type, event_time, valid))
         self.state = hll.HLLState(regs, ids, wm, dropped)
 
     def _device_scan_packed(self, packed, user_idx, event_time) -> None:
         """The packed wire word, extended to the sharded sketch engine
         (ISSUE 7): 3 stacked columns gather per dispatch instead of 5."""
         fn = _build_hll_scan_packed(self.mesh, self.divisor,
-                                    self.lateness, 0)
+                                    self.lateness, 0, True,
+                                    self._stats_on())
         packed, user_idx, event_time = pad_data_cols(
             self._data_pad, packed, user_idx, event_time)
-        regs, ids, wm, dropped = fn(
+        regs, ids, wm, dropped = self._note_shard(fn(
             self.state.registers, self.state.window_ids,
             self.state.watermark, self.state.dropped, self.join_table,
-            packed, user_idx, event_time)
+            packed, user_idx, event_time))
         self.state = hll.HLLState(regs, ids, wm, dropped)
 
     def attach_obs(self, registry, lifecycle: bool = False,
-                   spans=None, occupancy=None) -> None:
+                   spans=None, occupancy=None, xfer=None,
+                   shard=None) -> None:
         super().attach_obs(registry, lifecycle, spans=spans,
-                           occupancy=occupancy)
+                           occupancy=occupancy, xfer=xfer, shard=shard)
         self._obs_reg = registry
 
     def collective_report(self, k: int | None = None) -> dict:

@@ -119,14 +119,15 @@ class Tracer:
 
 @contextlib.contextmanager
 def device_trace(logdir: str | None):
-    """Capture a ``jax.profiler`` trace under ``logdir`` (no-op if None)."""
-    if not logdir:
-        yield
-        return
-    import jax
+    """Capture a ``jax.profiler`` trace under ``logdir`` (no-op if None).
 
-    jax.profiler.start_trace(logdir)
-    try:
+    Delegates to ``obs.capture.profiler_window`` — the ONE profiler
+    start/stop path in the repo, shared with the triggered-capture
+    manager (``jax.profiler`` is process-global; two entry points with
+    their own state could double-start and crash the run).  If a
+    triggered capture already owns the profiler, this trace is skipped
+    rather than raised."""
+    from streambench_tpu.obs.capture import profiler_window
+
+    with profiler_window(logdir):
         yield
-    finally:
-        jax.profiler.stop_trace()

@@ -1,0 +1,147 @@
+"""Triggered profiler capture (ISSUE 9 tentpole, obs.capture): an SLO
+breach transition fires a bounded capture that really writes a trace on
+the CPU backend, cooldown/cap suppress repeat triggers, and
+trace.device_trace shares the ONE process-global profiler path."""
+
+import os
+import pathlib
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from streambench_tpu.obs import CaptureManager, MetricsRegistry, SloTracker
+from streambench_tpu.obs.capture import profiler_window
+
+
+def _wait_idle(cm, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while cm.active is not None:
+        if time.monotonic() > deadline:
+            raise AssertionError("capture never finished")
+        time.sleep(0.05)
+
+
+def _capture_files(d):
+    return [p for p in pathlib.Path(d).rglob("*") if p.is_file()]
+
+
+def test_slo_breach_triggers_nonempty_capture(tmp_path):
+    """Drive the PR 8 burn-rate tracker into breach with a capture
+    manager attached: the breach TRANSITION starts a profiler window
+    and the capture dir ends up non-empty on the CPU backend."""
+    clock = {"t": 0.0}
+    reg = MetricsRegistry()
+    cm = CaptureManager(str(tmp_path), cooldown_s=60, max_captures=3,
+                        window_s=0.3, registry=reg)
+    slo = SloTracker(reg, p99_ms=100, budget=0.5, fast_s=3, slow_s=6,
+                     capture=cm, clock=lambda: clock["t"])
+    hist = reg.histogram(
+        "streambench_window_latency_ms",
+        "window writeback latency (time_updated - window_ts), ms")
+    for _ in range(8):
+        clock["t"] += 1
+        hist.observe(10_000)             # way over the objective
+        slo.collect({}, 1.0)
+        # device work while the window is open -> a non-empty trace
+        jax.block_until_ready(jax.jit(lambda x: x * 2)(jnp.ones(512)))
+    assert slo.breaches == 1
+    assert len(cm.captures) == 1
+    rec = cm.captures[0]
+    assert rec["reason"] == "slo_breach"
+    assert os.path.basename(rec["dir"]).startswith("xprof_")
+    _wait_idle(cm)
+    cm.close()
+    assert _capture_files(rec["dir"]), "trace dir is empty"
+    assert reg.counter("streambench_captures_total").value == 1
+
+
+def test_cooldown_suppresses_second_capture(tmp_path):
+    clock = {"t": 0.0}
+    reg = MetricsRegistry()
+    cm = CaptureManager(str(tmp_path), cooldown_s=30, max_captures=5,
+                        window_s=0.2, registry=reg,
+                        clock=lambda: clock["t"])
+    d1 = cm.trigger("slo_breach")
+    assert d1 is not None
+    # while the window is still open every trigger is suppressed
+    assert cm.trigger("slo_breach") is None
+    _wait_idle(cm)
+    clock["t"] += 5.0                    # inside the 30 s cooldown
+    assert cm.trigger("slo_breach") is None
+    assert cm.suppressed == 2
+    assert reg.counter(
+        "streambench_captures_suppressed_total").value == 2
+    clock["t"] += 30.0                   # cooldown elapsed
+    d2 = cm.trigger("slo_breach")
+    assert d2 is not None and d2 != d1
+    _wait_idle(cm)
+    cm.close()
+    assert len(cm.captures) == 2
+
+
+def test_max_captures_cap_and_summary(tmp_path):
+    clock = {"t": 0.0}
+    cm = CaptureManager(str(tmp_path), cooldown_s=0, max_captures=2,
+                        window_s=0.2, clock=lambda: clock["t"])
+    annotations = []
+    cm.annotate = lambda ev, **kw: annotations.append((ev, kw))
+    for i in range(4):
+        cm.trigger(f"r{i}")
+        _wait_idle(cm)
+        clock["t"] += 1.0
+    s = cm.summary()
+    assert len(s["captures"]) == 2       # the cap held
+    assert cm.suppressed == 2
+    assert s["max_captures"] == 2 and s["window_s"] == 0.2
+    assert [ev for ev, _ in annotations] == ["profiler_capture"] * 2
+    cm.close()
+
+
+def test_device_trace_delegates_to_shared_profiler_path(tmp_path):
+    """trace.device_trace and the capture manager share one profiler
+    lock: a whole-run trace still works alone, and while a triggered
+    capture owns the profiler the run-level trace SKIPS instead of
+    crashing the run (jax.profiler raises on double-start)."""
+    from streambench_tpu.trace import device_trace
+
+    solo = tmp_path / "solo"
+    with device_trace(str(solo)):
+        jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.ones(256)))
+    assert _capture_files(solo), "run-level trace wrote nothing"
+    # None stays a no-op
+    with device_trace(None):
+        pass
+
+    cm = CaptureManager(str(tmp_path), cooldown_s=0, max_captures=1,
+                        window_s=0.5)
+    d = cm.trigger("busy")
+    assert d is not None
+    nested = tmp_path / "nested"
+    with device_trace(str(nested)):      # profiler busy -> silent skip
+        jax.block_until_ready(jax.jit(lambda x: x - 1)(jnp.ones(256)))
+    assert not nested.exists() or not _capture_files(nested)
+    _wait_idle(cm)
+    cm.close()
+    assert _capture_files(d)
+
+
+def test_profiler_window_nested_is_noop_not_crash(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    with profiler_window(a):
+        with profiler_window(b):         # second start: skipped
+            jax.block_until_ready(jnp.ones(8) * 3)
+    assert _capture_files(a)
+    assert not pathlib.Path(b).exists() or not _capture_files(b)
+
+
+def test_close_stops_inflight_capture(tmp_path):
+    cm = CaptureManager(str(tmp_path), cooldown_s=0, max_captures=1,
+                        window_s=30.0)   # would outlive the test
+    d = cm.trigger("slow")
+    assert d is not None and cm.active == d
+    jax.block_until_ready(jax.jit(lambda x: x * 7)(jnp.ones(128)))
+    cm.close()                           # stop NOW, not in 30 s
+    assert cm.active is None
+    assert _capture_files(d), "closed capture dropped its trace"

@@ -1,0 +1,159 @@
+"""Device-memory ledger (ISSUE 9 tentpole, obs.devmem): XLA
+memory_analysis sums vs hand-computed shapes for a tiny kernel, the
+per-engine peak-footprint estimate, the fails-closed kernel hook, the
+live-array census, and the sampler collector cadence."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from streambench_tpu.obs import DeviceMemoryLedger
+from streambench_tpu.obs.devmem import (
+    kernel_memory,
+    live_array_census,
+    state_nbytes,
+)
+
+
+def test_kernel_memory_matches_hand_computed_shapes():
+    """A [1024] f32 + [1024] f32 -> [1024] f32 kernel: XLA's own
+    argument/output accounting must equal the dtype arithmetic."""
+    f = jax.jit(lambda x, y: x + y)
+    x = jnp.ones(1024, jnp.float32)
+    rep = kernel_memory(f, x, x)
+    if not rep["supported"]:
+        pytest.skip(f"memory_analysis unsupported: {rep['error']}")
+    assert rep["argument_bytes"] == 2 * 1024 * 4
+    assert rep["output_bytes"] == 1024 * 4
+    assert rep["total_bytes"] == (rep["argument_bytes"]
+                                  + rep["output_bytes"]
+                                  + rep.get("temp_bytes", 0))
+
+
+def test_kernel_memory_static_kwargs_and_failure_shape():
+    g = jax.jit(lambda x, *, k: x * k, static_argnames=("k",))
+    rep = kernel_memory(g, jnp.ones(16, jnp.int32), k=3)
+    if rep["supported"]:
+        assert rep["argument_bytes"] == 16 * 4
+    # a kernel that cannot lower never raises into obs callers
+    bad = kernel_memory(jax.jit(lambda x: x), "not-an-array")
+    assert bad["supported"] is False and "error" in bad
+
+
+def test_state_nbytes_over_pytree():
+    state = {"a": jnp.zeros((4, 8), jnp.int32),
+             "b": (jnp.zeros(3, jnp.float32), None, 7)}
+    # non-array leaves (None, ints) contribute nothing
+    assert state_nbytes(state) == 4 * 8 * 4 + 3 * 4
+    assert state_nbytes(None) == 0
+
+
+def test_live_array_census_sees_new_arrays():
+    before = live_array_census()
+    if not before.get("supported"):
+        pytest.skip(f"live_arrays unsupported: {before.get('error')}")
+    keep = [jnp.ones(2048, jnp.float32) for _ in range(3)]
+    jax.block_until_ready(keep)
+    after = live_array_census()
+    assert after["count"] >= before["count"] + 3
+    assert after["bytes"] >= before["bytes"] + 3 * 2048 * 4
+    # the [2048] f32 arrays land in the 8192-byte power-of-two bucket
+    b = after["buckets"].get("8192")
+    assert b is not None and b["count"] >= 3
+    del keep
+
+
+def test_ledger_peak_footprint_and_gauges():
+    from streambench_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    led = DeviceMemoryLedger(reg, census_every=2)
+    led.state_bytes = 1000
+    led.kernels["small"] = {"supported": True, "total_bytes": 50}
+    led.kernels["big"] = {"supported": True, "total_bytes": 700}
+    led.kernels["broken"] = {"supported": False, "error": "nope"}
+    # peak = persistent state + the LARGEST single kernel working set
+    assert led.peak_footprint_bytes() == 1700
+    rec: dict = {}
+    led.collect(rec, 1.0)                # tick 0: census refreshed
+    assert rec["devmem"]["peak_footprint_bytes"] == 1700
+    assert rec["devmem"]["state_bytes"] == 1000
+    census0 = rec["devmem"].get("live")
+    rec2: dict = {}
+    led.collect(rec2, 1.0)               # tick 1: census NOT refreshed
+    assert rec2["devmem"].get("live") is census0
+    if census0 and census0.get("supported"):
+        assert reg.gauge(
+            "streambench_devmem_live_arrays").value == census0["count"]
+
+
+def test_analyze_engine_real_kernels(tmp_path):
+    """On a real exact-count engine the ledger reports every hot kernel
+    with XLA's accounting, and the step kernel's argument bytes are
+    exactly state + join table + the packed wire columns."""
+    import random
+
+    from streambench_tpu.config import default_config
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.engine import AdAnalyticsEngine
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.journal import FileBroker
+    from streambench_tpu.io.redis_schema import as_redis, seed_campaigns
+
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(as_redis(FakeRedisStore()), cfg, broker=broker,
+                 events_num=200, rng=random.Random(5),
+                 workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    r = as_redis(FakeRedisStore())
+    seed_campaigns(r, sorted(set(mapping.values())))
+    engine = AdAnalyticsEngine(cfg, mapping, redis=r)
+    led = DeviceMemoryLedger()
+    rep = led.analyze_engine(engine)
+    assert led.state_bytes == state_nbytes(engine.state)
+    kernels = {n: k for n, k in rep["kernels"].items()
+               if k.get("supported")}
+    if not kernels:
+        pytest.skip("memory_analysis unsupported on this backend")
+    step = kernels.get("step_packed") or kernels.get("step")
+    assert step is not None and "drain" in kernels
+    expect_cols = (2 if "step_packed" in kernels
+                   else 4) * engine.batch_size * 4
+    join_bytes = engine.join_table.nbytes
+    assert step["argument_bytes"] == (led.state_bytes + join_bytes
+                                      + expect_cols)
+    assert rep["peak_footprint_bytes"] >= led.state_bytes
+    engine.close()
+
+
+def test_devmem_kernels_hook_fails_closed(tmp_path):
+    """An engine whose device hooks the base list cannot describe (the
+    HLL sketch overrides _device_step) reports NO kernel table rather
+    than a wrong one — state + census only."""
+    import random
+
+    from streambench_tpu.config import default_config
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.engine.sketches import HLLDistinctEngine
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.journal import FileBroker
+    from streambench_tpu.io.redis_schema import as_redis
+
+    cfg = default_config(jax_batch_size=256)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(as_redis(FakeRedisStore()), cfg, broker=broker,
+                 events_num=200, rng=random.Random(6),
+                 workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    engine = HLLDistinctEngine(cfg, mapping,
+                               redis=as_redis(FakeRedisStore()))
+    assert engine._devmem_kernels() == []
+    led = DeviceMemoryLedger()
+    rep = led.analyze_engine(engine)
+    assert rep["kernels"] == {}
+    assert rep["state_bytes"] > 0        # HLL registers are real bytes
+    assert rep["peak_footprint_bytes"] == rep["state_bytes"]
+    engine.close()
