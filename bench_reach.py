@@ -1,0 +1,432 @@
+#!/usr/bin/env python3
+"""Reach-serving bench: materialize MinHash∪HLL sketches from a journal,
+verify them against exact set arithmetic, then drive a concurrent
+query storm through the pub/sub serving surface (ISSUE 10).
+
+Three rungs, each emitting a compact (<= 4096 B) single-line JSON on
+stdout (the PR 6 truncation-proof contract) with the full detail in the
+``--out`` artifact:
+
+- **small** — low cardinality (hundreds of devices/campaign): the
+  device-materialized ``[C, k]``/``[C, R]`` planes must be BIT-EXACT
+  equal to the numpy sketches computed from the oracle's exact
+  per-campaign id sets (dedup/order invariance of the streamed fold),
+  and every query's integer collision count must match the numpy
+  evaluation exactly — the "oracle-exact at small cardinality" leg.
+- **large** — >= 100k distinct devices: measured relative error vs
+  exact set arithmetic must sit inside the theoretical bounds
+  (union: 2·1.04/sqrt(R); overlap, relative to the union size:
+  1/sqrt(k) + 1.04/sqrt(R) — ~6.25% + HLL term at k=256).
+- **storm** — >= 1k concurrent queries through PubSubServer ->
+  ReachQueryServer: all queries are admitted while the server holds,
+  then the drain must take <= ceil(Q/batch) dispatches (batched
+  evaluation, never one dispatch per query), with served/shed/p99 in
+  the compact line.  A second, depth-starved server proves shed-oldest
+  under overload (shed + served == sent, shed > 0).
+
+Budget: self-caps at ``STREAMBENCH_BENCH_BUDGET_S`` (default 840 s <
+the 870 s driver kill); the large rung is skipped (recorded, never
+silent) when the envelope runs out.
+
+Usage:
+    python bench_reach.py                       # full, writes bench_reach.json
+    python bench_reach.py --smoke               # CI: small + tiny storm
+    python bench_reach.py --out REACH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+
+COMPACT_LINE_MAX = 4096
+REPO = os.path.dirname(os.path.abspath(__file__))
+_T0 = time.monotonic()
+
+
+def log(msg: str) -> None:
+    print(f"[{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def compact_line(obj: dict) -> str:
+    """One bounded stdout line: shed detail until it fits."""
+    def dump(o):
+        return json.dumps(o, separators=(",", ":"))
+
+    line = dump(obj)
+    if len(line) <= COMPACT_LINE_MAX:
+        return line
+    obj = json.loads(line)
+    for strip in ("per_query", "errors", "params", "host"):
+        obj.pop(strip, None)
+        line = dump(obj)
+        if len(line) <= COMPACT_LINE_MAX:
+            return line
+    return dump({k: obj[k] for k in ("phase", "ok") if k in obj})
+
+
+# ----------------------------------------------------------------------
+# materialize: journal -> engine -> sketch planes
+# ----------------------------------------------------------------------
+
+def make_world(workdir: str, *, campaigns_n: int, users_n: int,
+               events_n: int, seed: int):
+    """Generator-shaped journal with a custom device universe (the
+    stock do_setup pins 100 users; reach needs a configurable one)."""
+    from streambench_tpu.datagen.gen import EventSource
+    from streambench_tpu.utils.ids import make_ids
+
+    rng = random.Random(seed)
+    campaigns = make_ids(campaigns_n, rng)
+    ads = make_ids(campaigns_n * 10, rng)
+    mapping = {}
+    for i, c in enumerate(campaigns):
+        for a in ads[i * 10:(i + 1) * 10]:
+            mapping[a] = c
+    src = EventSource(ads=ads, user_ids=make_ids(users_n, rng),
+                      page_ids=make_ids(100, rng), rng=rng)
+    path = os.path.join(workdir, "reach-journal.txt")
+    start = 1_700_000_000_000
+    with open(path, "wb") as f:
+        batch = 100_000
+        for base in range(0, events_n, batch):
+            hi = min(base + batch, events_n)
+            ts = start + 10 * np.arange(base, hi, dtype=np.int64)
+            blob = src.events_blob_at(ts)
+            if blob is not None:
+                f.write(blob)
+            else:
+                f.write(b"".join(src.event_at(int(t)).encode() + b"\n"
+                                 for t in ts))
+    return campaigns, mapping, path
+
+
+def materialize(path: str, mapping: dict, campaigns: list, *,
+                k: int, registers: int, batch: int = 8192):
+    """Fold the journal through a ReachSketchEngine (block ingest where
+    the native encoder is built, line fallback otherwise)."""
+    from streambench_tpu.config import default_config
+    from streambench_tpu.engine.sketches import ReachSketchEngine
+
+    cfg = default_config(jax_num_campaigns=len(campaigns),
+                         jax_batch_size=batch)
+    eng = ReachSketchEngine(cfg, mapping, campaigns=campaigns,
+                            redis=None, k=k, registers=registers)
+    eng.warmup()
+    t0 = time.monotonic()
+    with open(path, "rb") as f:
+        carry = b""
+        while True:
+            data = f.read(4 << 20)
+            if not data:
+                break
+            data = carry + data
+            nl = data.rfind(b"\n") + 1
+            carry = data[nl:]
+            eng.process_block(data[:nl])
+        if carry:
+            eng.process_block(carry + b"\n")
+    eng.flush(final=True)
+    wall = time.monotonic() - t0
+    return eng, wall
+
+
+def oracle_world(path: str, mapping: dict, campaigns: list):
+    from streambench_tpu.reach import oracle as ro
+
+    with open(path, "rb") as f:
+        return ro.campaign_user_sets(f, mapping, campaigns)
+
+
+# ----------------------------------------------------------------------
+# query workloads
+# ----------------------------------------------------------------------
+
+def make_queries(campaigns: list, n: int, seed: int):
+    rng = np.random.default_rng(seed)
+    C = len(campaigns)
+    masks = np.zeros((n, C), bool)
+    overlap = np.zeros(n, bool)
+    for i in range(n):
+        m = int(rng.integers(1, 6))
+        masks[i, rng.choice(C, size=min(m, C), replace=False)] = True
+        overlap[i] = bool(rng.integers(0, 2))
+    return masks, overlap
+
+
+def error_stats(est, masks, overlap, sets, campaigns, *, k, R):
+    """Measured relative errors vs exact set arithmetic (union relative
+    to truth; overlap relative to the union size — the Jaccard
+    estimator's natural scale)."""
+    from streambench_tpu.reach import oracle as ro
+
+    u_err, o_err = [], []
+    for i in range(masks.shape[0]):
+        sel = [campaigns[j] for j in range(masks.shape[1]) if masks[i, j]]
+        op = "overlap" if overlap[i] else "union"
+        truth, true_union = ro.exact_counts(sets, sel, op)
+        if overlap[i]:
+            o_err.append(abs(float(est[i]) - truth) / max(true_union, 1))
+        else:
+            u_err.append(abs(float(est[i]) - truth) / max(truth, 1))
+    return (dict(mean=float(np.mean(u_err)), max=float(np.max(u_err)),
+                 n=len(u_err)),
+            dict(mean=float(np.mean(o_err)), max=float(np.max(o_err)),
+                 n=len(o_err)))
+
+
+# ----------------------------------------------------------------------
+# rungs
+# ----------------------------------------------------------------------
+
+def run_verify(workdir: str, *, name: str, campaigns_n: int, users_n: int,
+               events_n: int, k: int, registers: int, queries_n: int,
+               seed: int, bitexact: bool) -> dict:
+    from streambench_tpu.reach import oracle as ro
+    from streambench_tpu.reach import query as rq
+
+    campaigns, mapping, path = make_world(
+        workdir, campaigns_n=campaigns_n, users_n=users_n,
+        events_n=events_n, seed=seed)
+    eng, mat_wall = materialize(path, mapping, campaigns,
+                                k=k, registers=registers)
+    names = list(eng.encoder.campaigns)
+    sets = oracle_world(path, mapping, names)
+    distinct = len(set().union(*sets.values())) if sets else 0
+    out = {"phase": name, "events": eng.events_processed,
+           "distinct_devices": distinct, "k": k, "registers": registers,
+           "materialize_s": round(mat_wall, 2),
+           "materialize_ev_s": int(eng.events_processed
+                                   / max(mat_wall, 1e-9))}
+    assert eng.events_processed == events_n, (eng.events_processed,
+                                              events_n)
+    if bitexact:
+        em, er = ro.expected_state(sets, names, k, registers)
+        assert (np.asarray(eng.state.mins) == em).all(), \
+            "device mins != set-arithmetic oracle sketch"
+        assert (np.asarray(eng.state.registers) == er).all(), \
+            "device registers != set-arithmetic oracle sketch"
+        out["sketch_bitexact"] = True
+    masks, overlap = make_queries(names, queries_n, seed + 1)
+    counter = rq.DispatchCounter()
+    est, union, jacc, agree = rq.query_chunks(
+        eng.state.mins, eng.state.registers, masks, overlap,
+        counter=counter)
+    out["queries"] = queries_n
+    out["query_dispatches"] = counter.dispatches
+    assert counter.dispatches == math.ceil(queries_n / rq.DEFAULT_BATCH)
+    if bitexact:
+        oa = ro.query_oracle_np(np.asarray(eng.state.mins),
+                                np.asarray(eng.state.registers), masks)
+        assert (agree == oa).all(), "device collision counts != oracle"
+        out["queries_bitexact"] = True
+        out["oracle"] = "exact"
+    u_err, o_err = error_stats(est, masks, overlap, sets, names,
+                               k=k, R=registers)
+    ub = 2 * 1.04 / math.sqrt(registers)
+    ob = 1.0 / math.sqrt(k) + 1.04 / math.sqrt(registers)
+    out["union_rel_err"] = {**u_err, "bound": round(ub, 4)}
+    out["overlap_rel_err_vs_union"] = {**o_err, "bound": round(ob, 4)}
+    if name == "large":
+        assert distinct >= 100_000, distinct
+        assert u_err["mean"] <= ub, (u_err, ub)
+        assert o_err["mean"] <= ob, (o_err, ob)
+        out["error_within_bounds"] = True
+    out["ok"] = True
+    return out, eng, names, sets
+
+
+def run_storm(eng, names, *, queries_n: int, clients: int, depth: int,
+              batch: int, expect_shed: bool, phase: str) -> dict:
+    from streambench_tpu.dimensions.pubsub import PubSubClient, PubSubServer
+    from streambench_tpu.reach.serve import ReachQueryServer
+
+    srv = ReachQueryServer(names, depth=depth, batch=batch, hold=True)
+    eng.attach_reach(srv)
+    ps = PubSubServer(port=0).start()
+    ps.register_query("reach", srv.handle)
+    host, port = ps.address
+    per = queries_n // clients
+    results: list = [None] * clients
+    rng = np.random.default_rng(1234)
+    picks = [
+        [list(rng.choice(len(names), size=int(rng.integers(1, 5)),
+                         replace=False)) for _ in range(per)]
+        for _ in range(clients)]
+
+    def run_client(ci: int) -> None:
+        c = PubSubClient(host, port, timeout_s=120)
+        t0s = {}
+        for qi, sel in enumerate(picks[ci]):
+            qid = ci * per + qi
+            t0s[qid] = time.monotonic()
+            c.request({"type": "reach",
+                       "campaigns": [names[j] for j in sel],
+                       "op": "overlap" if qid % 2 else "union",
+                       "id": qid})
+        got = []
+        for _ in range(per):
+            m = c.recv()["data"]
+            got.append((m, time.monotonic() - t0s.get(m.get("id"), _T0)))
+        results[ci] = got
+        c.close()
+
+    threads = [threading.Thread(target=run_client, args=(ci,))
+               for ci in range(clients)]
+    t_sub = time.monotonic()
+    for t in threads:
+        t.start()
+    # every query admitted (or shed) before the drain starts: the
+    # dispatch-count acceptance is about BATCHED evaluation of a
+    # standing backlog of concurrent queries
+    deadline = time.monotonic() + 120
+    want_pending = queries_n if not expect_shed else depth
+    while (srv.pending() < want_pending
+           and srv.pending() + srv.shed < queries_n
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    submit_s = time.monotonic() - t_sub
+    t_drain = time.monotonic()
+    srv.resume()
+    for t in threads:
+        t.join(timeout=120)
+    drain_s = time.monotonic() - t_drain
+    summary = srv.summary()
+    ps.close()
+    srv.close()
+    answers = [m for got in results if got for m, _ in got]
+    assert len(answers) == clients * per, (len(answers), clients * per)
+    served = [m for m in answers if "estimate" in m]
+    shed = [m for m in answers if m.get("shed")]
+    assert len(served) == summary["served"]
+    assert len(served) + len(shed) == clients * per
+    out = {"phase": phase, "sent": clients * per, "clients": clients,
+           "served": summary["served"], "shed": summary["shed"],
+           "dispatches": summary["dispatches"], "batch": batch,
+           "queue_depth": depth,
+           "submit_s": round(submit_s, 2),
+           "drain_s": round(drain_s, 2),
+           "p50_ms": summary.get("p50_ms"),
+           "p99_ms": summary.get("p99_ms"),
+           "qps": round(summary["served"] / max(drain_s, 1e-9), 1)}
+    if expect_shed:
+        assert summary["shed"] > 0, summary
+    else:
+        assert summary["shed"] == 0, summary
+        assert summary["served"] == clients * per
+        # the acceptance number: a standing storm of Q queries drains
+        # in at most ceil(Q/batch) dispatches, never one per query
+        assert summary["dispatches"] <= math.ceil(
+            (clients * per) / batch), summary
+        assert all(m["epoch"] == eng.reach_epoch for m in served)
+    out["ok"] = True
+    return out
+
+
+# ----------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI: small rung + tiny storm only")
+    ap.add_argument("--out", default="bench_reach.json")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args()
+    budget_s = float(os.environ.get("STREAMBENCH_BENCH_BUDGET_S", "840"))
+    deadline = _T0 + budget_s
+
+    import tempfile
+    workdir = args.workdir or tempfile.mkdtemp(prefix="bench-reach-")
+    os.makedirs(workdir, exist_ok=True)
+
+    import jax
+    doc: dict = {
+        "schema": "REACH", "smoke": bool(args.smoke),
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+        "cpus": os.cpu_count(),
+        "budget_s": budget_s,
+    }
+    ok = True
+
+    # -- small rung: bit-exact vs exact set arithmetic ------------------
+    small, eng_s, names_s, _ = run_verify(
+        workdir, name="small", campaigns_n=40, users_n=500,
+        events_n=50_000, k=256, registers=256, queries_n=256,
+        seed=17, bitexact=True)
+    doc["small"] = small
+    print(compact_line(small), flush=True)
+    log(f"small rung ok: bit-exact, {small['distinct_devices']} devices")
+
+    # -- large rung + storm --------------------------------------------
+    if args.smoke:
+        storm = run_storm(eng_s, names_s, queries_n=60, clients=2,
+                          depth=256, batch=32, expect_shed=False,
+                          phase="storm")
+        doc["storm"] = storm
+        print(compact_line(storm), flush=True)
+        shed = run_storm(eng_s, names_s, queries_n=120, clients=2,
+                         depth=16, batch=16, expect_shed=True,
+                         phase="shed")
+        doc["shed"] = shed
+        print(compact_line(shed), flush=True)
+    elif time.monotonic() > deadline - 120:
+        doc["large"] = {"skipped": "budget"}
+        doc["storm"] = {"skipped": "budget"}
+        ok = False
+        log("budget exhausted before the large rung — recorded, not silent")
+    else:
+        large, eng_l, names_l, _ = run_verify(
+            workdir, name="large", campaigns_n=100, users_n=130_000,
+            events_n=600_000, k=256, registers=1024, queries_n=512,
+            seed=23, bitexact=True)
+        doc["large"] = large
+        print(compact_line(large), flush=True)
+        log(f"large rung ok: {large['distinct_devices']} distinct devices, "
+            f"union err {large['union_rel_err']['mean']:.4f} "
+            f"overlap err {large['overlap_rel_err_vs_union']['mean']:.4f}")
+        storm = run_storm(eng_l, names_l, queries_n=1200, clients=6,
+                          depth=2048, batch=256, expect_shed=False,
+                          phase="storm")
+        assert storm["served"] >= 1000
+        doc["storm"] = storm
+        print(compact_line(storm), flush=True)
+        log(f"storm ok: {storm['served']} served in "
+            f"{storm['dispatches']} dispatches, p99 {storm['p99_ms']} ms")
+        shed = run_storm(eng_l, names_l, queries_n=300, clients=2,
+                         depth=64, batch=64, expect_shed=True,
+                         phase="shed")
+        doc["shed"] = shed
+        print(compact_line(shed), flush=True)
+        log(f"shed rung ok: {shed['shed']} shed of {shed['sent']}")
+
+    # regress-gate keys (obs/regress.py normalize_bench reads doc.reach)
+    storm_doc = doc.get("storm") or {}
+    if storm_doc.get("ok"):
+        doc["reach"] = {"qps": storm_doc["qps"],
+                        "p99_ms": storm_doc["p99_ms"]}
+    doc["ok"] = ok and all(
+        (doc.get(p) or {}).get("ok") for p in
+        (("small", "storm", "shed") if args.smoke
+         else ("small", "large", "storm", "shed")))
+    doc["wall_s"] = round(time.monotonic() - _T0, 1)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(compact_line({"phase": "summary", "ok": doc["ok"],
+                        "wall_s": doc["wall_s"],
+                        "reach": doc.get("reach"),
+                        "out": args.out}), flush=True)
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

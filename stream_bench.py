@@ -48,7 +48,7 @@ SHARDED = os.environ.get("SHARDED", "") not in ("", "0", "false", "no")
 STOP_STATS_GRACE_S = float(os.environ.get("STOP_STATS_GRACE", "2.5"))
 # Engine selection (BASELINE configs #1-#4) + execution-mode knobs, the
 # peer of the reference harness driving every engine (stream-bench.sh:286-343)
-ENGINE = os.environ.get("ENGINE", "exact")   # exact|hll|sliding|session
+ENGINE = os.environ.get("ENGINE", "exact")   # exact|hll|sliding|session|reach
 MICROBATCH = os.environ.get("MICROBATCH", "") not in ("", "0", "false", "no")
 CHECKPOINT_DIR = os.environ.get("CHECKPOINT_DIR", "")
 # Real-Kafka opt-in: "host:9092[,host2:9092]" routes every broker through
@@ -153,6 +153,20 @@ def _pidfile(name: str) -> str:
     return os.path.join(PID_DIR, f"{name}.pid")
 
 
+def _proc_starttime(pid: int) -> str | None:
+    """Kernel start time of ``pid`` (/proc stat field 22) — the
+    pid-match half of stop_if_needed: a recycled pid belongs to a
+    DIFFERENT process exactly when its start time differs, so STOP
+    never kills a process it didn't start (the reference's pid_match
+    greps argv, stream-bench.sh:42-46; start time is exact where argv
+    can collide)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().rsplit(")", 1)[1].split()[19]
+    except (OSError, IndexError):
+        return None
+
+
 def _alive(pid: int) -> bool:
     # Reap if it's our own child (else an exited child stays a zombie and
     # would look alive to kill(pid, 0) forever).
@@ -174,10 +188,19 @@ def _alive(pid: int) -> bool:
 def running_pid(name: str) -> int | None:
     try:
         with open(_pidfile(name)) as f:
-            pid = int(f.read().strip())
-    except (FileNotFoundError, ValueError):
+            fields = f.read().split()
+            pid = int(fields[0])
+            started = fields[1] if len(fields) > 1 else None
+    except (FileNotFoundError, ValueError, IndexError):
         return None
-    return pid if _alive(pid) else None
+    if not _alive(pid):
+        return None
+    # pid-match: a pidfile written with a start time only matches the
+    # process that still carries it — a recycled pid reads as "not
+    # running" instead of being adopted (or killed) by mistake
+    if started is not None and _proc_starttime(pid) != started:
+        return None
+    return pid
 
 
 def start_if_needed(name: str, argv: list[str]) -> int:
@@ -192,7 +215,10 @@ def start_if_needed(name: str, argv: list[str]) -> int:
     proc = subprocess.Popen(argv, cwd=REPO_ROOT, stdout=logf, stderr=logf,
                             env=env, start_new_session=True)
     with open(_pidfile(name), "w") as f:
-        f.write(str(proc.pid))
+        # pid + kernel start time: STOP only ever signals the exact
+        # process this harness started (see _proc_starttime)
+        started = _proc_starttime(proc.pid)
+        f.write(f"{proc.pid} {started}" if started else str(proc.pid))
     log(f"started {name} (pid {proc.pid})")
     return proc.pid
 
@@ -298,10 +324,43 @@ def op_setup() -> None:
         "native encoder build failed (python encoder will be used)")
 
 
+def _external_redis_marker() -> str:
+    return os.path.join(PID_DIR, "redis.external")
+
+
+def _redis_alive(timeout_s: float = 1.0) -> bool:
+    """Health-check PING against REDIS_HOST:REDIS_PORT (no spawn)."""
+    sys.path.insert(0, REPO_ROOT)
+    from streambench_tpu.io.resp import RespClient
+    try:
+        with RespClient(REDIS_HOST, REDIS_PORT,
+                        timeout_s=timeout_s) as c:
+            return c.ping() == "PONG"
+    except OSError:
+        return False
+
+
 def op_start_redis() -> None:
-    start_if_needed("redis", _py("streambench_tpu.io.fakeredis",
-                                 "--host", REDIS_HOST,
-                                 "--port", str(REDIS_PORT)))
+    # External-Redis drive mode (ROADMAP item 5): redis.host/redis.port
+    # pointing at an ALREADY-RUNNING server is adopted via a PING
+    # health check instead of spawning a second one; a marker file
+    # records the adoption so STOP leaves a server this harness never
+    # started strictly alone (the spawn path's pidfile carries a
+    # pid+starttime match for the same reason).
+    if running_pid("redis") is None and _redis_alive():
+        os.makedirs(PID_DIR, exist_ok=True)
+        with open(_external_redis_marker(), "w") as f:
+            f.write(f"{REDIS_HOST}:{REDIS_PORT}\n")
+        log(f"redis already serving at {REDIS_HOST}:{REDIS_PORT} "
+            "(external; adopted via PING, will not be stopped)")
+    else:
+        try:
+            os.remove(_external_redis_marker())
+        except FileNotFoundError:
+            pass
+        start_if_needed("redis", _py("streambench_tpu.io.fakeredis",
+                                     "--host", REDIS_HOST,
+                                     "--port", str(REDIS_PORT)))
     _wait_redis()
     # seed campaigns, like `lein run -n` right after redis start
     # (stream-bench.sh:182-186).  A checkpoint-resume run must NOT
@@ -330,6 +389,16 @@ def _wait_redis(timeout_s: float = 15.0) -> None:
 
 
 def op_stop_redis() -> None:
+    marker = _external_redis_marker()
+    if os.path.exists(marker):
+        try:
+            with open(marker) as f:
+                where = f.read().strip()
+        finally:
+            os.remove(marker)
+        log(f"external redis at {where} left running "
+            "(not started by this harness)")
+        return
     stop_if_needed("redis")
 
 
@@ -457,10 +526,10 @@ def op_jax_test() -> None:
     # A composite test that produced load but measured NOTHING is a
     # failure (observed: a stale hung engine from a crashed previous run
     # was reused via its pidfile and the test "passed" with zero
-    # windows), not a quiet success.  The session engine writes no
-    # canonical window rows, so its evidence is the engine's own final
-    # stats line instead of seen.txt.
-    if ENGINE == "session":
+    # windows), not a quiet success.  The session and reach engines
+    # write no canonical window rows, so their evidence is the engine's
+    # own final stats line instead of seen.txt.
+    if ENGINE in ("session", "reach"):
         evidence, what = "", "events"
         try:
             with open(os.path.join(LOG_DIR, "engine.log")) as f:

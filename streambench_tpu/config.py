@@ -221,6 +221,18 @@ class BenchmarkConfig:
     jax_obs_capture_window_s: float = 3.0  # seconds each capture records
     jax_obs_capture_oneshot: bool = False  # fire one capture at startup
     #   (smoke tests / "trace the warm ramp" runs)
+    # --- live reach-query serving (reach/; ISSUE 10 — the MinHash∪HLL
+    # audience-overlap engine behind the pubsub/store surface) ---
+    jax_reach_k: int = 256                 # MinHash signature slots per
+    #   campaign ([C, k] running minima); the overlap estimate's
+    #   relative-to-union error is ~1/sqrt(k) (6.25% at the default)
+    jax_reach_queue_depth: int = 512       # bounded reach-query queue:
+    #   beyond this depth the OLDEST pending query is shed (answered
+    #   {"shed": true}, streambench_reach_shed_total counts it)
+    jax_reach_slo_p99_ms: int = 0          # >0: reach-serving latency
+    #   objective — a served query slower than this (submit -> reply)
+    #   is "bad"; judged by the same two-window burn-rate machinery as
+    #   jax.slo.p99.ms, surfaced under objective="reach"
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -377,6 +389,10 @@ class BenchmarkConfig:
             jax_obs_capture_window_s=max(
                 getf("jax.obs.capture.window.s", 3.0), 0.1),
             jax_obs_capture_oneshot=getb("jax.obs.capture.oneshot", False),
+            jax_reach_k=max(geti("jax.reach.k", 256), 1),
+            jax_reach_queue_depth=max(
+                geti("jax.reach.queue.depth", 512), 1),
+            jax_reach_slo_p99_ms=max(geti("jax.reach.slo.p99.ms", 0), 0),
             raw=dict(conf),
         )
 

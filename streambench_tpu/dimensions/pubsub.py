@@ -324,10 +324,21 @@ class _Handler(socketserver.StreamRequestHandler):
             for msg in self._messages(_SockStream(self.connection)):
                 if not isinstance(msg, dict):
                     continue  # '5' / '[1,2]' are valid JSON, not messages
+                kind = msg.get("type")
+                qfn = server._query_handler(kind)
+                if qfn is not None:
+                    # registered query verb (e.g. "reach"): the handler
+                    # enqueues and replies LATER from its worker thread
+                    # — send() serializes under _wlock, so replies can
+                    # interleave with pub/sub traffic safely.  The
+                    # topic defaults to the verb name; the reply rides
+                    # the normal data-message shape.
+                    self._answer_query(server, qfn, msg,
+                                       str(msg.get("topic") or kind))
+                    continue
                 topic = str(msg.get("topic", ""))
                 if not topic:
                     continue
-                kind = msg.get("type")
                 if kind == "subscribe":
                     my_topics.add(topic)
                     server._subscribe(topic, self)
@@ -340,6 +351,27 @@ class _Handler(socketserver.StreamRequestHandler):
         finally:
             for t in my_topics:
                 server._unsubscribe(t, self)
+
+    def _answer_query(self, server: "PubSubServer", qfn, msg: dict,
+                      topic: str) -> None:
+        """Route one query-verb message; the handler's reply callback
+        writes a standard data message back on THIS connection (from
+        whatever thread answers).  Handler errors are contained — a bad
+        query must never tear down the pub/sub connection."""
+
+        def reply(data) -> None:
+            payload = (json.dumps({"type": "data", "topic": topic,
+                                   "data": data},
+                                  separators=(",", ":")) + "\n").encode()
+            self.send(payload)
+
+        try:
+            qfn(msg, reply)
+        except Exception:
+            try:
+                reply({"error": "query_failed"})
+            except Exception:
+                pass
 
     def send_raw(self, data: bytes) -> bool:
         # serialize writers: publish() runs on engine threads while the
@@ -374,6 +406,9 @@ class PubSubServer:
         self._srv = _Server((host, port), _Handler)
         self._srv.pubsub = self  # type: ignore[attr-defined]
         self._subs: dict[str, set[_Handler]] = {}
+        # query verbs (e.g. "reach"): message type -> fn(msg, reply);
+        # the gateway's request/response half next to topic pub/sub
+        self._queries: dict[str, object] = {}
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
@@ -385,6 +420,22 @@ class PubSubServer:
     def start(self) -> "PubSubServer":
         self._thread.start()
         return self
+
+    def register_query(self, kind: str, fn) -> None:
+        """Register a query verb: messages with ``type == kind`` are
+        routed to ``fn(msg, reply)`` instead of the pub/sub arms.
+        Reserved types (subscribe/unsubscribe/publish/data) refuse."""
+        if kind in ("subscribe", "unsubscribe", "publish", "data"):
+            raise ValueError(f"query verb {kind!r} shadows the pub/sub "
+                             "protocol")
+        with self._lock:
+            self._queries[str(kind)] = fn
+
+    def _query_handler(self, kind):
+        if not self._queries:   # fast path: no verbs registered
+            return None
+        with self._lock:
+            return self._queries.get(kind)
 
     def _subscribe(self, topic: str, h: _Handler) -> None:
         with self._lock:
@@ -462,6 +513,12 @@ class WebSocketClient:
     def publish(self, topic: str, data) -> None:
         self._send({"type": "publish", "topic": topic, "data": data})
 
+    def request(self, msg: dict) -> None:
+        """Send a query-verb message (e.g. ``{"type": "reach",
+        "campaigns": [...], "op": "union"}``); the answer arrives as a
+        normal data message via ``recv()``."""
+        self._send(msg)
+
     def _send(self, msg: dict) -> None:
         self._file.write(ws_encode(json.dumps(msg).encode(), mask=True))
         self._file.flush()
@@ -523,6 +580,11 @@ class PubSubClient:
 
     def unsubscribe(self, topic: str) -> None:
         self._send({"type": "unsubscribe", "topic": topic})
+
+    def request(self, msg: dict) -> None:
+        """Send a query-verb message; the answer arrives as a normal
+        data message via ``recv()``."""
+        self._send(msg)
 
     def _send(self, msg: dict) -> None:
         self._file.write(json.dumps(msg).encode() + b"\n")

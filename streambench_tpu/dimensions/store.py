@@ -20,9 +20,12 @@ This peer is an append-only JSON-lines log plus an in-memory index:
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 from typing import Iterator
+
+import numpy as np
 
 from streambench_tpu.metrics import LatencyTracker
 from streambench_tpu.utils.ids import now_ms
@@ -43,6 +46,10 @@ class DurableDimensionStore:
                                       ignore_first=ignore_first)
         self._sync_every = max(sync_every, 0)
         self._since_sync = 0
+        # latest materialized reach-sketch record (reach/; ISSUE 10):
+        # {"mins": [C,k] uint32, "registers": [C,R] int32,
+        #  "campaigns": [...], "epoch": int, "_updated": ms} or None
+        self._reach: dict | None = None
         if os.path.exists(self.path):
             self._replay()
         self._f = open(self.path, "a", encoding="utf-8")
@@ -64,6 +71,44 @@ class DurableDimensionStore:
             os.fsync(self._f.fileno())
             self._since_sync = 0
         return len(rows)
+
+    def put_reach_sketches(self, mins: np.ndarray, registers: np.ndarray,
+                           campaigns: list[str], epoch: int,
+                           update_time_ms: int | None = None) -> None:
+        """Materialize the reach sketch planes (reach/; ISSUE 10) as one
+        durable log record, so a reopened store can serve audience
+        queries without re-folding the journal.  Latest record wins on
+        replay; ``compact`` keeps only it."""
+        stamp = now_ms() if update_time_ms is None else update_time_ms
+        mins = np.ascontiguousarray(mins, dtype=np.uint32)
+        regs = np.ascontiguousarray(registers, dtype=np.int32)
+        rec = {"kind": "reach_sketch", "t": stamp, "epoch": int(epoch),
+               "c": list(campaigns),
+               "k": int(mins.shape[1]), "r": int(regs.shape[1]),
+               "mins": base64.b64encode(mins.tobytes()).decode(),
+               "regs": base64.b64encode(regs.tobytes()).decode()}
+        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._absorb_reach(rec)
+
+    def _absorb_reach(self, rec: dict) -> None:
+        try:
+            c = list(rec["c"])
+            k, r = int(rec["k"]), int(rec["r"])
+            mins = np.frombuffer(base64.b64decode(rec["mins"]),
+                                 np.uint32).reshape(len(c), k)
+            regs = np.frombuffer(base64.b64decode(rec["regs"]),
+                                 np.int32).reshape(len(c), r)
+        except (KeyError, ValueError, TypeError):
+            return   # torn/corrupt sketch record: keep the previous one
+        self._reach = {"mins": mins, "registers": regs, "campaigns": c,
+                       "epoch": int(rec.get("epoch", 0)),
+                       "_updated": int(rec.get("t", 0))}
+
+    def reach_sketches(self) -> dict | None:
+        """Latest materialized reach-sketch record (or None)."""
+        return self._reach
 
     # -- read path -----------------------------------------------------
     def get(self, key: str, bucket_ms: int) -> dict | None:
@@ -92,6 +137,9 @@ class DurableDimensionStore:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # torn tail record from a crash mid-append
+                if rec.get("kind") == "reach_sketch":
+                    self._absorb_reach(rec)
+                    continue
                 self.index[(rec["k"], rec["b"])] = {
                     **rec["a"], "_updated": rec["t"]}
                 self.latency.record(rec["k"], rec["b"], rec["t"])
@@ -105,6 +153,18 @@ class DurableDimensionStore:
                 rec = {"k": key, "b": bucket, "t": val["_updated"],
                        "a": aggs}
                 f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            if self._reach is not None:
+                r = self._reach
+                f.write(json.dumps(
+                    {"kind": "reach_sketch", "t": r["_updated"],
+                     "epoch": r["epoch"], "c": r["campaigns"],
+                     "k": int(r["mins"].shape[1]),
+                     "r": int(r["registers"].shape[1]),
+                     "mins": base64.b64encode(
+                         r["mins"].tobytes()).decode(),
+                     "regs": base64.b64encode(
+                         r["registers"].tobytes()).decode()},
+                    separators=(",", ":")) + "\n")
             f.flush()
             os.fsync(f.fileno())
         self._f.close()

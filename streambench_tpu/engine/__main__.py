@@ -51,11 +51,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sharded", action="store_true",
                    help="run the mesh-sharded engine (jax.mesh.* config)")
     p.add_argument("--engine", default="exact",
-                   choices=("exact", "hll", "sliding", "session"),
+                   choices=("exact", "hll", "sliding", "session",
+                            "reach"),
                    help="aggregation engine: exact window counts "
                         "(default), HLL distinct users, sliding-window + "
-                        "t-digest quantiles, or session windows + "
-                        "count-min heavy hitters (BASELINE configs #1-#4)")
+                        "t-digest quantiles, session windows + "
+                        "count-min heavy hitters (BASELINE configs "
+                        "#1-#4), or cumulative MinHash∪HLL reach "
+                        "sketches served live over pub/sub (README "
+                        "\"Reach serving\")")
     p.add_argument("--checkpointDir", default=None,
                    help="enable (offset, state) snapshots here; on start, "
                         "resume from the newest one if present")
@@ -99,11 +103,11 @@ def main(argv: list[str] | None = None) -> int:
         redis = RespClient(cfg.redis_host, cfg.redis_port)
 
     if args.microbatch:
-        if args.engine in ("sliding", "session"):
+        if args.engine in ("sliding", "session", "reach"):
             raise SystemExit(
                 f"--microbatch has no count-window form of --engine "
                 f"{args.engine} (sliding needs a time axis, session a gap "
-                f"axis); supported: exact, hll")
+                f"axis, reach is cumulative); supported: exact, hll")
         from streambench_tpu.engine.microbatch import run_microbatch
 
         broker = make_broker(cfg.kafka_bootstrap_servers,
@@ -144,12 +148,14 @@ def main(argv: list[str] | None = None) -> int:
         if args.engine != "exact":
             from streambench_tpu.engine.sketches import (
                 HLLDistinctEngine,
+                ReachSketchEngine,
                 SessionCMSEngine,
                 SlidingTDigestEngine,
             )
             cls = {"hll": HLLDistinctEngine,
                    "sliding": SlidingTDigestEngine,
-                   "session": SessionCMSEngine}[args.engine]
+                   "session": SessionCMSEngine,
+                   "reach": ReachSketchEngine}[args.engine]
             return cls(cfg, mapping, campaigns=campaigns, redis=r)
         return AdAnalyticsEngine(cfg, mapping, campaigns=campaigns, redis=r)
 
@@ -235,7 +241,10 @@ def main(argv: list[str] | None = None) -> int:
     # the smoke test can scrape without a race).
     sampler = metrics_server = occupancy = slo = None
     xfer = shard = devmem = capture = None
-    slo_wanted = cfg.jax_slo_p99_ms > 0 or cfg.jax_slo_rate_evps > 0
+    registry = None
+    slo_wanted = (cfg.jax_slo_p99_ms > 0 or cfg.jax_slo_rate_evps > 0
+                  or (args.engine == "reach"
+                      and cfg.jax_reach_slo_p99_ms > 0))
     if (cfg.jax_metrics_interval_ms > 0 or cfg.jax_metrics_port >= 0
             or cfg.jax_obs_lifecycle or cfg.jax_obs_spans
             or cfg.jax_obs_occupancy or slo_wanted
@@ -326,6 +335,8 @@ def main(argv: list[str] | None = None) -> int:
             slo = SloTracker(
                 registry, p99_ms=cfg.jax_slo_p99_ms,
                 rate_evps=cfg.jax_slo_rate_evps,
+                reach_p99_ms=(cfg.jax_reach_slo_p99_ms
+                              if args.engine == "reach" else 0),
                 budget=cfg.jax_slo_budget, fast_s=cfg.jax_slo_fast_s,
                 slow_s=cfg.jax_slo_slow_s,
                 use_lifecycle=cfg.jax_obs_lifecycle,
@@ -341,6 +352,26 @@ def main(argv: list[str] | None = None) -> int:
             endpoint = f" endpoint={metrics_server.url}"
         print(f"metrics: interval={sampler.interval_ms}ms "
               f"jsonl={metrics_path}{endpoint}", flush=True)
+
+    # Live reach-query serving (reach/; --engine reach only): one
+    # pub/sub endpoint (WebSocket + JSON-lines on one port) with the
+    # "reach" query verb routed through the bounded load-shedding
+    # query server; the engine pushes sketch state at flush cadence.
+    reach_ps = reach_srv = None
+    if args.engine == "reach":
+        from streambench_tpu.dimensions.pubsub import PubSubServer
+        from streambench_tpu.reach.serve import ReachQueryServer
+
+        reach_ps = PubSubServer(port=0).start()
+        reach_srv = ReachQueryServer(
+            list(engine.encoder.campaigns),
+            depth=cfg.jax_reach_queue_depth, registry=registry)
+        reach_ps.register_query("reach", reach_srv.handle)
+        engine.attach_reach(reach_srv)
+        r_host, r_port = reach_ps.address
+        print(f"reach: pubsub={r_host}:{r_port} "
+              f"queue_depth={cfg.jax_reach_queue_depth} k={engine.k} "
+              f"registers={engine.registers}", flush=True)
 
     xo = " exactly_once=on" if cfg.jax_sink_exactly_once else ""
     print(f"engine up: topic={cfg.kafka_topic} redis={cfg.redis_host}:"
@@ -410,6 +441,13 @@ def main(argv: list[str] | None = None) -> int:
             if flightrec is not None:
                 flightrec.record("steady_compiles", count=steady)
         occupancy.close()
+    if reach_srv is not None:
+        # close (and drain) the query server BEFORE the SLO verdict:
+        # queries answered by the drain-at-close must land in the reach
+        # latency histogram the verdict judges
+        reach_srv.close()
+        stats_line["reach"] = reach_srv.summary()
+        reach_ps.close()
     if slo is not None:
         stats_line["slo"] = slo.verdict()
     if xfer is not None:

@@ -29,7 +29,7 @@ import numpy as np
 from streambench_tpu.config import BenchmarkConfig
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine
 from streambench_tpu.io.redis_schema import RedisLike
-from streambench_tpu.ops import cms, hll, session, sliding, tdigest
+from streambench_tpu.ops import cms, hll, minhash, session, sliding, tdigest
 from streambench_tpu.ops import windowcount as wc
 from streambench_tpu.utils.ids import now_ms
 
@@ -230,6 +230,152 @@ class HLLDistinctEngine(_SketchEngineBase):
                  base + wids[si].astype(np.int64) * self.divisor,
                  est[ci, si].astype(np.int64)))
         self._flush_cache = (est, wids)
+
+    @property
+    def dropped(self) -> int:
+        return int(self.state.dropped)
+
+
+class ReachSketchEngine(_SketchEngineBase):
+    """Cumulative per-campaign reach sketches: MinHash signature + HLL
+    plane, served live (ISSUE 10 / ROADMAP item 4).
+
+    Unlike every windowed engine, reach state is *cumulative audience*:
+    there is no ring, no lateness cutoff, and nothing is ever dropped —
+    ``flush()`` writes no canonical window rows (like the session
+    engine) and instead pushes the current sketch planes to an attached
+    :class:`reach.serve.ReachQueryServer` so concurrent
+    union/intersection/overlap queries evaluate against materialized
+    state.  ``close()`` additionally writes per-campaign reach
+    estimates to ``<redis.hashtable>_reach``.
+    """
+
+    ENGINE_FAMILY = "reach"
+    # Reach consumes user identity only through hashes (exactly the HLL
+    # rationale): stateless crc32 ids, parallel encode pool sound, no
+    # intern tables in snapshots.
+    HASHED_IDS = True
+    NEEDS_INTERNED_IDS = False
+    PARALLEL_ENCODE_OK = True
+    SCAN_SUPPORTED = True
+    SCAN_COLUMNS = ("ad_idx", "user_idx", "event_type", "event_time",
+                    "valid")
+    PACKED_EXTRA_COLS = ("user_idx",)
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 k: int | None = None, registers: int = 256,
+                 input_format: str = "json"):
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, input_format=input_format)
+        self.k = int(k if k is not None else cfg.jax_reach_k)
+        self.registers = int(registers)
+        self.state = minhash.init_state(self.encoder.num_campaigns,
+                                        self.k, self.registers)
+        # Cumulative sketches have no ring to overrun: disable the span
+        # guard (same rule as the session engine) so catchup chunks
+        # never fall back to the per-batch fold for nothing.
+        self._span_guard = 2**31 - 1
+        # Query-serving attachment (reach/serve.py): None until
+        # attach_reach — the fold hot path pays one None check per
+        # flush, nothing per batch.
+        self._reach_server = None
+        # Epoch of the served state: bumped on every restore so a
+        # post-resume answer is distinguishable from a stale one (the
+        # chaos sweep's "never return stale-epoch estimates" check).
+        self.reach_epoch = 0
+
+    def _device_step(self, batch) -> None:
+        self.state = minhash.step(
+            self.state, self.join_table,
+            jnp.asarray(batch.ad_idx), jnp.asarray(batch.user_idx),
+            jnp.asarray(batch.event_type), jnp.asarray(batch.event_time),
+            jnp.asarray(batch.valid))
+
+    def _device_scan(self, ad_idx, user_idx, event_type, event_time,
+                     valid) -> None:
+        self.state = minhash.scan_steps(
+            self.state, self.join_table, ad_idx, user_idx, event_type,
+            event_time, valid)
+
+    def _device_scan_packed(self, packed, user_idx, event_time) -> None:
+        self.state = minhash.scan_steps_packed(
+            self.state, self.join_table, packed, user_idx, event_time)
+
+    # -- serving -------------------------------------------------------
+    def attach_reach(self, server) -> None:
+        """Wire a ReachQueryServer: immediate initial push (possibly
+        empty state — queries answer 0 until events fold), then a fresh
+        push on every flush and on restore."""
+        self._reach_server = server
+        self._reach_push()
+
+    def _reach_push(self) -> None:
+        if self._reach_server is not None:
+            self._reach_server.update_state(
+                self.state.mins, self.state.registers, self.reach_epoch)
+
+    # -- harness hooks -------------------------------------------------
+    def _drain_device(self) -> None:
+        # nothing to drain: sketches are cumulative, estimates are read
+        # (not reset) at flush/close
+        self._span_start = None
+
+    def flush(self, time_updated: int | None = None, *,
+              final: bool = False) -> int:
+        self._reach_push()
+        return 0   # reach has no canonical window rows
+
+    def estimates(self) -> np.ndarray:
+        """Per-campaign distinct-device estimates ``[C]`` (HLL plane)."""
+        return np.asarray(minhash.estimate(self.state.registers))
+
+    def snapshot(self, offset: int):
+        from streambench_tpu.checkpoint import Snapshot
+
+        self._snapshot_sync()
+        meta = self._snapshot_meta()
+        meta.update(reach_k=self.k, num_registers=self.registers,
+                    reach_epoch=self.reach_epoch)
+        return self._xo_decorate(Snapshot(
+            offset=offset, meta=meta,
+            counts=np.zeros((0, 0), np.int32),
+            window_ids=np.zeros((0,), np.int32),  # no window ring
+            watermark=int(self.state.watermark),
+            dropped=int(self.state.dropped),
+            extra={"mh_mins": np.asarray(self.state.mins),
+                   "hll_plane": np.asarray(self.state.registers),
+                   **self._intern_extra()},
+        ))
+
+    def restore(self, snap) -> None:
+        self._check_geometry(snap, extra=dict(
+            reach_k=self.k, num_registers=self.registers))
+        self.state = minhash.ReachState(
+            mins=jnp.asarray(snap.extra["mh_mins"]),
+            registers=jnp.asarray(snap.extra["hll_plane"]),
+            watermark=jnp.int32(snap.watermark),
+            dropped=jnp.int32(snap.dropped))
+        self._restore_interns(snap)
+        self._restore_host(snap)
+        # Every restore begins a new serving epoch STRICTLY ABOVE both
+        # the snapshot's and the current lineage's — answers computed
+        # against pre-crash state are then detectable by epoch alone.
+        self.reach_epoch = max(self.reach_epoch,
+                               int(snap.meta.get("reach_epoch", 0))) + 1
+        self._reach_push()
+
+    def close(self) -> None:
+        self._reach_push()
+        if self.redis is not None and self.cfg.redis_hashtable:
+            est = self.estimates()
+            table = f"{self.cfg.redis_hashtable}_reach"
+            cmds = [("HSET", table, name, str(int(round(float(e)))))
+                    for name, e in zip(self.encoder.campaigns, est)
+                    if e > 0]
+            if cmds:
+                self.redis.pipeline_execute(cmds)
 
     @property
     def dropped(self) -> int:

@@ -60,6 +60,10 @@ DEFAULT_TOLERANCES: dict = {
     # means the packed word stopped halving the wire
     "packed_unpacked_ratio": ("lower", 0.15),
     "devmem_peak_footprint_bytes": ("lower", 1.0),
+    # reach serving (ISSUE 10): query throughput regresses DOWN, query
+    # latency UP; generous like the other timing rows (1-core variance)
+    "reach_qps": ("higher", 0.5),
+    "reach_p99_ms": ("lower", 1.0),
 }
 
 
@@ -116,6 +120,11 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
     if isinstance(dm, dict):
         out["devmem_peak_footprint_bytes"] = _num(
             dm.get("peak_footprint_bytes"))
+    # reach serving block (bench_reach.py artifact / engine stats line)
+    reach = doc.get("reach")
+    if isinstance(reach, dict):
+        out["reach_qps"] = _num(reach.get("qps"))
+        out["reach_p99_ms"] = _num(reach.get("p99_ms"))
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -55,12 +55,18 @@ class SloTracker:
     """
 
     def __init__(self, registry, p99_ms: int = 0, rate_evps: int = 0,
+                 reach_p99_ms: int = 0,
                  budget: float = 0.01, fast_s: float = 30.0,
                  slow_s: float = 180.0, use_lifecycle: bool = False,
                  annotate=None, flightrec=None, capture=None,
                  clock=time.monotonic):
         self.p99_ms = max(int(p99_ms), 0)
         self.rate_evps = max(int(rate_evps), 0)
+        # jax.reach.slo.p99.ms — reach-serving latency objective: a
+        # served reach query slower than this (submit -> reply) is
+        # "bad".  Judged over the reach server's latency histogram with
+        # the SAME two-window burn construction as the window objective.
+        self.reach_p99_ms = max(int(reach_p99_ms), 0)
         self.budget = min(max(float(budget), 1e-6), 1.0)
         self.fast_s = max(float(fast_s), 1.0)
         self.slow_s = max(float(slow_s), self.fast_s)
@@ -86,6 +92,15 @@ class SloTracker:
             self._hist = registry.histogram(
                 "streambench_window_latency_ms",
                 "window writeback latency (time_updated - window_ts), ms")
+        # reach latency source: get-or-create the SAME instrument the
+        # ReachQueryServer feeds (default geometry on both sides)
+        self._reach_hist = None
+        if self.reach_p99_ms:
+            from streambench_tpu.reach.serve import LATENCY_HIST
+
+            self._reach_hist = registry.histogram(
+                LATENCY_HIST,
+                "reach query latency, submit to reply (ms)")
         # sample ring: (t, windows_total, windows_bad, rate_ticks,
         # rate_bad_ticks) — bounded by the slow window at the sampler's
         # cadence; 4096 covers a 1 s cadence for over an hour
@@ -110,6 +125,12 @@ class SloTracker:
             ("rate", "slow"): g("streambench_slo_burn_rate", "",
                                 labels={"objective": "rate",
                                         "window": "slow"}),
+            ("reach", "fast"): g("streambench_slo_burn_rate", "",
+                                 labels={"objective": "reach",
+                                         "window": "fast"}),
+            ("reach", "slow"): g("streambench_slo_burn_rate", "",
+                                 labels={"objective": "reach",
+                                         "window": "slow"}),
         }
         self._g_bad = g("streambench_slo_bad_windows_total",
                         "windows whose e2e latency exceeded the "
@@ -120,7 +141,7 @@ class SloTracker:
 
     @property
     def active(self) -> bool:
-        return bool(self.p99_ms or self.rate_evps)
+        return bool(self.p99_ms or self.rate_evps or self.reach_p99_ms)
 
     # ------------------------------------------------------------------
     def _window_burn(self, window_s: float, idx_total: int,
@@ -156,6 +177,10 @@ class SloTracker:
             out["rate"] = {
                 "fast": round(self._window_burn(self.fast_s, 3, 4), 3),
                 "slow": round(self._window_burn(self.slow_s, 3, 4), 3)}
+        if self.reach_p99_ms:
+            out["reach"] = {
+                "fast": round(self._window_burn(self.fast_s, 5, 6), 3),
+                "slow": round(self._window_burn(self.slow_s, 5, 6), 3)}
         return out
 
     # ------------------------------------------------------------------
@@ -170,6 +195,11 @@ class SloTracker:
         if self.p99_ms:
             total = self._hist.count
             bad = total - self._hist.count_le(float(self.p99_ms))
+        r_total = r_bad = 0
+        if self._reach_hist is not None:
+            r_total = self._reach_hist.count
+            r_bad = r_total - self._reach_hist.count_le(
+                float(self.reach_p99_ms))
         if self.rate_evps and dt_s > 0:
             events = rec.get("events")
             rate = rec.get("events_per_s")
@@ -181,7 +211,8 @@ class SloTracker:
                 if rate < self.rate_evps:
                     self._rate_bad += 1
         self._ring.append((now, total, bad,
-                           self._rate_ticks, self._rate_bad))
+                           self._rate_ticks, self._rate_bad,
+                           r_total, r_bad))
         if len(self._ring) > self._ring_cap:
             del self._ring[:len(self._ring) - self._ring_cap]
         burns = self.burn_rates()
@@ -221,6 +252,9 @@ class SloTracker:
         rec["slo"] = {"burn": burns, "bad_windows": bad,
                       "total_windows": total, "breaches": self.breaches,
                       "in_breach": breaching}
+        if self.reach_p99_ms:
+            rec["slo"]["bad_reach"] = r_bad
+            rec["slo"]["total_reach"] = r_total
 
     # ------------------------------------------------------------------
     def verdict(self) -> dict:
@@ -230,11 +264,13 @@ class SloTracker:
         total = self._hist.count if self.p99_ms else 0
         bad = (total - self._hist.count_le(float(self.p99_ms))
                if self.p99_ms else 0)
-        return {
+        out = {
             "objectives": {
                 **({"p99_ms": self.p99_ms} if self.p99_ms else {}),
                 **({"rate_evps": self.rate_evps}
                    if self.rate_evps else {}),
+                **({"reach_p99_ms": self.reach_p99_ms}
+                   if self.reach_p99_ms else {}),
             },
             "budget": self.budget,
             "windows_s": {"fast": self.fast_s, "slow": self.slow_s},
@@ -244,3 +280,9 @@ class SloTracker:
             "breaches": self.breaches,
             "pass": self.breaches == 0 and not self._in_breach,
         }
+        if self._reach_hist is not None:
+            r_total = self._reach_hist.count
+            out["bad_reach"] = r_total - self._reach_hist.count_le(
+                float(self.reach_p99_ms))
+            out["total_reach"] = r_total
+        return out

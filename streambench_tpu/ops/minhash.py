@@ -1,0 +1,190 @@
+"""MinHash ∪ HyperLogLog reach sketches as one cumulative TPU state.
+
+ROADMAP item 4 / PAPERS.md reach forecasting (arxiv 2502.14785): the ad
+platform's hot query is *reach* — how many distinct devices does a
+combination of campaigns cover?  The paper's construction composes two
+sketches per campaign so any union/intersection/overlap query over
+arbitrary campaign sets becomes a cheap merge of materialized state:
+
+- a **k-hash-function MinHash signature** ``mins[C, k]``: slot ``j``
+  of campaign ``c`` holds ``min over devices of h_j(device)``.  Updates
+  are a sort-free running-min scatter (the register-max structure of
+  ``ops/hll.py`` with ``min`` in place of ``max``), so a batch folds in
+  one vectorized ``at[].min``; ``merge(a, b) = elementwise min`` is
+  associative/commutative/idempotent, which makes sharded materialize
+  trivially exact (tests/test_minhash.py pins the algebra).
+- a **paired HLL register plane** ``registers[C, R]``: the same
+  scatter-max as ``ops/hll.py`` but with no window axis — reach is
+  cumulative audience, not a windowed aggregate.  ``merge = elementwise
+  max``.
+
+Query evaluation (``reach/query.py``) uses the classic identities: the
+union's signature/registers are the elementwise min/max over the
+selected campaigns; ``P(all selected campaigns share slot j's min) =
+|∩| / |∪|`` (the slot's argmin device must belong to every selected
+set), so the m-way Jaccard falls out of a collision fraction and
+``|∩| ≈ |∪| · J``.
+
+Hashes are 32-bit (this repo runs with jax x64 disabled — a uint64
+plane would silently truncate; see ops/devdecode.py for the same
+rule).  Device identity arrives as the encoder's stateless crc32 id
+column (``HASHED_IDS``), then gets one splitmix32 mix for the HLL
+plane and k salted splitmix32 mixes for the signature.  32-bit minima
+tie only with probability ~n·2^-32 per slot — negligible at any
+cardinality this harness reaches.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from streambench_tpu.ops.hll import _rank, splitmix32
+from streambench_tpu.ops.windowcount import NEG
+
+#: "no device seen" sentinel for a signature slot (uint32 max: any real
+#: hash is smaller, so the running min absorbs it away on first touch)
+EMPTY = 0xFFFFFFFF
+
+#: salt-stream constant for the k per-slot hash functions (golden-ratio
+#: increment, the standard splitmix stream schedule)
+_SALT_GAMMA = 0x9E3779B9
+
+
+class ReachState(NamedTuple):
+    """mins: [C, k] uint32 signature; registers: [C, R] int32 HLL plane;
+    watermark: max valid relative event time folded (host-mirrorable,
+    same convention as ``WindowState``); dropped: always 0 — reach is
+    cumulative, there is no ring and no lateness cutoff to drop for
+    (kept for the engine-harness contract)."""
+
+    mins: jax.Array
+    registers: jax.Array
+    watermark: jax.Array
+    dropped: jax.Array
+
+
+def salts(k: int) -> jax.Array:
+    """The k slot salts, derived once from the splitmix stream; slot
+    j's hash is ``splitmix32(splitmix32(id) ^ salts[j])``."""
+    return splitmix32(jnp.arange(1, k + 1, dtype=jnp.uint32)
+                      * jnp.uint32(_SALT_GAMMA))
+
+
+def init_state(num_campaigns: int, k: int = 256,
+               num_registers: int = 256) -> ReachState:
+    if k <= 0:
+        raise ValueError("k must be positive")
+    if num_registers & (num_registers - 1) or num_registers < 16:
+        raise ValueError("num_registers must be a power of two >= 16")
+    if num_campaigns * max(k, num_registers) >= 2**31:
+        raise ValueError("C*k / C*R must fit int32 flat indices")
+    return ReachState(
+        mins=jnp.full((num_campaigns, k), EMPTY, jnp.uint32),
+        registers=jnp.zeros((num_campaigns, num_registers), jnp.int32),
+        watermark=jnp.int32(NEG),
+        dropped=jnp.int32(0),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("view_type",))
+def step(state: ReachState, join_table: jax.Array,
+         ad_idx: jax.Array, user_idx: jax.Array, event_type: jax.Array,
+         event_time: jax.Array, valid: jax.Array,
+         *, view_type: int = 0) -> ReachState:
+    """Fold one micro-batch into both sketch planes.
+
+    Per wanted row: ``mins[campaign, j] = min(., h_j(user))`` for all k
+    slots (one [B, k] hash block + one flat scatter-min) and
+    ``registers[campaign, h & (R-1)] = max(., rank)`` exactly as the
+    windowed HLL step.  Invalid/non-view/join-miss rows scatter to the
+    drop slot (``mode="drop"``).
+    """
+    C, k = state.mins.shape
+    R = state.registers.shape[1]
+    p = R.bit_length() - 1
+
+    campaign = join_table[ad_idx]
+    wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+    h = splitmix32(user_idx)                         # [B] base mix
+    hk = splitmix32(h[:, None] ^ salts(k)[None, :])  # [B, k] slot hashes
+    slot = jnp.arange(k, dtype=jnp.int32)[None, :]
+    flat = jnp.where(wanted[:, None], campaign[:, None] * k + slot, C * k)
+    mins = (state.mins.reshape(-1)
+            .at[flat].min(hk, mode="drop")
+            .reshape(C, k))
+
+    j = (h & jnp.uint32(R - 1)).astype(jnp.int32)
+    rank = _rank(h, p)
+    rflat = jnp.where(wanted, campaign * R + j, C * R)
+    registers = (state.registers.reshape(-1)
+                 .at[rflat].max(rank, mode="drop")
+                 .reshape(C, R))
+
+    watermark = jnp.maximum(
+        state.watermark, jnp.max(jnp.where(valid, event_time, NEG)))
+    return ReachState(mins, registers, watermark, state.dropped)
+
+
+@functools.partial(jax.jit, static_argnames=("view_type",))
+def scan_steps(state: ReachState, join_table: jax.Array,
+               ad_idx: jax.Array, user_idx: jax.Array,
+               event_type: jax.Array, event_time: jax.Array,
+               valid: jax.Array, *, view_type: int = 0) -> ReachState:
+    """Fold ``[N, B]`` stacked micro-batches via ``lax.scan`` — one
+    dispatch per chunk, same amortization as ``hll.scan_steps``."""
+
+    def body(carry, xs):
+        a, u, e, t, v = xs
+        return step(carry, join_table, a, u, e, t, v,
+                    view_type=view_type), None
+
+    final, _ = jax.lax.scan(
+        body, state, (ad_idx, user_idx, event_type, event_time, valid))
+    return final
+
+
+@functools.partial(jax.jit, static_argnames=("view_type",))
+def scan_steps_packed(state: ReachState, join_table: jax.Array,
+                      packed: jax.Array, user_idx: jax.Array,
+                      event_time: jax.Array,
+                      *, view_type: int = 0) -> ReachState:
+    """``scan_steps`` over the packed wire word
+    (``windowcount.pack_columns``) + user ids — the same 12 B/event wire
+    as the HLL engine's packed scan."""
+    from streambench_tpu.ops.windowcount import unpack_columns
+
+    def body(carry, xs):
+        pk, u, t = xs
+        a, e, v = unpack_columns(pk)
+        return step(carry, join_table, a, u, e, t, v,
+                    view_type=view_type), None
+
+    final, _ = jax.lax.scan(body, state, (packed, user_idx, event_time))
+    return final
+
+
+@jax.jit
+def merge(a: ReachState, b: ReachState) -> ReachState:
+    """Shard/partial-state merge: elementwise min over signatures, max
+    over registers.  Commutative, associative, idempotent — the algebra
+    tests/test_minhash.py sweeps over random shard splits."""
+    return ReachState(
+        mins=jnp.minimum(a.mins, b.mins),
+        registers=jnp.maximum(a.registers, b.registers),
+        watermark=jnp.maximum(a.watermark, b.watermark),
+        dropped=a.dropped + b.dropped,
+    )
+
+
+def estimate(registers: jax.Array) -> jax.Array:
+    """Per-campaign distinct-device estimates from the HLL plane (any
+    leading batch dims; delegates to the windowed HLL's estimator —
+    same alpha_m/linear-counting operating points)."""
+    from streambench_tpu.ops import hll
+
+    return hll.estimate(registers)

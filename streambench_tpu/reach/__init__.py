@@ -1,0 +1,32 @@
+"""Live reach-query serving: MinHash ∪ HLL audience-overlap engine.
+
+Layers (ISSUE 10 / ROADMAP item 4):
+
+- ``ops/minhash.py`` — the cumulative per-campaign sketch state
+  (signature + paired HLL plane) folded inside the jitted step;
+- ``reach.query`` — one jitted ``batch_query`` that evaluates a *batch*
+  of union/intersection/overlap queries in a single dispatch (campaign
+  sets as a ``[Q, C]`` membership mask);
+- ``reach.serve`` — the bounded, load-shedding query server behind the
+  ``dimensions.pubsub`` "reach" verb, with per-query latency histograms
+  feeding the ``jax.reach.slo.p99.ms`` objective (obs/slo.py);
+- ``reach.oracle`` — exact set-arithmetic ground truth + a numpy mirror
+  of the sketch algebra for bit-exact verification (bench_reach.py,
+  tests/test_reach_query.py).
+"""
+
+from streambench_tpu.reach.query import (
+    batch_query,
+    overlap_bound,
+    query_chunks,
+    union_bound,
+)
+from streambench_tpu.reach.serve import ReachQueryServer
+
+__all__ = [
+    "ReachQueryServer",
+    "batch_query",
+    "overlap_bound",
+    "query_chunks",
+    "union_bound",
+]

@@ -1,0 +1,132 @@
+"""Batched reach-query evaluation: thousands of ad-hoc queries in a
+handful of device dispatches.
+
+A query is ``(campaign set, op)`` with op ∈ {union, overlap}.  A batch
+of Q queries is encoded as a ``[Q, C]`` boolean membership mask plus a
+``[Q]`` overlap flag and evaluated by ONE jitted program:
+
+- union signature/registers = masked elementwise min/max over the
+  selected campaigns (the sketch merges are embarrassingly parallel —
+  a [Q, C, k] broadcast + reduction, no per-query host work);
+- ``|∪|`` from the merged HLL plane (``hll.estimate``);
+- m-way Jaccard from the collision fraction: slot j agrees when every
+  selected campaign's minimum equals the union minimum — that happens
+  exactly when slot j's argmin device belongs to every selected set,
+  so ``P(agree) = |∩|/|∪|`` and ``J_est = agree_count / k``;
+- ``|∩| ≈ |∪| · J``.
+
+``query_chunks`` pads query batches to ONE static batch shape so the
+whole storm compiles once and dispatches ``ceil(Q/batch)`` times — the
+bench asserts that dispatch count, not one dispatch per query.
+
+Error model (the bounds the serving layer returns next to every
+estimate): the union estimate carries HLL's relative standard error
+``1.04/sqrt(R)``; the overlap estimate's error *as a fraction of the
+union* is the Jaccard estimator's ``sqrt(J(1-J)/k) <= 0.5/sqrt(k)``
+plus the union term — ``1/sqrt(k)`` (~6.25% at k=256) is the
+conservative 2-sigma figure bench_reach.py asserts against exact set
+arithmetic.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from streambench_tpu.ops import hll
+from streambench_tpu.ops.minhash import EMPTY
+
+#: default queries evaluated per dispatch (padded static shape)
+DEFAULT_BATCH = 256
+
+
+def union_bound(num_registers: int) -> float:
+    """Relative standard error of the HLL union estimate."""
+    return 1.04 / math.sqrt(num_registers)
+
+
+def overlap_bound(k: int, num_registers: int) -> float:
+    """Conservative relative-to-union error bound for the overlap
+    estimate: 2-sigma Jaccard (``1/sqrt(k)``) + the union term."""
+    return 1.0 / math.sqrt(k) + union_bound(num_registers)
+
+
+@jax.jit
+def batch_query(mins: jax.Array, registers: jax.Array,
+                mask: jax.Array, overlap: jax.Array):
+    """Evaluate one padded query batch.
+
+    ``mins [C, k] uint32``, ``registers [C, R] int32``,
+    ``mask [Q, C] bool``, ``overlap [Q] bool``.  Returns
+    ``(estimate [Q] f32, union [Q] f32, jaccard [Q] f32,
+    agree [Q] i32)`` — ``agree`` is the integer collision count, the
+    bit-exact quantity the oracle comparisons pin (float estimates are
+    derived from it deterministically but reduction order may differ
+    between backends).
+
+    All-False mask rows (padding, or a query over zero campaigns)
+    evaluate to 0: the union registers stay zero (estimate 0 via linear
+    counting) and no slot can agree (an empty selection's masked min is
+    the EMPTY sentinel, masked max is 0).
+    """
+    empty = jnp.uint32(EMPTY)
+    sel = mask[:, :, None]
+    # [Q, k]: min/max of each slot over the selected campaigns; a
+    # selected-but-never-seen campaign contributes EMPTY to the max, so
+    # any empty member forces disagreement — |∩| with an empty set is 0.
+    sel_min = jnp.min(jnp.where(sel, mins[None], empty), axis=1)
+    sel_max = jnp.max(jnp.where(sel, mins[None], jnp.uint32(0)), axis=1)
+    agree = jnp.sum(((sel_min == sel_max) & (sel_min != empty))
+                    .astype(jnp.int32), axis=1)
+    union_regs = jnp.max(jnp.where(sel, registers[None], 0), axis=1)
+    union = hll.estimate(union_regs).astype(jnp.float32)
+    k = mins.shape[1]
+    jacc = agree.astype(jnp.float32) / jnp.float32(k)
+    est = jnp.where(overlap, union * jacc, union)
+    return est, union, jacc, agree
+
+
+class DispatchCounter:
+    """Counts ``batch_query`` dispatches (the bench's ``<= ceil(Q/B)``
+    acceptance is on this number)."""
+
+    def __init__(self) -> None:
+        self.dispatches = 0
+
+
+def query_chunks(mins, registers, masks: np.ndarray,
+                 overlap: np.ndarray, *, batch: int = DEFAULT_BATCH,
+                 counter: DispatchCounter | None = None):
+    """Evaluate Q queries in ``ceil(Q/batch)`` dispatches of ONE padded
+    static shape (a single compile covers the whole storm).
+
+    ``masks [Q, C] bool``, ``overlap [Q] bool`` (numpy).  Returns
+    ``(est, union, jacc, agree)`` numpy arrays of length Q.
+    """
+    q = masks.shape[0]
+    if q == 0:
+        z = np.zeros(0, np.float32)
+        return z, z.copy(), z.copy(), np.zeros(0, np.int32)
+    batch = max(int(batch), 1)
+    outs = []
+    for off in range(0, q, batch):
+        m = masks[off:off + batch]
+        o = overlap[off:off + batch]
+        rows = m.shape[0]
+        if rows < batch:
+            m = np.concatenate(
+                [m, np.zeros((batch - rows, m.shape[1]), bool)])
+            o = np.concatenate([o, np.zeros(batch - rows, bool)])
+        res = batch_query(mins, registers, jnp.asarray(m),
+                          jnp.asarray(o))
+        if counter is not None:
+            counter.dispatches += 1
+        outs.append(tuple(np.asarray(x)[:rows] for x in res))
+    est = np.concatenate([t[0] for t in outs])
+    union = np.concatenate([t[1] for t in outs])
+    jacc = np.concatenate([t[2] for t in outs])
+    agree = np.concatenate([t[3] for t in outs])
+    return est, union, jacc, agree

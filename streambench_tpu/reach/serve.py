@@ -1,0 +1,264 @@
+"""Bounded, load-shedding reach-query server.
+
+The serving contract mirrors the pub/sub layer's prime directive —
+queries must never stall aggregation — extended with explicit admission
+control:
+
+- a **bounded queue** (``jax.reach.queue.depth``): a submit beyond the
+  depth sheds the OLDEST pending query (freshest-first under overload —
+  the newest queries are the ones whose answer is still wanted), the
+  shed query is *answered* with ``{"shed": true}`` rather than dropped
+  silently, and ``streambench_reach_shed_total`` counts it;
+- **batched evaluation**: the worker drains everything queued (up to
+  the batch cap) into ONE padded ``reach.query.batch_query`` dispatch,
+  so thousands of concurrent queries amortize into a handful of device
+  steps (``summary()['dispatches']`` is the bench's acceptance number);
+- **per-query latency** (submit -> reply) lands in the
+  ``streambench_reach_latency_ms`` histogram, which the
+  ``jax.reach.slo.p99.ms`` objective (obs/slo.py) judges with the same
+  two-window burn-rate machinery as the window-latency SLO;
+- **epoch tagging**: every answer carries the epoch of the sketch
+  state it was evaluated against.  The engine bumps the epoch on every
+  restore, so a client can detect that an answer pre-dates a crash
+  recovery — the chaos sweep asserts no post-resume answer carries a
+  pre-resume epoch.
+
+State arrives by push (``update_state``): jax arrays are immutable, so
+the engine hands over its current references under the GIL and the
+worker evaluates against a consistent snapshot while folds continue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from streambench_tpu.reach import query as rq
+
+#: shared instrument name — obs/slo.py's reach objective get-or-creates
+#: the SAME histogram geometry, so both sides see one instrument
+LATENCY_HIST = "streambench_reach_latency_ms"
+
+
+class ReachQueryServer:
+    def __init__(self, campaigns: list[str], *, depth: int = 512,
+                 batch: int = rq.DEFAULT_BATCH, registry=None,
+                 hold: bool = False):
+        self.campaigns = list(campaigns)
+        self._index = {c: i for i, c in enumerate(self.campaigns)}
+        self.depth = max(int(depth), 1)
+        self.batch = max(int(batch), 1)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._state = None          # (mins, registers, k, R, epoch)
+        self._hold = bool(hold)
+        self._closed = False
+        self.served = 0
+        self.shed = 0
+        self.rejected = 0
+        self.dispatches = 0
+        self._lat_ring: deque = deque(maxlen=8192)  # ms, summary() only
+        self._served_t0: float | None = None
+        self._served_t1: float | None = None
+        self._c_shed = self._c_served = self._hist = None
+        if registry is not None:
+            self._c_shed = registry.counter(
+                "streambench_reach_shed_total",
+                "reach queries shed (oldest-first) beyond queue depth")
+            self._c_served = registry.counter(
+                "streambench_reach_served_total",
+                "reach queries answered with an estimate")
+            self._hist = registry.histogram(
+                LATENCY_HIST,
+                "reach query latency, submit to reply (ms)")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="reach-query")
+        self._thread.start()
+
+    # -- state push ----------------------------------------------------
+    def update_state(self, mins, registers, epoch: int) -> None:
+        """Engine-side push of the current sketch planes (immutable jax
+        arrays; the reference handoff is atomic under the GIL)."""
+        with self._cv:
+            self._state = (mins, registers,
+                           int(mins.shape[1]), int(registers.shape[1]),
+                           int(epoch))
+            self._cv.notify()
+
+    @property
+    def epoch(self) -> int | None:
+        st = self._state
+        return st[4] if st is not None else None
+
+    # -- admission -----------------------------------------------------
+    def handle(self, msg: dict, reply) -> None:
+        """The pub/sub query-verb hook: parse, admit (shedding the
+        oldest beyond depth), never raise."""
+        self.submit(msg.get("campaigns"), msg.get("op", "union"), reply,
+                    query_id=msg.get("id"))
+
+    def submit(self, campaigns, op, reply, query_id=None) -> bool:
+        """Admit one query.  Returns False when it was rejected outright
+        (malformed); shedding affects the *oldest* queued query, never
+        the one being admitted."""
+        if op not in ("union", "overlap") or not isinstance(
+                campaigns, (list, tuple)) or not campaigns:
+            self.rejected += 1
+            self._safe_reply(reply, {"error": "bad_request", "op": op,
+                                     "id": query_id})
+            return False
+        idx = []
+        for c in campaigns:
+            i = self._index.get(c)
+            if i is None:
+                self.rejected += 1
+                self._safe_reply(reply, {"error": "unknown_campaign",
+                                         "campaign": c, "id": query_id})
+                return False
+            idx.append(i)
+        item = (idx, op == "overlap", reply, query_id,
+                time.monotonic())
+        victims = []
+        with self._cv:
+            self._q.append(item)
+            while len(self._q) > self.depth:
+                victims.append(self._q.popleft())
+                self.shed += 1
+                if self._c_shed is not None:
+                    self._c_shed.inc()
+            self._cv.notify()
+        for old in victims:   # replies outside the lock: a slow socket
+            self._safe_reply(old[2], {"shed": True, "id": old[3]})
+        return True
+
+    # -- hold/resume (bench storms: queue while held, then drain in
+    # ceil(pending/batch) dispatches) ----------------------------------
+    def pause(self) -> None:
+        with self._cv:
+            self._hold = True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._hold = False
+            self._cv.notify()
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # -- worker --------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._closed and (
+                        self._hold or not self._q
+                        or self._state is None):
+                    self._cv.wait(timeout=0.5)
+                if self._closed and (not self._q
+                                     or self._state is None):
+                    # drain-at-close only works with state to evaluate
+                    # against; without one, answer the stragglers as
+                    # shed rather than spin
+                    leftovers = list(self._q)
+                    self._q.clear()
+                    self.shed += len(leftovers)
+                else:
+                    leftovers = None
+                if leftovers is None and (self._hold
+                                          or self._state is None):
+                    continue
+                items = state = None
+                if leftovers is None:
+                    items = [self._q.popleft()
+                             for _ in range(min(len(self._q),
+                                                self.batch))]
+                    state = self._state
+            if leftovers is not None:
+                for it in leftovers:
+                    self._safe_reply(it[2], {"shed": True, "id": it[3]})
+                return
+            try:
+                self._evaluate(items, state)
+            except Exception as e:   # a bad batch must not kill serving
+                for it in items:
+                    self._safe_reply(it[2], {"error": repr(e),
+                                             "id": it[3]})
+
+    def _evaluate(self, items: list, state) -> None:
+        mins, registers, k, R, epoch = state
+        C = len(self.campaigns)
+        mask = np.zeros((self.batch, C), bool)
+        overlap = np.zeros(self.batch, bool)
+        for row, (idx, is_overlap, _, _, _) in enumerate(items):
+            mask[row, idx] = True
+            overlap[row] = is_overlap
+        est, union, jacc, _ = rq.batch_query(
+            mins, registers, mask, overlap)
+        self.dispatches += 1
+        est = np.asarray(est)
+        union = np.asarray(union)
+        jacc = np.asarray(jacc)
+        ub = rq.union_bound(R)
+        ob = rq.overlap_bound(k, R)
+        now = time.monotonic()
+        if self._served_t0 is None:
+            self._served_t0 = now
+        for row, (idx, is_overlap, reply, qid, t0) in enumerate(items):
+            lat_ms = (now - t0) * 1000.0
+            self._lat_ring.append(lat_ms)
+            if self._hist is not None:
+                self._hist.observe(lat_ms)
+            self.served += 1
+            if self._c_served is not None:
+                self._c_served.inc()
+            self._safe_reply(reply, {
+                "op": "overlap" if is_overlap else "union",
+                "estimate": round(float(est[row]), 2),
+                "union": round(float(union[row]), 2),
+                "jaccard": round(float(jacc[row]), 5),
+                # relative error bound: union is relative to the
+                # estimate; overlap is relative to the UNION size (the
+                # Jaccard estimator's natural scale)
+                "bound": round(ob if is_overlap else ub, 5),
+                "epoch": epoch,
+                "id": qid,
+            })
+        self._served_t1 = time.monotonic()
+
+    @staticmethod
+    def _safe_reply(reply, data: dict) -> None:
+        try:
+            reply(data)
+        except Exception:
+            pass   # a dead subscriber must not kill the worker
+
+    # -- reporting -----------------------------------------------------
+    def summary(self) -> dict:
+        lats = sorted(self._lat_ring)
+        out = {
+            "served": self.served,
+            "shed": self.shed,
+            "rejected": self.rejected,
+            "dispatches": self.dispatches,
+            "batch": self.batch,
+            "queue_depth": self.depth,
+        }
+        if lats:
+            out["p50_ms"] = round(lats[len(lats) // 2], 2)
+            out["p99_ms"] = round(lats[min(len(lats) - 1,
+                                           int(len(lats) * 0.99))], 2)
+        if (self._served_t0 is not None and self._served_t1 is not None
+                and self._served_t1 > self._served_t0 and self.served):
+            out["qps"] = round(
+                self.served / (self._served_t1 - self._served_t0), 1)
+        return out
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._hold = False
+            self._cv.notify()
+        self._thread.join(timeout=10.0)

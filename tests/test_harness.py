@@ -172,3 +172,77 @@ def test_suite_retry_gated_on_wedge_signature(tmp_path, monkeypatch):
     except SystemExit:
         pass
     assert calls == ["exact"], "no retry for a non-wedge failure"
+
+
+def _load_sb(tmp_path, monkeypatch, **over):
+    """Fresh stream_bench module instance with paths pinned to tmp."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("sb_ext", SB)
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    monkeypatch.setattr(sb, "WORKDIR", str(tmp_path))
+    monkeypatch.setattr(sb, "PID_DIR", str(tmp_path / "pids"))
+    monkeypatch.setattr(sb, "LOG_DIR", str(tmp_path / "logs"))
+    for k, v in over.items():
+        monkeypatch.setattr(sb, k, v)
+    return sb
+
+
+def test_pidfile_starttime_match(tmp_path, monkeypatch):
+    """pid-match (ROADMAP item 5 slice): a pidfile whose recorded kernel
+    start time no longer matches the process reads as 'not running', so
+    STOP never signals a recycled pid it didn't start."""
+    sb = _load_sb(tmp_path, monkeypatch)
+    os.makedirs(sb.PID_DIR, exist_ok=True)
+    me = os.getpid()
+    started = sb._proc_starttime(me)
+    assert started is not None
+    # correct starttime -> matches
+    with open(sb._pidfile("redis"), "w") as f:
+        f.write(f"{me} {started}")
+    assert sb.running_pid("redis") == me
+    # wrong starttime (recycled pid) -> NOT adopted
+    with open(sb._pidfile("redis"), "w") as f:
+        f.write(f"{me} 12345")
+    assert sb.running_pid("redis") is None
+    # stop_if_needed on the mismatch is a no-op (we are still alive)
+    sb.stop_if_needed("redis")
+    assert os.getpid() == me
+    # legacy bare-pid files keep working
+    with open(sb._pidfile("redis"), "w") as f:
+        f.write(str(me))
+    assert sb.running_pid("redis") == me
+    os.remove(sb._pidfile("redis"))
+
+
+def test_external_redis_adopted_not_stopped(tmp_path, monkeypatch):
+    """External-Redis drive mode: redis.host/redis.port pointing at an
+    already-running server is health-checked (PING) instead of spawned,
+    and STOP leaves it running."""
+    sys.path.insert(0, REPO)
+    from streambench_tpu.io.fakeredis import FakeRedisServer
+    from streambench_tpu.io.resp import RespClient
+
+    srv = FakeRedisServer(host="127.0.0.1", port=0).start()
+    port = srv.port
+    try:
+        sb = _load_sb(tmp_path, monkeypatch,
+                      REDIS_HOST="127.0.0.1", REDIS_PORT=port)
+        assert sb._redis_alive()
+        # seeding needs the datagen CLI; run only the adoption half
+        sb.os.makedirs(sb.PID_DIR, exist_ok=True)
+        assert sb.running_pid("redis") is None
+        # op_start_redis would seed via subprocess; drive the adoption
+        # logic directly (the marker decides STOP's behavior)
+        with open(sb._external_redis_marker(), "w") as f:
+            f.write(f"127.0.0.1:{port}\n")
+        sb.op_stop_redis()
+        assert not os.path.exists(sb._external_redis_marker())
+        # the server this harness never started is STILL serving
+        with RespClient("127.0.0.1", port, timeout_s=2.0) as c:
+            assert c.ping() == "PONG"
+        # and a second STOP (no marker, no pidfile) is a clean no-op
+        sb.op_stop_redis()
+    finally:
+        srv.stop()

@@ -1,0 +1,193 @@
+"""MinHash ∪ HLL reach sketches (ops/minhash.py, ISSUE 10): the fold vs
+a numpy set-arithmetic oracle, the merge algebra (commutative,
+associative, idempotent, shard-order-invariant over random shard splits
+— what makes sharded reach trivially correct later), scan/packed-scan
+bit-identity, and the numpy hash mirrors the oracle depends on."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.ops import hll, minhash
+from streambench_tpu.reach import oracle as ro
+
+C, K, R = 7, 64, 64
+JOIN = np.array([0, 0, 1, 1, 2, 3, 4, 5, 6, -1], np.int32)
+
+
+def rand_batch(rng, B=256, ads=10):
+    """One adversarial micro-batch: dup users, invalid rows, non-view
+    events, join-miss ads."""
+    return dict(
+        ad_idx=rng.integers(0, ads, B).astype(np.int32),
+        user_idx=rng.integers(-2**31, 2**31 - 1, B,
+                              dtype=np.int64).astype(np.int32),
+        event_type=rng.integers(0, 3, B).astype(np.int32),
+        event_time=rng.integers(0, 10**6, B).astype(np.int32),
+        valid=rng.random(B) > 0.15,
+    )
+
+
+def fold(state, batches):
+    join = jnp.asarray(JOIN)
+    for b in batches:
+        state = minhash.step(state, join, jnp.asarray(b["ad_idx"]),
+                             jnp.asarray(b["user_idx"]),
+                             jnp.asarray(b["event_type"]),
+                             jnp.asarray(b["event_time"]),
+                             jnp.asarray(b["valid"]))
+    return state
+
+
+def oracle_sets(batches):
+    sets = {c: set() for c in range(C)}
+    for b in batches:
+        for a, u, e, v in zip(b["ad_idx"], b["user_idx"],
+                              b["event_type"], b["valid"]):
+            camp = JOIN[a]
+            if v and e == 0 and camp >= 0:
+                sets[camp].add(int(u))
+    return sets
+
+
+def expected(sets):
+    names = [str(c) for c in range(C)]
+    return ro.expected_state({str(c): sets[c] for c in range(C)},
+                             names, K, R)
+
+
+# ------------------------------------------------------------- hashes
+def test_numpy_hash_mirrors_are_bit_identical():
+    """The oracle's numpy splitmix32/rank/salts must match the jax ops
+    bit-for-bit — everything downstream (expected_state, bench
+    bit-exactness) rests on this differential."""
+    xs = np.array([0, 1, -1, 2**31 - 1, -2**31, 12345, -98765],
+                  np.int64).astype(np.int32)
+    got = np.asarray(hll.splitmix32(jnp.asarray(xs)))
+    want = ro.splitmix32_np(xs)
+    np.testing.assert_array_equal(got, want)
+    h = ro.splitmix32_np(np.arange(1000, dtype=np.int64).astype(np.int32))
+    for p in (4, 6, 8):
+        got = np.asarray(hll._rank(jnp.asarray(h), p))
+        np.testing.assert_array_equal(got, ro.rank_np(h, p))
+    np.testing.assert_array_equal(np.asarray(minhash.salts(K)),
+                                  ro.salts_np(K))
+
+
+# --------------------------------------------------------------- fold
+def test_step_matches_set_arithmetic_oracle():
+    rng = np.random.default_rng(3)
+    batches = [rand_batch(rng) for _ in range(8)]
+    st = fold(minhash.init_state(C, K, R), batches)
+    em, er = expected(oracle_sets(batches))
+    np.testing.assert_array_equal(np.asarray(st.mins), em)
+    np.testing.assert_array_equal(np.asarray(st.registers), er)
+    assert int(st.dropped) == 0   # reach never drops: no ring, no cutoff
+
+
+def test_duplicate_events_are_idempotent():
+    """Folding the SAME batches twice changes nothing — running min/max
+    absorb duplicates (the dedup-free materialize contract)."""
+    rng = np.random.default_rng(4)
+    batches = [rand_batch(rng) for _ in range(4)]
+    once = fold(minhash.init_state(C, K, R), batches)
+    twice = fold(once, batches)
+    np.testing.assert_array_equal(np.asarray(once.mins),
+                                  np.asarray(twice.mins))
+    np.testing.assert_array_equal(np.asarray(once.registers),
+                                  np.asarray(twice.registers))
+
+
+def test_scan_steps_bit_identical_to_step_sequence():
+    rng = np.random.default_rng(5)
+    batches = [rand_batch(rng, B=128) for _ in range(6)]
+    seq = fold(minhash.init_state(C, K, R), batches)
+    stacked = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+    scanned = minhash.scan_steps(
+        minhash.init_state(C, K, R), jnp.asarray(JOIN),
+        jnp.asarray(stacked["ad_idx"]), jnp.asarray(stacked["user_idx"]),
+        jnp.asarray(stacked["event_type"]),
+        jnp.asarray(stacked["event_time"]), jnp.asarray(stacked["valid"]))
+    for a, b in zip(seq, scanned):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_packed_scan_bit_identical():
+    from streambench_tpu.ops import windowcount as wc
+
+    rng = np.random.default_rng(6)
+    batches = [rand_batch(rng, B=128) for _ in range(4)]
+    seq = fold(minhash.init_state(C, K, R), batches)
+    packed = np.stack([np.asarray(wc.pack_columns(
+        b["ad_idx"], b["event_type"], b["valid"])) for b in batches])
+    scanned = minhash.scan_steps_packed(
+        minhash.init_state(C, K, R), jnp.asarray(JOIN),
+        jnp.asarray(packed),
+        jnp.asarray(np.stack([b["user_idx"] for b in batches])),
+        jnp.asarray(np.stack([b["event_time"] for b in batches])))
+    np.testing.assert_array_equal(np.asarray(seq.mins),
+                                  np.asarray(scanned.mins))
+    np.testing.assert_array_equal(np.asarray(seq.registers),
+                                  np.asarray(scanned.registers))
+
+
+# ------------------------------------------------------- merge algebra
+def _states(rng, n):
+    return [fold(minhash.init_state(C, K, R),
+                 [rand_batch(rng) for _ in range(2)]) for _ in range(n)]
+
+
+def assert_state_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.mins), np.asarray(b.mins))
+    np.testing.assert_array_equal(np.asarray(a.registers),
+                                  np.asarray(b.registers))
+
+
+def test_merge_commutative_associative_idempotent():
+    rng = np.random.default_rng(7)
+    a, b, c = _states(rng, 3)
+    assert_state_equal(minhash.merge(a, b), minhash.merge(b, a))
+    assert_state_equal(minhash.merge(minhash.merge(a, b), c),
+                       minhash.merge(a, minhash.merge(b, c)))
+    assert_state_equal(minhash.merge(a, a), a)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_shard_order_invariance_random_splits(seed):
+    """Hypothesis-style sweep: split one stream across S shards at
+    random, fold each shard independently, merge in a random order —
+    the result is bit-identical to the single-engine fold.  This is
+    the property that makes the sharded variant trivially correct."""
+    rng = np.random.default_rng(seed)
+    pyrng = random.Random(seed)
+    batches = [rand_batch(rng, B=128) for _ in range(10)]
+    reference = fold(minhash.init_state(C, K, R), batches)
+    S = pyrng.choice([2, 3, 4])
+    shards = [[] for _ in range(S)]
+    for b in batches:
+        shards[pyrng.randrange(S)].append(b)
+    partials = [fold(minhash.init_state(C, K, R), sh) for sh in shards]
+    pyrng.shuffle(partials)
+    merged = partials[0]
+    for p in partials[1:]:
+        merged = minhash.merge(merged, p)
+    assert_state_equal(merged, reference)
+
+
+# ----------------------------------------------------------- estimates
+def test_estimate_tracks_true_cardinality():
+    """Statistical sanity at R=64: per-campaign estimates within 4
+    sigma of the true distinct counts (seeded, deterministic)."""
+    rng = np.random.default_rng(21)
+    batches = [rand_batch(rng, B=1024, ads=9) for _ in range(12)]
+    st = fold(minhash.init_state(C, K, R), batches)
+    sets = oracle_sets(batches)
+    est = np.asarray(minhash.estimate(st.registers))
+    for c in range(C):
+        true = len(sets[c])
+        if true < 50:
+            continue
+        rel = abs(est[c] - true) / true
+        assert rel < 4 * 1.04 / np.sqrt(R), (c, true, est[c], rel)

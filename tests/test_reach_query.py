@@ -1,0 +1,190 @@
+"""Batched reach-query evaluation (reach/query.py) + the serving
+surfaces it rides: bit-exact collision counts vs the numpy oracle,
+exact set-arithmetic truth at small cardinality, dispatch amortization
+(ceil(Q/batch), never one dispatch per query), the pub/sub "reach"
+query verb end-to-end, and durable-store sketch round-trips."""
+
+import numpy as np
+import pytest
+
+from streambench_tpu.ops import minhash
+from streambench_tpu.reach import oracle as ro
+from streambench_tpu.reach import query as rq
+
+C, K, R = 9, 128, 64
+NAMES = [f"camp{i}" for i in range(C)]
+
+
+@pytest.fixture(scope="module")
+def world():
+    """Deterministic per-campaign device sets with real overlap (shared
+    pool + per-campaign tail) + the materialized sketch planes."""
+    rng = np.random.default_rng(42)
+    shared = set(int(x) for x in rng.integers(0, 10**6, 300))
+    sets = {}
+    for i, name in enumerate(NAMES):
+        own = set(int(x) for x in rng.integers(10**7 * (i + 1),
+                                               10**7 * (i + 1) + 10**6,
+                                               200 + 37 * i))
+        take = set(x for x in shared if rng.random() < 0.5)
+        sets[name] = own | take
+    mins, regs = ro.expected_state(sets, NAMES, K, R)
+    return sets, mins, regs
+
+
+def make_queries(rng, n):
+    masks = np.zeros((n, C), bool)
+    overlap = np.zeros(n, bool)
+    for i in range(n):
+        sel = rng.choice(C, size=rng.integers(1, 5), replace=False)
+        masks[i, sel] = True
+        overlap[i] = bool(rng.integers(0, 2))
+    return masks, overlap
+
+
+def test_agree_counts_bit_exact_vs_numpy_oracle(world):
+    sets, mins, regs = world
+    rng = np.random.default_rng(1)
+    masks, overlap = make_queries(rng, 100)
+    counter = rq.DispatchCounter()
+    est, union, jacc, agree = rq.query_chunks(
+        mins, regs, masks, overlap, batch=32, counter=counter)
+    np.testing.assert_array_equal(
+        agree, ro.query_oracle_np(mins, regs, masks))
+    assert counter.dispatches == int(np.ceil(100 / 32))
+    # jaccard/estimate derive deterministically from agree/union
+    np.testing.assert_allclose(jacc, agree / K, rtol=1e-6)
+
+
+def test_estimates_inside_error_bounds(world):
+    """Measured relative error vs exact set arithmetic: union within
+    the HLL bound, overlap within the relative-to-union Jaccard bound
+    (the k=256 -> ~6.25% acceptance figure, here at K=128)."""
+    sets, mins, regs = world
+    rng = np.random.default_rng(2)
+    masks, overlap = make_queries(rng, 120)
+    est, union, jacc, _ = rq.query_chunks(mins, regs, masks, overlap)
+    u_err, o_err = [], []
+    for i in range(masks.shape[0]):
+        sel = [NAMES[j] for j in range(C) if masks[i, j]]
+        op = "overlap" if overlap[i] else "union"
+        truth, true_union = ro.exact_counts(sets, sel, op)
+        if overlap[i]:
+            o_err.append(abs(est[i] - truth) / max(true_union, 1))
+        else:
+            u_err.append(abs(est[i] - truth) / max(truth, 1))
+    # mean measured error within the theoretical (2-sigma-ish) bounds
+    assert np.mean(u_err) <= rq.union_bound(R) * 2, np.mean(u_err)
+    assert np.mean(o_err) <= rq.overlap_bound(K, R), np.mean(o_err)
+
+
+def test_empty_selection_and_padding_rows_evaluate_to_zero(world):
+    _, mins, regs = world
+    masks = np.zeros((3, C), bool)
+    masks[1, 0] = True
+    est, union, jacc, agree = rq.query_chunks(
+        mins, regs, masks, np.array([False, False, True]), batch=8)
+    assert est[0] == 0 and agree[0] == 0      # empty union query
+    assert est[1] > 0                          # real row unaffected
+    assert agree[2] == 0 and est[2] == 0       # overlap over nothing
+
+
+def test_single_campaign_overlap_is_identity(world):
+    """m=1 'overlap' degenerates to the campaign itself: J=1 (every
+    slot agrees with itself), estimate == union estimate."""
+    _, mins, regs = world
+    masks = np.zeros((C, C), bool)
+    np.fill_diagonal(masks, True)
+    est, union, jacc, agree = rq.query_chunks(
+        mins, regs, masks, np.ones(C, bool))
+    np.testing.assert_array_equal(agree, np.full(C, K))
+    np.testing.assert_allclose(est, union, rtol=1e-6)
+
+
+def test_disjoint_campaigns_overlap_zero():
+    """Two campaigns with no shared devices: every slot disagrees (up
+    to 32-bit hash ties, absent at this size) -> intersection 0."""
+    sets = {"a": set(range(1000)), "b": set(range(5000, 6000))}
+    mins, regs = ro.expected_state(sets, ["a", "b"], K, R)
+    masks = np.ones((1, 2), bool)
+    est, union, jacc, agree = rq.query_chunks(
+        mins, regs, masks, np.ones(1, bool))
+    assert agree[0] == 0 and est[0] == 0.0
+
+
+# -------------------------------------------------- pub/sub query verb
+def test_pubsub_reach_verb_round_trip(world):
+    import jax.numpy as jnp
+
+    from streambench_tpu.dimensions.pubsub import PubSubClient, PubSubServer
+    from streambench_tpu.reach.serve import ReachQueryServer
+
+    sets, mins, regs = world
+    srv = ReachQueryServer(NAMES, depth=64, batch=16)
+    srv.update_state(jnp.asarray(mins), jnp.asarray(regs), epoch=7)
+    ps = PubSubServer(port=0).start()
+    ps.register_query("reach", srv.handle)
+    host, port = ps.address
+    try:
+        c = PubSubClient(host, port)
+        c.request({"type": "reach", "campaigns": NAMES[:2],
+                   "op": "union", "id": "q1"})
+        c.request({"type": "reach", "campaigns": NAMES[:3],
+                   "op": "overlap", "id": "q2"})
+        got = {m["data"]["id"]: m["data"] for m in (c.recv(), c.recv())}
+        assert got["q1"]["epoch"] == 7 and got["q1"]["estimate"] > 0
+        assert got["q2"]["op"] == "overlap"
+        assert 0.0 < got["q2"]["bound"] < 1.0
+        # malformed verbs answer, never hang or kill the connection
+        c.request({"type": "reach", "campaigns": ["nope"],
+                   "op": "union", "id": "q3"})
+        assert c.recv()["data"]["error"] == "unknown_campaign"
+        c.request({"type": "reach", "campaigns": NAMES[:1],
+                   "op": "median", "id": "q4"})
+        assert "error" in c.recv()["data"]
+        c.close()
+    finally:
+        srv.close()
+        ps.close()
+
+
+def test_register_query_refuses_reserved_verbs():
+    from streambench_tpu.dimensions.pubsub import PubSubServer
+
+    ps = PubSubServer(port=0).start()
+    try:
+        with pytest.raises(ValueError):
+            ps.register_query("subscribe", lambda m, r: None)
+    finally:
+        ps.close()
+
+
+# ------------------------------------------------- durable-store leg
+def test_store_sketch_round_trip(tmp_path, world):
+    """Materialized sketches survive the durable store: put -> reopen
+    -> replay -> identical query answers (serving from the store, not
+    the engine)."""
+    from streambench_tpu.dimensions.store import DurableDimensionStore
+
+    sets, mins, regs = world
+    with DurableDimensionStore(str(tmp_path)) as st:
+        st.put_rows([("campA", 0, {"clicks:SUM": 3})])
+        st.put_reach_sketches(mins, regs, NAMES, epoch=5)
+    with DurableDimensionStore(str(tmp_path)) as st2:
+        rec = st2.reach_sketches()
+        assert rec is not None and rec["epoch"] == 5
+        np.testing.assert_array_equal(rec["mins"], mins)
+        np.testing.assert_array_equal(rec["registers"], regs)
+        assert rec["campaigns"] == NAMES
+        # normal rows coexist with the sketch record
+        assert st2.get("campA", 0)["clicks:SUM"] == 3
+        st2.compact()
+    with DurableDimensionStore(str(tmp_path)) as st3:
+        rec = st3.reach_sketches()   # compaction kept the latest sketch
+        assert rec is not None and rec["epoch"] == 5
+        masks = np.zeros((2, C), bool)
+        masks[0, :3] = True
+        masks[1, [0, 4]] = True
+        agree = ro.query_oracle_np(rec["mins"], rec["registers"], masks)
+        np.testing.assert_array_equal(
+            agree, ro.query_oracle_np(mins, regs, masks))

@@ -1,0 +1,280 @@
+"""Reach serving under load and under chaos (reach/serve.py, ISSUE 10):
+shed-oldest admission, epoch tagging across engine restore, the
+jax.reach.slo.p99.ms burn-rate objective, and the acceptance sweep — a
+pub/sub query storm concurrent with a sink-outage + crash FaultPlan
+where every query sheds or answers, nothing crashes, and no post-resume
+answer carries a stale epoch."""
+
+import random
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.engine.sketches import ReachSketchEngine
+from streambench_tpu.ops import minhash
+from streambench_tpu.reach.serve import ReachQueryServer
+
+
+def tiny_state(C=4, k=16, R=16, seed=0):
+    rng = np.random.default_rng(seed)
+    st = minhash.init_state(C, k, R)
+    join = jnp.asarray(np.arange(C, dtype=np.int32))
+    B = 64
+    return minhash.step(
+        st, join,
+        jnp.asarray(rng.integers(0, C, B).astype(np.int32)),
+        jnp.asarray(rng.integers(0, 1 << 20, B).astype(np.int32)),
+        jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+        jnp.ones(B, bool))
+
+
+# ----------------------------------------------------------- admission
+def test_shed_oldest_beyond_depth_and_counters():
+    st = tiny_state()
+    srv = ReachQueryServer(list("abcd"), depth=5, batch=4, hold=True)
+    srv.update_state(st.mins, st.registers, epoch=1)
+    got = []
+    try:
+        for i in range(12):
+            srv.submit(["a"], "union", lambda d: got.append(d),
+                       query_id=i)
+        # held: 12 in, depth 5 -> 7 oldest shed already
+        assert srv.shed == 7 and srv.pending() == 5
+        shed_ids = sorted(d["id"] for d in got if d.get("shed"))
+        assert shed_ids == list(range(7))   # OLDEST were shed
+        srv.resume()
+        deadline = time.monotonic() + 10
+        while len(got) < 12 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        answered = [d for d in got if "estimate" in d]
+        assert len(answered) == 5 and srv.served == 5
+        assert {d["id"] for d in answered} == set(range(7, 12))
+        # drain of 5 at batch=4 -> exactly ceil(5/4)=2 dispatches
+        assert srv.dispatches == 2
+        s = srv.summary()
+        assert s["shed"] == 7 and s["served"] == 5
+        assert s["p99_ms"] >= 0
+    finally:
+        srv.close()
+
+
+def test_bad_requests_answer_without_queueing():
+    srv = ReachQueryServer(["a"], depth=4, batch=2)
+    got = []
+    try:
+        assert not srv.submit([], "union", lambda d: got.append(d))
+        assert not srv.submit(["a"], "p99", lambda d: got.append(d))
+        assert not srv.submit(["zzz"], "union",
+                              lambda d: got.append(d))
+        assert srv.rejected == 3 and srv.pending() == 0
+        assert all("error" in d for d in got)
+    finally:
+        srv.close()
+
+
+def test_close_without_state_sheds_stragglers():
+    srv = ReachQueryServer(["a"], depth=8, batch=4)   # no state pushed
+    got = []
+    srv.submit(["a"], "union", lambda d: got.append(d), query_id="s")
+    srv.close()
+    assert got and got[0].get("shed") is True
+
+
+# ------------------------------------------------------------- epochs
+def test_engine_restore_bumps_epoch_and_pushes(tmp_path):
+    from streambench_tpu.utils.ids import make_ids
+
+    rng = random.Random(3)
+    campaigns = make_ids(5, rng)
+    ads = make_ids(10, rng)
+    mapping = {a: campaigns[i // 2] for i, a in enumerate(ads)}
+    cfg = default_config(jax_num_campaigns=5, jax_batch_size=128)
+    eng = ReachSketchEngine(cfg, mapping, campaigns=campaigns,
+                            k=16, registers=16)
+    srv = ReachQueryServer(list(eng.encoder.campaigns), depth=16,
+                           batch=4)
+    try:
+        eng.attach_reach(srv)
+        assert srv.epoch == 0
+        lines = [
+            ('{"user_id": "u%d", "page_id": "p", "ad_id": "%s", '
+             '"ad_type": "banner", "event_type": "view", '
+             '"event_time": "%d", "ip_address": "1.2.3.4"}'
+             % (i, ads[i % 10], 1_000_000 + i * 10)).encode()
+            for i in range(400)]
+        eng.process_chunk(lines)
+        eng.flush()
+        snap = eng.snapshot(offset=1)
+        before = np.asarray(eng.state.mins).copy()
+        eng.restore(snap)             # resume on the SAME engine
+        assert eng.reach_epoch == 1 and srv.epoch == 1
+        np.testing.assert_array_equal(np.asarray(eng.state.mins), before)
+        # a fresh engine restoring the same snapshot also moves PAST the
+        # snapshot's recorded epoch (strictly increasing across lineages)
+        eng2 = ReachSketchEngine(cfg, mapping, campaigns=campaigns,
+                                 k=16, registers=16)
+        snap2 = eng.snapshot(offset=2)     # carries reach_epoch=1
+        eng2.restore(snap2)
+        assert eng2.reach_epoch == 2
+        got = []
+        srv.submit([campaigns[0]], "union", lambda d: got.append(d))
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got and got[0]["epoch"] == 1
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------- SLO objective
+def test_reach_slo_objective_burn_and_verdict():
+    from streambench_tpu.obs import MetricsRegistry
+    from streambench_tpu.obs.slo import SloTracker
+    from streambench_tpu.reach.serve import LATENCY_HIST
+
+    clock = {"t": 0.0}
+    reg = MetricsRegistry()
+    slo = SloTracker(reg, reach_p99_ms=100, budget=0.1, fast_s=5,
+                     slow_s=20, clock=lambda: clock["t"])
+    assert slo.active
+    hist = reg.histogram(LATENCY_HIST)   # the shared serve instrument
+    for _ in range(20):
+        clock["t"] += 1
+        hist.observe(10)
+        rec: dict = {}
+        slo.collect(rec, 1.0)
+        assert rec["slo"]["burn"]["reach"]["fast"] == 0.0
+    for _ in range(4):
+        clock["t"] += 1
+        hist.observe(10_000)
+        rec = {}
+        slo.collect(rec, 1.0)
+    burns = rec["slo"]["burn"]["reach"]
+    assert burns["fast"] == pytest.approx(8.0, rel=0.01)
+    assert burns["slow"] == pytest.approx(2.0, rel=0.01)
+    assert rec["slo"]["in_breach"] and slo.breaches == 1
+    assert rec["slo"]["total_reach"] == 24
+    v = slo.verdict()
+    assert v["objectives"]["reach_p99_ms"] == 100
+    assert v["total_reach"] == 24 and v["bad_reach"] == 4
+    assert v["pass"] is False
+
+
+# ----------------------------------------------------- chaos acceptance
+def test_query_storm_under_sink_outage_and_crashes(tmp_path):
+    """The acceptance sweep: a pub/sub query storm runs concurrently
+    with a supervised reach run whose FaultPlan injects a sink outage
+    and mid-run crashes.  Every query sheds or answers (none lost, no
+    crash propagates to a client), and once the run has resumed and
+    completed, fresh answers carry the LIVE epoch — never a stale one."""
+    from streambench_tpu.chaos import FaultInjector, FaultPlan, Supervisor
+    from streambench_tpu.checkpoint import Checkpointer
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.dimensions.pubsub import PubSubClient, PubSubServer
+    from streambench_tpu.engine.runner import StreamRunner
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.journal import FileBroker
+    from streambench_tpu.io.redis_schema import as_redis
+
+    # flush every ~1 ms so checkpoints land BETWEEN batches: the crash
+    # must find a snapshot to resume from, or restore (and the epoch
+    # bump under test) would never run on this fast a catchup
+    cfg = default_config(jax_batch_size=256, jax_scan_batches=2,
+                         jax_flush_interval_ms=1,
+                         jax_sink_retry_base_ms=1,
+                         jax_sink_retry_cap_ms=4)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=6_000,
+                 rng=random.Random(7), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    campaigns = gen.load_ids(str(tmp_path))[0]
+
+    plan = FaultPlan.generate(77, sink_rate=0.3, sink_ops=8,
+                              sink_outage=(0, 4), crashes=0)
+    plan = FaultPlan(seed=plan.seed, sink_faults=plan.sink_faults,
+                     journal_faults=plan.journal_faults,
+                     crashes=(("batch", 3), ("batch", 2)))
+    inj = FaultInjector(plan)
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    srv = ReachQueryServer(campaigns, depth=8, batch=4)
+    ps = PubSubServer(port=0).start()
+    ps.register_query("reach", srv.handle)
+    engines = []
+
+    def make_runner():
+        eng = ReachSketchEngine(cfg, mapping, campaigns=campaigns,
+                                redis=inj.wrap_redis(r), k=16,
+                                registers=16)
+        eng.attach_reach(srv)
+        engines.append(eng)
+        reader = inj.wrap_reader(broker.reader(cfg.kafka_topic))
+        return StreamRunner(eng, reader, checkpointer=ckpt,
+                            crash_points=inj.scheduler)
+
+    host, port = ps.address
+    done = threading.Event()
+    storm: dict = {"sent": 0, "answers": [], "errors": []}
+
+    def client():
+        try:
+            c = PubSubClient(host, port, timeout_s=30)
+            while not done.is_set():
+                sel = [campaigns[storm["sent"] % len(campaigns)]]
+                c.request({"type": "reach", "campaigns": sel,
+                           "op": "union", "id": storm["sent"]})
+                storm["sent"] += 1
+                storm["answers"].append(c.recv())
+                time.sleep(0.005)
+            c.close()
+        except Exception as e:   # a crash must never reach a client
+            storm["errors"].append(repr(e))
+
+    t = threading.Thread(target=client)
+    t.start()
+    try:
+        sup = Supervisor(make_runner, backoff_base_ms=1,
+                         backoff_cap_ms=4, seed=1)
+        st = sup.run(catchup=True)
+        assert st.completed, st.errors
+        assert st.crashes >= 2
+        live = engines[-1]
+        assert live.reach_epoch >= 1       # resumed lineages bumped
+        assert live.events_processed == 6_000
+        # the sink outage lands on the close-time reach-hash write (the
+        # only sink op this engine issues); serving must survive it
+        try:
+            live.close()
+        except Exception:
+            pass
+        assert inj.counters.get("chaos_sink_faults") > 0
+        # post-resume storm: answers must carry the LIVE epoch only
+        done.set()
+        t.join(timeout=30)
+        assert not storm["errors"], storm["errors"]
+        c = PubSubClient(host, port, timeout_s=30)
+        final = []
+        for i in range(10):
+            c.request({"type": "reach", "campaigns": campaigns[:3],
+                       "op": "overlap", "id": f"final{i}"})
+            final.append(c.recv()["data"])
+        c.close()
+        for d in final:
+            assert d.get("shed") or d["epoch"] == live.reach_epoch, d
+        assert any("estimate" in d for d in final)
+        # the storm's ledger: every query shed or answered, none lost
+        data = [a["data"] for a in storm["answers"]]
+        assert len(data) == storm["sent"]
+        assert all(("estimate" in d) or d.get("shed") for d in data)
+        published = {e.reach_epoch for e in engines} | {0}
+        assert {d["epoch"] for d in data if "epoch" in d} <= published
+    finally:
+        done.set()
+        t.join(timeout=10)
+        srv.close()
+        ps.close()
