@@ -23,6 +23,16 @@ stdout (the PR 6 truncation-proof contract) with the full detail in the
   evaluation, never one dispatch per query), with served/shed/p99 in
   the compact line.  A second, depth-starved server proves shed-oldest
   under overload (shed + served == sent, shed > 0).
+- **attribution** (ISSUE 11) — the storm re-run with the query-path
+  observability on (jax.obs.query + spans) and a CONCURRENT ingest
+  thread re-folding the journal: every query's submit -> reply latency
+  decomposes into queue/batch/dispatch/reply segments whose p50s sum
+  to within 10% of the e2e p50, shed + answered queries each leave
+  exactly one lifecycle record reconciling with
+  ``streambench_reach_shed_total``, the perfetto trace validates with
+  BOTH ingest and query lanes, and
+  ``streambench_reach_contention_ratio`` measures the fraction of
+  query queue-wait spent behind ingest dispatches.
 
 Budget: self-caps at ``STREAMBENCH_BENCH_BUDGET_S`` (default 840 s <
 the 870 s driver kill); the large rung is skipped (recorded, never
@@ -242,7 +252,7 @@ def run_verify(workdir: str, *, name: str, campaigns_n: int, users_n: int,
         assert o_err["mean"] <= ob, (o_err, ob)
         out["error_within_bounds"] = True
     out["ok"] = True
-    return out, eng, names, sets
+    return out, eng, names, sets, path
 
 
 def run_storm(eng, names, *, queries_n: int, clients: int, depth: int,
@@ -332,6 +342,213 @@ def run_storm(eng, names, *, queries_n: int, clients: int, depth: int,
     return out
 
 
+def run_attribution(eng, names, journal_path: str, workdir: str, *,
+                    queries_n: int, gap_s: float, depth: int,
+                    batch: int, shed_burst: int, slo_ms: int = 250,
+                    ingest_gap_s: float = 0.01,
+                    phase: str = "attribution") -> dict:
+    """The ISSUE 11 rung: a paced pub/sub query storm with query-path
+    observability ON, concurrent with an ingest thread re-folding the
+    journal (idempotent for cumulative sketches — the served state
+    never changes, only device occupancy does), followed by a shed
+    burst for the reconciliation check.
+
+    The ingest side is PACED (``ingest_gap_s`` between block folds):
+    on this 1-core host an unthrottled re-fold loop saturates both the
+    interpreter and the device queue and the query worker starves
+    outright — the ratio would measure GIL starvation, not device
+    contention.  Paced, each query's queue wait genuinely overlaps
+    some ingest dispatches and the ratio reads as designed."""
+    import jax
+
+    from streambench_tpu.dimensions.pubsub import PubSubClient, PubSubServer
+    from streambench_tpu.obs import MetricsRegistry, SpanTracer
+    from streambench_tpu.obs.queryattr import SEGMENTS, QueryLifecycle
+    from streambench_tpu.obs.spans import validate_chrome_trace
+    from streambench_tpu.reach.serve import ReachQueryServer
+
+    reg = MetricsRegistry()
+    spans = SpanTracer(capacity=16384, registry=reg)
+    old_sink = eng.tracer.sink
+    spans.attach(eng.tracer)         # ingest folds -> the shared ring
+    ql = QueryLifecycle(reg, slo_ms=slo_ms, slowlog_max=64, spans=spans)
+    srv = ReachQueryServer(names, depth=depth, batch=batch,
+                           registry=reg, queryattr=ql, spans=spans)
+    eng.attach_reach(srv)
+    ps = PubSubServer(port=0).start()
+    ps.register_query("reach", srv.handle)
+    host, port = ps.address
+
+    ingest_stop = threading.Event()
+    folded = {"events": 0}
+
+    def ingest() -> None:
+        # re-fold the journal in a loop: real device dispatches (real
+        # contention for the query worker) with idempotent state.
+        # block_until_ready after each block is the backpressure the
+        # runner's flush path provides in production — without it the
+        # async dispatch stream outruns the device without bound and
+        # query waits grow with the backlog instead of measuring it
+        while not ingest_stop.is_set():
+            with open(journal_path, "rb") as f:
+                carry = b""
+                while not ingest_stop.is_set():
+                    data = f.read(256 << 10)
+                    if not data:
+                        break
+                    data = carry + data
+                    nl = data.rfind(b"\n") + 1
+                    carry = data[nl:]
+                    eng.process_block(data[:nl])
+                    # the fold-sync window is the measured
+                    # device-busy evidence the contention ratio
+                    # intersects query queue-waits with
+                    t_d = time.perf_counter_ns()
+                    jax.block_until_ready(eng.state.mins)
+                    ql.note_ingest_busy(t_d, time.perf_counter_ns())
+                    folded["events"] = eng.events_processed
+                    time.sleep(ingest_gap_s)
+
+    rng = np.random.default_rng(4321)
+    answers: list = []
+    splits: list = []
+
+    def storm() -> None:
+        c = PubSubClient(host, port, timeout_s=120)
+        pending = 0
+        for qi in range(queries_n):
+            sel = [names[j] for j in rng.choice(
+                len(names), size=int(rng.integers(1, 5)),
+                replace=False)]
+            c.request({"type": "reach", "campaigns": sel,
+                       "op": "overlap" if qi % 2 else "union",
+                       "id": qi, "trace": f"bench-{qi}",
+                       "sent_ms": int(time.time() * 1000)})
+            pending += 1
+            # paced, but bounded in flight so a slow drain never
+            # deadlocks the blocking client against its own sends
+            while pending > 64:
+                d = c.recv()["data"]
+                answers.append(d)
+                s = c.latency_split(d)
+                if s is not None:
+                    splits.append(s)
+                pending -= 1
+            time.sleep(gap_s)
+        for _ in range(pending):
+            d = c.recv()["data"]
+            answers.append(d)
+            s = c.latency_split(d)
+            if s is not None:
+                splits.append(s)
+        c.close()
+
+    t_ing = threading.Thread(target=ingest, daemon=True)
+    t_storm = threading.Thread(target=storm)
+    t0 = time.monotonic()
+    t_ing.start()
+    t_storm.start()
+    t_storm.join(timeout=300)
+    ingest_stop.set()
+    t_ing.join(timeout=60)
+    storm_s = time.monotonic() - t0
+    assert not t_storm.is_alive(), "attribution storm never finished"
+    assert len(answers) == queries_n, (len(answers), queries_n)
+    assert all("estimate" in d or d.get("shed") for d in answers)
+    served_storm = sum("estimate" in d for d in answers)
+
+    # shed burst: overload a held server so shed lifecycle records and
+    # the shed counter must reconcile exactly
+    srv.pause()
+    got_burst: list = []
+    for qi in range(shed_burst):
+        srv.submit([names[qi % len(names)]], "union",
+                   lambda d: got_burst.append(d), query_id=f"b{qi}")
+    srv.resume()
+    deadline = time.monotonic() + 120
+    while len(got_burst) < shed_burst and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(got_burst) == shed_burst
+    jax.block_until_ready(eng.state.mins)
+    srv.close()
+    summary = srv.summary()
+    ps.close()
+    eng.tracer.sink = old_sink
+
+    qsum = ql.summary()
+    # --- reconciliation: every query leaves exactly ONE lifecycle
+    # record, and shed records == the Prometheus shed counter ---------
+    shed_counter = int(reg.counter("streambench_reach_shed_total").value)
+    assert qsum["shed_records"] == summary["shed"] == shed_counter, (
+        qsum["shed_records"], summary["shed"], shed_counter)
+    assert qsum["served_records"] == summary["served"], (
+        qsum["served_records"], summary["served"])
+    assert qsum["served_records"] + qsum["shed_records"] == (
+        queries_n + shed_burst), qsum
+    assert summary["shed"] > 0, "shed burst produced no sheds"
+
+    # --- segment partition: p50s sum to ~the e2e p50 -----------------
+    segs = {seg: qsum["segments"][seg] for seg in SEGMENTS}
+    p50_sum = sum(s.get("p50", 0.0) for s in segs.values())
+    e2e_p50 = qsum["e2e_ms"].get("p50", 0.0)
+    seg_sum_ratio = p50_sum / e2e_p50 if e2e_p50 else 0.0
+    # exact-sum check (no bucket error): segment sums total the e2e sum
+    sum_exact = sum(s.get("sum", 0.0) for s in segs.values())
+    assert abs(sum_exact - qsum["e2e_ms"]["sum"]) <= max(
+        1e-6 * qsum["e2e_ms"]["sum"], 5e-3), (sum_exact, qsum["e2e_ms"])
+    assert abs(seg_sum_ratio - 1.0) <= 0.10, (
+        f"segment p50 sum {p50_sum:.3f} vs e2e p50 {e2e_p50:.3f} "
+        f"({seg_sum_ratio:.3f})")
+
+    # --- perfetto trace: both lanes on one clock ---------------------
+    trace_path = os.path.join(workdir, "trace_reach_attr.json")
+    spans.dump(trace_path, run="bench-reach-attribution")
+    doc = json.load(open(trace_path))
+    problems = validate_chrome_trace(doc)
+    assert problems == [], problems
+    cats = {e.get("cat") for e in doc["traceEvents"]
+            if e.get("ph") == "X"}
+    assert "query" in cats and "stage" in cats, cats
+
+    cont = qsum["contention"]
+    net = sorted(s.get("network_ms", 0.0) for s in splits)
+    srvms = sorted(s.get("server_ms", 0.0) for s in splits)
+    out = {
+        "phase": phase, "queries": queries_n, "shed_burst": shed_burst,
+        "served": summary["served"], "shed": summary["shed"],
+        "served_storm": served_storm,
+        "dispatches": summary["dispatches"],
+        "storm_s": round(storm_s, 2),
+        "ingest_events_folded": folded["events"],
+        "segments": {seg: {"p50": round(s.get("p50", 0.0), 3),
+                           "p99": round(s.get("p99", 0.0), 3),
+                           "count": s.get("count", 0)}
+                     for seg, s in segs.items()},
+        "e2e_ms": {k: (round(v, 3) if isinstance(v, float) else v)
+                   for k, v in qsum["e2e_ms"].items()},
+        "seg_sum_ratio": round(seg_sum_ratio, 4),
+        "segment_sum_exact": True,
+        "shed_reconciled": True,
+        "contention_ratio": cont["ratio"],
+        "contention": {"queue_wait_ms": cont["queue_wait_ms"],
+                       "ingest_overlap_ms": cont["ingest_overlap_ms"]},
+        "slow_queries": qsum["slow_queries"],
+        "slo_ms": slo_ms,
+        "client_split": {
+            "n": len(splits),
+            "server_p50_ms": round(srvms[len(srvms) // 2], 3)
+            if srvms else None,
+            "network_p50_ms": round(net[len(net) // 2], 3)
+            if net else None,
+        },
+        "trace": {"path": os.path.basename(trace_path),
+                  "events": len(doc["traceEvents"]),
+                  "lanes": sorted(c for c in cats if c)},
+        "ok": True,
+    }
+    return out
+
+
 # ----------------------------------------------------------------------
 
 def main() -> int:
@@ -359,7 +576,7 @@ def main() -> int:
     ok = True
 
     # -- small rung: bit-exact vs exact set arithmetic ------------------
-    small, eng_s, names_s, _ = run_verify(
+    small, eng_s, names_s, _, journal_s = run_verify(
         workdir, name="small", campaigns_n=40, users_n=500,
         events_n=50_000, k=256, registers=256, queries_n=256,
         seed=17, bitexact=True)
@@ -379,13 +596,21 @@ def main() -> int:
                          phase="shed")
         doc["shed"] = shed
         print(compact_line(shed), flush=True)
+        attr = run_attribution(
+            eng_s, names_s, journal_s, workdir, queries_n=120,
+            gap_s=0.005, depth=128, batch=16, shed_burst=200)
+        doc["attribution"] = attr
+        print(compact_line(attr), flush=True)
+        log(f"attribution ok: seg_sum_ratio {attr['seg_sum_ratio']} "
+            f"contention {attr['contention_ratio']}")
     elif time.monotonic() > deadline - 120:
         doc["large"] = {"skipped": "budget"}
         doc["storm"] = {"skipped": "budget"}
+        doc["attribution"] = {"skipped": "budget"}
         ok = False
         log("budget exhausted before the large rung — recorded, not silent")
     else:
-        large, eng_l, names_l, _ = run_verify(
+        large, eng_l, names_l, _, journal_l = run_verify(
             workdir, name="large", campaigns_n=100, users_n=130_000,
             events_n=600_000, k=256, registers=1024, queries_n=512,
             seed=23, bitexact=True)
@@ -408,16 +633,42 @@ def main() -> int:
         doc["shed"] = shed
         print(compact_line(shed), flush=True)
         log(f"shed rung ok: {shed['shed']} shed of {shed['sent']}")
+        # ISSUE 11: the storm re-run with query obs on + concurrent
+        # ingest — segment decomposition, shed reconcile, contention
+        # ingest_gap_s tuned to a ~30% duty cycle: this engine's
+        # per-block fold+sync is ~110 ms (C=100, R=1024), and a
+        # near-100% duty cycle makes the latency distribution bimodal
+        # around the fold time — the p50-sum check then compares
+        # medians across modes instead of decomposing the typical
+        # path.  The ~9 folds the paced storm spans still put real
+        # ingest-busy windows under the queue waits (the tail
+        # dominates total wait, so the contention ratio stays
+        # evidence-backed).
+        attr = run_attribution(
+            eng_l, names_l, journal_l, workdir, queries_n=400,
+            gap_s=0.008, depth=128, batch=64, shed_burst=240,
+            ingest_gap_s=0.25)
+        doc["attribution"] = attr
+        print(compact_line(attr), flush=True)
+        log(f"attribution ok: seg_sum_ratio {attr['seg_sum_ratio']} "
+            f"contention {attr['contention_ratio']} "
+            f"({attr['ingest_events_folded']} ev folded concurrently)")
 
     # regress-gate keys (obs/regress.py normalize_bench reads doc.reach)
     storm_doc = doc.get("storm") or {}
     if storm_doc.get("ok"):
         doc["reach"] = {"qps": storm_doc["qps"],
                         "p99_ms": storm_doc["p99_ms"]}
+    attr_doc = doc.get("attribution") or {}
+    if attr_doc.get("ok") and "reach" in doc:
+        # per-segment p50s + contention ratio, the ISSUE 11 regress keys
+        doc["reach"]["segments"] = {
+            seg: d["p50"] for seg, d in attr_doc["segments"].items()}
+        doc["reach"]["contention_ratio"] = attr_doc["contention_ratio"]
     doc["ok"] = ok and all(
         (doc.get(p) or {}).get("ok") for p in
-        (("small", "storm", "shed") if args.smoke
-         else ("small", "large", "storm", "shed")))
+        (("small", "storm", "shed", "attribution") if args.smoke
+         else ("small", "large", "storm", "shed", "attribution")))
     doc["wall_s"] = round(time.monotonic() - _T0, 1)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)

@@ -103,6 +103,11 @@ OBS_DEVMEM = os.environ.get("OBS_DEVMEM", "") not in (
 OBS_SHARD = os.environ.get("OBS_SHARD", "") not in ("", "0", "false", "no")
 OBS_CAPTURE = os.environ.get("OBS_CAPTURE", "") not in (
     "", "0", "false", "no")
+# Query-path attribution for the reach serving tier (obs/queryattr):
+# OBS_QUERY=1 decomposes every reach query's submit->reply latency into
+# queue/batch/dispatch/reply segments, keeps a bounded slow-query log,
+# and — with OBS_SPANS=1 — exports the ingest-contention ratio.
+OBS_QUERY = os.environ.get("OBS_QUERY", "") not in ("", "0", "false", "no")
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -313,6 +318,7 @@ def op_setup() -> None:
         # the env knob means "prove capture works": fire one bounded
         # window at startup so smoke runs always produce an xprof dir
         "jax.obs.capture.oneshot": OBS_CAPTURE,
+        "jax.obs.query": OBS_QUERY,
     })
     log(f"wrote {CONF_FILE}")
     try:

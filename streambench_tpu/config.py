@@ -233,6 +233,22 @@ class BenchmarkConfig:
     #   objective — a served query slower than this (submit -> reply)
     #   is "bad"; judged by the same two-window burn-rate machinery as
     #   jax.slo.p99.ms, surfaced under objective="reach"
+    # --- query-path observability (obs/queryattr; ISSUE 11 — the
+    # serving-tier mirror of jax.obs.lifecycle; default-off: reply
+    # payloads stay byte-identical) ---
+    jax_obs_query: bool = False            # stamp each reach query's
+    #   journey (admission, queue-exit, dispatch submit/complete, reply
+    #   write) and decompose its submit->reply latency into
+    #   queue/batch/dispatch/reply segment histograms
+    #   (streambench_reach_segment_ms) + the ingest-contention ratio
+    #   when jax.obs.spans is also on; replies gain a "server" block
+    jax_obs_query_slowlog: int = 128       # slow-query log capacity:
+    #   full decompositions of queries over jax.reach.slo.p99.ms,
+    #   oldest-first eviction (counted, never silent)
+    jax_obs_query_sample: int = 1          # 1-in-N reach dispatches
+    #   additionally timed to block_until_ready for the pure device
+    #   histogram (the worker materializes results synchronously, so
+    #   even 1 costs only a split stamp)
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -393,6 +409,10 @@ class BenchmarkConfig:
             jax_reach_queue_depth=max(
                 geti("jax.reach.queue.depth", 512), 1),
             jax_reach_slo_p99_ms=max(geti("jax.reach.slo.p99.ms", 0), 0),
+            jax_obs_query=getb("jax.obs.query", False),
+            jax_obs_query_slowlog=max(
+                geti("jax.obs.query.slowlog", 128), 1),
+            jax_obs_query_sample=max(geti("jax.obs.query.sample", 1), 1),
             raw=dict(conf),
         )
 

@@ -35,8 +35,54 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 
 _WS_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+#: request() keeps at most this many in-flight send stamps per client
+#: (latency_split pops them; an abandoned query must not grow memory)
+MAX_INFLIGHT_STAMPS = 4096
+
+
+class _LatencySplitMixin:
+    """Client-side half of the query-path decomposition (ISSUE 11):
+    ``request()`` stamps each outgoing query's send time; when the
+    answer carries the server-side ``server`` block (the reach server
+    includes it under ``jax.obs.query``), ``latency_split`` divides the
+    measured round trip into server-vs-network halves — the piece no
+    server-side histogram can see."""
+
+    def _note_request(self, msg: dict) -> None:
+        stamps = getattr(self, "_inflight", None)
+        if stamps is None:
+            stamps = self._inflight = {}
+        qid = msg.get("id")
+        if qid is None:
+            return
+        while len(stamps) >= MAX_INFLIGHT_STAMPS:
+            stamps.pop(next(iter(stamps)))
+        stamps[qid] = time.monotonic()
+
+    def latency_split(self, data: dict) -> "dict | None":
+        """Split one answered query's round trip.  ``data`` is the
+        payload ``recv()`` returned (the ``"data"`` member of the data
+        message).  Returns ``{"rtt_ms", "server_ms", "network_ms"}``
+        when the reply carries the server decomposition, ``{"rtt_ms"}``
+        when it does not (query obs off server-side), or None when the
+        answer's id was never stamped by ``request()``."""
+        stamps = getattr(self, "_inflight", None)
+        t0 = stamps.pop(data.get("id"), None) if stamps else None
+        if t0 is None:
+            return None
+        rtt_ms = (time.monotonic() - t0) * 1000.0
+        out = {"rtt_ms": round(rtt_ms, 3)}
+        server = data.get("server")
+        if isinstance(server, dict) and isinstance(
+                server.get("total_ms"), (int, float)):
+            out["server_ms"] = server["total_ms"]
+            out["network_ms"] = round(
+                max(rtt_ms - server["total_ms"], 0.0), 3)
+        return out
 
 
 def query_uri(host: str, port: int) -> str:
@@ -410,6 +456,7 @@ class PubSubServer:
         # the gateway's request/response half next to topic pub/sub
         self._queries: dict[str, object] = {}
         self._lock = threading.Lock()
+        self._started = False
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
 
@@ -419,6 +466,7 @@ class PubSubServer:
 
     def start(self) -> "PubSubServer":
         self._thread.start()
+        self._started = True
         return self
 
     def register_query(self, kind: str, fn) -> None:
@@ -466,11 +514,16 @@ class PubSubServer:
         return sent
 
     def close(self) -> None:
-        self._srv.shutdown()
+        # shutdown() blocks on an ack from the serve_forever loop; if
+        # start() never ran there is no loop to ack and close() would
+        # hang forever (the PR 10 gotcha).  server_close() alone
+        # releases the listening socket either way.
+        if self._started:
+            self._srv.shutdown()
         self._srv.server_close()
 
 
-class WebSocketClient:
+class WebSocketClient(_LatencySplitMixin):
     """Minimal RFC 6455 client for the ``ws://<host>:<port>/pubsub``
     endpoint (tests + CLI queries over the reference's wire protocol).
     Client frames are masked, as the RFC requires."""
@@ -516,7 +569,9 @@ class WebSocketClient:
     def request(self, msg: dict) -> None:
         """Send a query-verb message (e.g. ``{"type": "reach",
         "campaigns": [...], "op": "union"}``); the answer arrives as a
-        normal data message via ``recv()``."""
+        normal data message via ``recv()``.  The send time is stamped
+        per id so ``latency_split`` can divide the round trip."""
+        self._note_request(msg)
         self._send(msg)
 
     def _send(self, msg: dict) -> None:
@@ -567,7 +622,7 @@ class WebSocketClient:
             self._sock.close()
 
 
-class PubSubClient:
+class PubSubClient(_LatencySplitMixin):
     """Blocking JSON-lines client (tests + CLI queries)."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0):
@@ -583,7 +638,9 @@ class PubSubClient:
 
     def request(self, msg: dict) -> None:
         """Send a query-verb message; the answer arrives as a normal
-        data message via ``recv()``."""
+        data message via ``recv()``.  The send time is stamped per id
+        so ``latency_split`` can divide the round trip."""
+        self._note_request(msg)
         self._send(msg)
 
     def _send(self, msg: dict) -> None:

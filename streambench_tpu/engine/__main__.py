@@ -240,16 +240,18 @@ def main(argv: list[str] | None = None) -> int:
     # (0 = ephemeral, the chosen port is printed below so harnesses and
     # the smoke test can scrape without a race).
     sampler = metrics_server = occupancy = slo = None
-    xfer = shard = devmem = capture = None
+    xfer = shard = devmem = capture = query_obs = None
     registry = None
     slo_wanted = (cfg.jax_slo_p99_ms > 0 or cfg.jax_slo_rate_evps > 0
                   or (args.engine == "reach"
                       and cfg.jax_reach_slo_p99_ms > 0))
+    query_obs_wanted = args.engine == "reach" and cfg.jax_obs_query
     if (cfg.jax_metrics_interval_ms > 0 or cfg.jax_metrics_port >= 0
             or cfg.jax_obs_lifecycle or cfg.jax_obs_spans
             or cfg.jax_obs_occupancy or slo_wanted
             or cfg.jax_obs_xfer or cfg.jax_obs_devmem
-            or cfg.jax_obs_shard or cfg.jax_obs_capture):
+            or cfg.jax_obs_shard or cfg.jax_obs_capture
+            or query_obs_wanted):
         from streambench_tpu.obs import (
             CaptureManager,
             DeviceMemoryLedger,
@@ -302,8 +304,29 @@ def main(argv: list[str] | None = None) -> int:
         if cfg.jax_obs_devmem:
             devmem = DeviceMemoryLedger(registry)
             devmem.analyze_engine(engine)
-        if occupancy is not None:
-            occupancy.mark_steady()
+        # NOTE: occupancy.mark_steady() is deferred until AFTER the
+        # reach serving block below — the query server pre-compiles
+        # its padded batch_query kernel at the first state push
+        # (attach_reach), and that compile must count as warmup, not
+        # as a steady-state violation.
+        # jax.obs.query: per-query lifecycle attribution for the reach
+        # serving tier (the query-side WindowLifecycle).  Built here so
+        # the SLO tracker below can attach segment attribution to
+        # breach events; the ReachQueryServer gets it further down.
+        # With spans also on, the queue-wait/ingest-dispatch overlap
+        # feeds streambench_reach_contention_ratio.
+        if query_obs_wanted:
+            from streambench_tpu.obs.queryattr import QueryLifecycle
+
+            query_obs = QueryLifecycle(
+                registry, slo_ms=cfg.jax_reach_slo_p99_ms,
+                slowlog_max=cfg.jax_obs_query_slowlog,
+                sample_every=cfg.jax_obs_query_sample, spans=spans)
+            if occupancy is not None:
+                # the contention numerator's production evidence: the
+                # occupancy sampler's measured busy windows (async
+                # ingest dispatch spans cover only the submit call)
+                occupancy.busy_sink = query_obs.note_ingest_busy
         metrics_path = os.path.join(args.workdir, "metrics.jsonl")
         sampler = MetricsSampler(
             metrics_path,
@@ -341,7 +364,7 @@ def main(argv: list[str] | None = None) -> int:
                 slow_s=cfg.jax_slo_slow_s,
                 use_lifecycle=cfg.jax_obs_lifecycle,
                 annotate=sampler.annotate, flightrec=flightrec,
-                capture=capture)
+                capture=capture, queryattr=query_obs)
             sampler.add_collector(slo.collect)
         sampler.start()
         endpoint = ""
@@ -365,13 +388,29 @@ def main(argv: list[str] | None = None) -> int:
         reach_ps = PubSubServer(port=0).start()
         reach_srv = ReachQueryServer(
             list(engine.encoder.campaigns),
-            depth=cfg.jax_reach_queue_depth, registry=registry)
+            depth=cfg.jax_reach_queue_depth, registry=registry,
+            queryattr=query_obs, spans=spans, flightrec=flightrec)
         reach_ps.register_query("reach", reach_srv.handle)
         engine.attach_reach(reach_srv)
+        if sampler is not None and query_obs is not None:
+            # every metrics.jsonl snapshot carries the live serving
+            # picture (segments, contention, slow-query log) under
+            # "reach_query" — the block `obs report/diff` renders
+            def _reach_query_collect(rec, dt_s, srv=reach_srv):
+                rec["reach_query"] = srv.summary()
+
+            sampler.add_collector(_reach_query_collect)
         r_host, r_port = reach_ps.address
+        qobs = " query_obs=on" if query_obs is not None else ""
         print(f"reach: pubsub={r_host}:{r_port} "
               f"queue_depth={cfg.jax_reach_queue_depth} k={engine.k} "
-              f"registers={engine.registers}", flush=True)
+              f"registers={engine.registers}{qobs}", flush=True)
+
+    # everything is compiled now — engine warmup AND the reach query
+    # kernel (warmed at the first state push above); any compile from
+    # here on is a genuine mid-run stall
+    if occupancy is not None:
+        occupancy.mark_steady()
 
     xo = " exactly_once=on" if cfg.jax_sink_exactly_once else ""
     print(f"engine up: topic={cfg.kafka_topic} redis={cfg.redis_host}:"

@@ -303,6 +303,16 @@ class ReachSketchEngine(_SketchEngineBase):
         self.state = minhash.scan_steps_packed(
             self.state, self.join_table, packed, user_idx, event_time)
 
+    def warmup(self) -> None:
+        """Base warmup + the close-time estimate program:
+        ``minhash.estimate`` first runs when ``close()`` writes the
+        reach hash, and an uncompiled program there lands AFTER
+        ``mark_steady`` — a false mid-run-stall warning from the
+        recompile detector.  ``estimate`` is read-only, so compiling
+        it here is state-neutral."""
+        super().warmup()
+        np.asarray(minhash.estimate(self.state.registers))
+
     # -- serving -------------------------------------------------------
     def attach_reach(self, server) -> None:
         """Wire a ReachQueryServer: immediate initial push (possibly

@@ -81,9 +81,13 @@ class FaultCounters:
         with self._lock:
             self._counts[key] += n
 
-    def get(self, key: str) -> int:
+    def get(self, key: str, default: int = 0) -> int:
+        """Current count for ``key``; ``default`` for a key never
+        bumped (the dict-like signature callers kept reaching for —
+        PR 10 shipped without it and call sites had to know the
+        zero-default by heart)."""
         with self._lock:
-            return self._counts.get(key, 0)
+            return self._counts.get(key, default)
 
     def snapshot(self) -> dict[str, int]:
         """Non-zero counters as a plain dict (RunStats surfacing)."""

@@ -42,6 +42,12 @@ recorder).  Four pieces, all stdlib, all default-off:
   SIGUSR2 / config one-shot, with cooldown + cap;
   ``jax.obs.capture.*``); also owns the one process-global profiler
   start/stop path ``trace.device_trace`` delegates to
+- ``queryattr`` — per-query latency attribution for the reach serving
+  tier (``jax.obs.query``): submit->reply decomposed into
+  queue/batch/dispatch/reply segments that sum to it, a bounded
+  slow-query log, and the ingest-contention ratio
+  (``streambench_reach_contention_ratio``) computed from the span
+  ring's ingest dispatch spans
 
 Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
 > 0 and/or ``jax.metrics.port`` >= 0); embed via::
@@ -68,6 +74,7 @@ from streambench_tpu.obs.occupancy import (  # noqa: F401
     CompileWatcher,
     OccupancySampler,
 )
+from streambench_tpu.obs.queryattr import QueryLifecycle  # noqa: F401
 from streambench_tpu.obs.registry import (  # noqa: F401
     Counter,
     Gauge,

@@ -32,8 +32,11 @@ from streambench_tpu.obs.report import (
     render_attribution_diff,
     render_diff,
     render_report,
+    render_serve,
+    render_serve_diff,
     summarize,
     summarize_attribution,
+    summarize_serve,
 )
 
 
@@ -57,6 +60,15 @@ def build_parser() -> argparse.ArgumentParser:
     att.add_argument("path_b", nargs="?", default=None)
     att.add_argument("--json", action="store_true",
                      help="emit the attribution dict(s) instead of text")
+    srv = sub.add_parser(
+        "serve",
+        help="reach serving-layer attribution (query segment table, "
+             "contention ratio, slow-query log; give a second path to "
+             "diff B vs A)")
+    srv.add_argument("path")
+    srv.add_argument("path_b", nargs="?", default=None)
+    srv.add_argument("--json", action="store_true",
+                     help="emit the serving dict(s) instead of text")
     trc = sub.add_parser(
         "trace", help="validate + summarize a Chrome trace-event file "
                       "(obs.spans trace_<run>.json)")
@@ -115,6 +127,17 @@ def main(argv: list[str] | None = None) -> int:
             s = summarize_trace(doc, path=args.path)
             print(json.dumps(s) if args.json
                   else render_trace_summary(s))
+            return 0
+        if args.cmd == "serve":
+            a = summarize_serve(load_records(args.path),
+                                path=args.path)
+            if args.path_b:
+                b = summarize_serve(load_records(args.path_b),
+                                    path=args.path_b)
+                print(json.dumps({"a": a, "b": b}) if args.json
+                      else render_serve_diff(a, b))
+            else:
+                print(json.dumps(a) if args.json else render_serve(a))
             return 0
         if args.cmd == "report":
             s = summarize(load_records(args.path), path=args.path)

@@ -157,6 +157,12 @@ class OccupancySampler:
         self.dispatches = 0
         self.sampled = 0
         self.busy_ns = 0
+        # Optional ``fn(start_ns, end_ns)`` fed every sampled busy
+        # window (obs.queryattr.QueryLifecycle.note_ingest_busy): the
+        # reach contention ratio's production evidence — an async
+        # dispatch span cannot cover device time, a sampled
+        # block_until_ready window does.
+        self.busy_sink = None
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._hist = self._g_ratio = None
@@ -194,6 +200,11 @@ class OccupancySampler:
         t0 = time.perf_counter_ns()
         jax.block_until_ready(state)
         dt = time.perf_counter_ns() - t0
+        if self.busy_sink is not None:
+            try:
+                self.busy_sink(t0, t0 + dt)
+            except Exception:
+                pass   # a broken sink must not kill the hot path
         with self._lock:
             self.sampled += 1
             self.busy_ns += dt

@@ -64,6 +64,16 @@ DEFAULT_TOLERANCES: dict = {
     # latency UP; generous like the other timing rows (1-core variance)
     "reach_qps": ("higher", 0.5),
     "reach_p99_ms": ("lower", 1.0),
+    # query-path attribution (ISSUE 11): per-segment p50s regress UP
+    # (more time in any segment is worse), as does the fraction of
+    # query queue-wait spent behind ingest dispatches.  Generous: the
+    # timing rows share the 1-core host's 2-4x variance, and the
+    # contention ratio depends on the concurrent-ingest pacing.
+    "reach_segment_queue_ms": ("lower", 1.0),
+    "reach_segment_batch_ms": ("lower", 1.0),
+    "reach_segment_dispatch_ms": ("lower", 1.0),
+    "reach_segment_reply_ms": ("lower", 1.0),
+    "reach_contention_ratio": ("lower", 1.0),
 }
 
 
@@ -125,6 +135,15 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
     if isinstance(reach, dict):
         out["reach_qps"] = _num(reach.get("qps"))
         out["reach_p99_ms"] = _num(reach.get("p99_ms"))
+        # ISSUE 11: per-segment p50s + contention ratio from the
+        # attribution phase (segments values are p50 scalars, or full
+        # summaries when an engine stats line is compared directly)
+        for seg, v in (reach.get("segments") or {}).items():
+            if isinstance(v, dict):
+                v = v.get("p50")
+            out[f"reach_segment_{seg}_ms"] = _num(v)
+        out["reach_contention_ratio"] = _num(
+            reach.get("contention_ratio"))
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -101,6 +101,9 @@ def summarize(records: list[dict], path: str = "") -> dict:
         "xfer": last_block("xfer"),
         "devmem": last_block("devmem"),
         "shard_skew": last_block("shard_skew"),
+        # serving-tier obs (layer 5, jax.obs.query): newest per-query
+        # attribution block the reach collector journals
+        "reach_query": last_block("reach_query"),
         "faults": last.get("faults") or {},
         "stages": stages,
         "annotations": [{k: r.get(k) for k in ("event", "uptime_ms")}
@@ -192,6 +195,29 @@ def render_report(s: dict) -> str:
         lines.append(f"    rows {sk.get('rows')}  dropped "
                      f"{sk.get('dropped')}  imbalance "
                      f"{_fmt(sk.get('imbalance_ratio'))}")
+    rqo = (s.get("reach_query") or {}).get("query_obs")
+    if rqo:
+        lines.append("  reach query attribution (submit -> reply):")
+        lines.append(f"    tracked {_fmt(rqo.get('served_records'))}  "
+                     f"shed {_fmt(rqo.get('shed_records'))}  "
+                     f"slow {_fmt(rqo.get('slow_queries'))}")
+        for seg, summ in (rqo.get("segments") or {}).items():
+            if summ.get("count"):
+                lines.append(
+                    f"    seg {seg:<9} p50 {_fmt(summ.get('p50')):>10} "
+                    f"ms  p99 {_fmt(summ.get('p99')):>10} ms")
+        e2e = rqo.get("e2e_ms") or {}
+        if e2e.get("count"):
+            lines.append(
+                f"    e2e           p50 {_fmt(e2e.get('p50')):>10} ms  "
+                f"p99 {_fmt(e2e.get('p99')):>10} ms")
+        cont = rqo.get("contention") or {}
+        if cont:
+            lines.append(
+                f"    contention ratio {_fmt(cont.get('ratio'))} "
+                f"(queue wait {_fmt(cont.get('queue_wait_ms'))} ms, "
+                f"ingest overlap {_fmt(cont.get('ingest_overlap_ms'))} "
+                "ms)")
     if s["faults"]:
         lines.append("  faults:")
         for k in sorted(s["faults"]):
@@ -212,6 +238,115 @@ def render_report(s: dict) -> str:
                          f"{a.get('event')} {extras or ''}".rstrip())
     if s.get("run_stats"):
         lines.append(f"  run_stats: {json.dumps(s['run_stats'])}")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------------
+# serving-layer query attribution (obs.queryattr): the "reach_query"
+# block each snapshot carries, rendered by `obs serve A [B]`
+def summarize_serve(records: list[dict], path: str = "") -> dict:
+    """Newest ``reach_query`` block out of one run's records (final
+    record first, torn tail falls back to the last intact snapshot)."""
+    rq = None
+    for r in reversed(records):
+        if isinstance(r.get("reach_query"), dict):
+            rq = r["reach_query"]
+            break
+    return {"path": path, "reach_query": rq}
+
+
+def render_serve(s: dict) -> str:
+    """One run's serving-layer table: admission/shed counters, the
+    segment decomposition, contention, and the slow-query log tail."""
+    rq = s.get("reach_query") or {}
+    qobs = rq.get("query_obs")
+    lines = [f"reach serving attribution: {s['path'] or '(records)'}"]
+    if not qobs:
+        lines.append("  no reach_query records "
+                     "(run --engine reach with jax.obs.query: true)")
+        return "\n".join(lines)
+    lines.append(f"  served {_fmt(rq.get('served'))}  "
+                 f"shed {_fmt(rq.get('shed'))}  "
+                 f"rejected {_fmt(rq.get('rejected'))}  "
+                 f"dispatches {_fmt(rq.get('dispatches'))}  "
+                 f"queue high-water {_fmt(rq.get('queue_high_water'))}"
+                 f"/{_fmt(rq.get('queue_depth'))}")
+    lines.append(f"  lifecycle records: {_fmt(qobs.get('served_records'))}"
+                 f" served + {_fmt(qobs.get('shed_records'))} shed")
+    segs = qobs.get("segments") or {}
+    p50_sum = sum(_p50(v) for v in segs.values())
+    lines.append(f"  {'segment':<10} {'count':>8} {'p50_ms':>12} "
+                 f"{'p95_ms':>12} {'p99_ms':>12} {'share':>7}")
+    for name, summ in segs.items():
+        share = (f"{_p50(summ) / p50_sum * 100:.1f}%" if p50_sum else "-")
+        lines.append(
+            f"  {name:<10} {_fmt(summ.get('count') or 0):>8} "
+            f"{_fmt(summ.get('p50')):>12} {_fmt(summ.get('p95')):>12} "
+            f"{_fmt(summ.get('p99')):>12} {share:>7}")
+    e2e = qobs.get("e2e_ms") or {}
+    lines.append(f"  {'e2e':<10} {_fmt(e2e.get('count') or 0):>8} "
+                 f"{_fmt(e2e.get('p50')):>12} {_fmt(e2e.get('p95')):>12} "
+                 f"{_fmt(e2e.get('p99')):>12}")
+    if _p50(e2e):
+        cov = p50_sum / _p50(e2e) * 100
+        lines.append(f"  segment p50 sum {p50_sum:,.1f} ms = {cov:.1f}% "
+                     "of e2e p50")
+    shed_q = qobs.get("shed_queue_ms") or {}
+    if shed_q.get("count"):
+        lines.append(f"  shed queue wait    p50 {_fmt(shed_q.get('p50'))}"
+                     f" ms over {_fmt(shed_q['count'])} shed records")
+    cont = qobs.get("contention") or {}
+    lines.append(f"  contention ratio {_fmt(cont.get('ratio'))} "
+                 f"(ingest overlap {_fmt(cont.get('ingest_overlap_ms'))}"
+                 f" ms of {_fmt(cont.get('queue_wait_ms'))} ms queue "
+                 f"wait; busy evidence: "
+                 f"{_fmt(cont.get('busy_intervals'))} windows)")
+    if qobs.get("slow_queries"):
+        lines.append(f"  slow queries {_fmt(qobs['slow_queries'])} "
+                     f"(> {_fmt(qobs.get('slo_ms'))} ms; "
+                     f"{_fmt(qobs.get('slowlog_evicted'))} evicted)")
+        for e in (qobs.get("slowlog") or [])[-5:]:
+            lines.append(
+                f"    id={e.get('id')} e2e {_fmt(e.get('e2e_ms'))} ms = "
+                f"queue {_fmt(e.get('queue_ms'))} + batch "
+                f"{_fmt(e.get('batch_ms'))} + dispatch "
+                f"{_fmt(e.get('dispatch_ms'))} + reply "
+                f"{_fmt(e.get('reply_ms'))}")
+    return "\n".join(lines)
+
+
+def render_serve_diff(a: dict, b: dict) -> str:
+    """Two runs' serving segment p50/p99 side by side (B vs A)."""
+    lines = ["reach serving diff:",
+             f"  A: {a['path']}",
+             f"  B: {b['path']}"]
+    qa = (a.get("reach_query") or {}).get("query_obs")
+    qb = (b.get("reach_query") or {}).get("query_obs")
+    if not qa or not qb:
+        lines.append("  missing reach_query records in "
+                     + ("both runs" if not (qa or qb)
+                        else ("A" if not qa else "B")))
+        return "\n".join(lines)
+    lines.append(f"  {'segment':<10} {'A p50':>12} {'B p50':>12} "
+                 f"{'delta':>12} {'A p99':>12} {'B p99':>12}")
+    segs = list((qa.get("segments") or {}).keys())
+    for extra in (qb.get("segments") or {}):
+        if extra not in segs:
+            segs.append(extra)
+    rows = [(name, (qa.get("segments") or {}).get(name),
+             (qb.get("segments") or {}).get(name)) for name in segs]
+    rows.append(("e2e", qa.get("e2e_ms"), qb.get("e2e_ms")))
+    for name, sa, sb in rows:
+        pa, pb = _p50(sa), _p50(sb)
+        lines.append(
+            f"  {name:<10} {_fmt((sa or {}).get('p50')):>12} "
+            f"{_fmt((sb or {}).get('p50')):>12} "
+            f"{_fmt(round(pb - pa, 3)):>12} "
+            f"{_fmt((sa or {}).get('p99')):>12} "
+            f"{_fmt((sb or {}).get('p99')):>12}")
+    ca = (qa.get("contention") or {}).get("ratio")
+    cb = (qb.get("contention") or {}).get("ratio")
+    lines.append(f"  contention ratio: A {_fmt(ca)}  B {_fmt(cb)}")
     return "\n".join(lines)
 
 
@@ -338,6 +473,16 @@ def render_diff(a: dict, b: dict) -> str:
     if da or db:
         emit("devmem peak bytes", da.get("peak_footprint_bytes"),
              db.get("peak_footprint_bytes"))
+    qa = (a.get("reach_query") or {}).get("query_obs") or {}
+    qb = (b.get("reach_query") or {}).get("query_obs") or {}
+    if qa or qb:
+        sa, sb = qa.get("segments") or {}, qb.get("segments") or {}
+        for seg in sorted(set(sa) | set(sb)):
+            emit(f"reach {seg} p50 ms", (sa.get(seg) or {}).get("p50"),
+                 (sb.get(seg) or {}).get("p50"))
+        emit("reach contention",
+             (qa.get("contention") or {}).get("ratio"),
+             (qb.get("contention") or {}).get("ratio"))
     fault_keys = sorted(set(a["faults"]) | set(b["faults"]))
     for k in fault_keys:
         emit(f"fault {k}", a["faults"].get(k, 0), b["faults"].get(k, 0))

@@ -71,6 +71,9 @@ class SpanTracer:
         # metrics.jsonl / flight-recorder wall clocks
         self._t0_ns = time.perf_counter_ns()
         self.wall0_ms = now_ms()
+        # t0_ns is public API: the query-contention tracker
+        # (obs.queryattr) converts its own perf_counter_ns stamps into
+        # ring-relative time to intersect with ingest dispatch spans
         self._c_spans = self._c_dropped = None
         if registry is not None:
             self._c_spans = registry.counter(
@@ -79,6 +82,12 @@ class SpanTracer:
             self._c_dropped = registry.counter(
                 "streambench_spans_dropped_total",
                 "spans evicted from the bounded ring")
+
+    @property
+    def t0_ns(self) -> int:
+        """The ring's ``perf_counter_ns`` origin: ``ts_us`` fields are
+        relative to this stamp."""
+        return self._t0_ns
 
     # ------------------------------------------------------------------
     def add(self, name: str, start_ns: int, dur_ns: int,

@@ -23,6 +23,24 @@ control:
   recovery — the chaos sweep asserts no post-resume answer carries a
   pre-resume epoch.
 
+Query-path observability (ISSUE 11, all default-off):
+
+- ``queryattr`` (:class:`~streambench_tpu.obs.queryattr.QueryLifecycle`,
+  ``jax.obs.query``) stamps every query at admission / queue-exit /
+  dispatch-submit / dispatch-complete / reply-write and decomposes the
+  submit -> reply latency into queue/batch/dispatch/reply segments that
+  sum to it; shed victims get a queue-only record reconciling exactly
+  against ``streambench_reach_shed_total``; replies then carry a
+  ``server`` block so clients can split network-vs-server time.
+- ``spans`` (:class:`~streambench_tpu.obs.spans.SpanTracer`) receives
+  per-batch ``query_assembly``/``query_dispatch``/``query_reply`` spans
+  under the ``"query"`` category — the worker thread is its own lane in
+  the perfetto trace, interleaved with the engine's ingest folds on the
+  shared clock, which is what the contention ratio is computed from.
+- ``flightrec`` gets the serving black-box records: rate-limited shed
+  events and queue high-water marks, so a crash dump explains the
+  query backlog.
+
 State arrives by push (``update_state``): jax arrays are immutable, so
 the engine hands over its current references under the GIL and the
 worker evaluates against a consistent snapshot while folds continue.
@@ -46,7 +64,8 @@ LATENCY_HIST = "streambench_reach_latency_ms"
 class ReachQueryServer:
     def __init__(self, campaigns: list[str], *, depth: int = 512,
                  batch: int = rq.DEFAULT_BATCH, registry=None,
-                 hold: bool = False):
+                 hold: bool = False, queryattr=None, spans=None,
+                 flightrec=None):
         self.campaigns = list(campaigns)
         self._index = {c: i for i, c in enumerate(self.campaigns)}
         self.depth = max(int(depth), 1)
@@ -60,6 +79,16 @@ class ReachQueryServer:
         self.shed = 0
         self.rejected = 0
         self.dispatches = 0
+        # serving observability (ISSUE 11) — all None on the default
+        # path: one attribute check per admission/batch, replies
+        # byte-identical until jax.obs.query wires a QueryLifecycle
+        self._queryattr = queryattr
+        self._spans = spans
+        self._flightrec = flightrec
+        self.queue_high_water = 0
+        self._fr_hw_recorded = 1     # next high-water worth a record
+        self._fr_shed_last = 0.0     # monotonic stamp of last shed rec
+        self._warmed = False         # query kernel compiled (first push)
         self._lat_ring: deque = deque(maxlen=8192)  # ms, summary() only
         self._served_t0: float | None = None
         self._served_t1: float | None = None
@@ -81,12 +110,31 @@ class ReachQueryServer:
     # -- state push ----------------------------------------------------
     def update_state(self, mins, registers, epoch: int) -> None:
         """Engine-side push of the current sketch planes (immutable jax
-        arrays; the reference handoff is atomic under the GIL)."""
+        arrays; the reference handoff is atomic under the GIL).  The
+        FIRST push warms the padded query kernel on the caller's thread
+        — the engine-warmup rule ("pre-compile every device program
+        before announcing readiness") applied to the serving tier: an
+        XLA compile racing a concurrently-dispatching ingest thread can
+        starve for tens of seconds on a small host, and the first push
+        happens at attach time, before traffic."""
+        if not self._warmed:
+            self._warm(mins, registers)
         with self._cv:
             self._state = (mins, registers,
                            int(mins.shape[1]), int(registers.shape[1]),
                            int(epoch))
             self._cv.notify()
+
+    def _warm(self, mins, registers) -> None:
+        try:
+            C = len(self.campaigns)
+            np.asarray(rq.batch_query(
+                mins, registers, np.zeros((self.batch, C), bool),
+                np.zeros(self.batch, bool))[0])
+            self._warmed = True
+        except Exception:
+            pass   # a failed warmup must not block serving; the first
+            #        real batch compiles instead
 
     @property
     def epoch(self) -> int | None:
@@ -96,11 +144,15 @@ class ReachQueryServer:
     # -- admission -----------------------------------------------------
     def handle(self, msg: dict, reply) -> None:
         """The pub/sub query-verb hook: parse, admit (shedding the
-        oldest beyond depth), never raise."""
+        oldest beyond depth), never raise.  ``trace``/``sent_ms`` are
+        the client-side trace id and send stamp the lifecycle records
+        propagate (ignored when query obs is off)."""
         self.submit(msg.get("campaigns"), msg.get("op", "union"), reply,
-                    query_id=msg.get("id"))
+                    query_id=msg.get("id"), trace=msg.get("trace"),
+                    client_ms=msg.get("sent_ms"))
 
-    def submit(self, campaigns, op, reply, query_id=None) -> bool:
+    def submit(self, campaigns, op, reply, query_id=None, trace=None,
+               client_ms=None) -> bool:
         """Admit one query.  Returns False when it was rejected outright
         (malformed); shedding affects the *oldest* queued query, never
         the one being admitted."""
@@ -119,20 +171,62 @@ class ReachQueryServer:
                                          "campaign": c, "id": query_id})
                 return False
             idx.append(i)
+        rec = None
+        if self._queryattr is not None:
+            rec = self._queryattr.admit(trace=trace, qid=query_id,
+                                        client_ms=client_ms)
         item = (idx, op == "overlap", reply, query_id,
-                time.monotonic())
+                time.monotonic(), rec)
         victims = []
         with self._cv:
             self._q.append(item)
+            pending = len(self._q)
+            if pending > self.queue_high_water:
+                self.queue_high_water = pending
             while len(self._q) > self.depth:
                 victims.append(self._q.popleft())
                 self.shed += 1
                 if self._c_shed is not None:
                     self._c_shed.inc()
             self._cv.notify()
+        if (self._flightrec is not None
+                and self.queue_high_water >= 2 * self._fr_hw_recorded):
+            # high-water doubled since the last record: log2(depth)
+            # records max, so the bounded flight ring keeps room for
+            # the feeders that matter at crash time
+            self._fr_hw_recorded = self.queue_high_water
+            self._flightrec.record(
+                "reach_queue_high_water",
+                high_water=self.queue_high_water, depth=self.depth,
+                shed=self.shed, served=self.served)
         for old in victims:   # replies outside the lock: a slow socket
-            self._safe_reply(old[2], {"shed": True, "id": old[3]})
+            self._reply_shed(old)
+        if victims and self._flightrec is not None:
+            now = time.monotonic()
+            if now - self._fr_shed_last >= 1.0:
+                # rate-limited (1 Hz): a sustained overload leaves a
+                # trail without flooding the ring one record per victim
+                self._fr_shed_last = now
+                self._flightrec.record(
+                    "reach_shed", shed_total=self.shed,
+                    pending=self.pending(), depth=self.depth,
+                    served=self.served)
         return True
+
+    def _reply_shed(self, item) -> None:
+        """Answer one shed victim ``{"shed": true}``; with query obs on
+        the reply also carries the queue-only server block (shed
+        queries stamp too — the record count reconciles against the
+        shed counter exactly)."""
+        payload = {"shed": True, "id": item[3]}
+        rec = item[5]
+        if rec is not None:
+            queue_ms = self._queryattr.note_shed(rec)
+            block = {"queue_ms": round(queue_ms, 3)}
+            if rec.trace is not None:
+                block["trace"] = rec.trace
+            payload["server"] = block
+        self._safe_reply(item[2], payload)
 
     # -- hold/resume (bench storms: queue while held, then drain in
     # ceil(pending/batch) dispatches) ----------------------------------
@@ -165,6 +259,12 @@ class ReachQueryServer:
                     leftovers = list(self._q)
                     self._q.clear()
                     self.shed += len(leftovers)
+                    if self._c_shed is not None:
+                        # keep streambench_reach_shed_total == shed:
+                        # close-time stragglers are sheds like any other
+                        # (the lifecycle reconciliation depends on it)
+                        for _ in leftovers:
+                            self._c_shed.inc()
                 else:
                     leftovers = None
                 if leftovers is None and (self._hold
@@ -178,7 +278,7 @@ class ReachQueryServer:
                     state = self._state
             if leftovers is not None:
                 for it in leftovers:
-                    self._safe_reply(it[2], {"shed": True, "id": it[3]})
+                    self._reply_shed(it)
                 return
             try:
                 self._evaluate(items, state)
@@ -188,25 +288,57 @@ class ReachQueryServer:
                                              "id": it[3]})
 
     def _evaluate(self, items: list, state) -> None:
+        ql = self._queryattr
+        t_exit = time.perf_counter_ns()
+        recs = []
+        if ql is not None:
+            recs = [it[5] for it in items if it[5] is not None]
+            for r in recs:
+                r.t_exit = t_exit
         mins, registers, k, R, epoch = state
         C = len(self.campaigns)
         mask = np.zeros((self.batch, C), bool)
         overlap = np.zeros(self.batch, bool)
-        for row, (idx, is_overlap, _, _, _) in enumerate(items):
+        for row, (idx, is_overlap, _, _, _, _) in enumerate(items):
             mask[row, idx] = True
             overlap[row] = is_overlap
+        t_submit = time.perf_counter_ns()
         est, union, jacc, _ = rq.batch_query(
             mins, registers, mask, overlap)
         self.dispatches += 1
+        # ALWAYS resolve the dispatch with block_until_ready before the
+        # np.asarray conversions.  Under a concurrently-dispatching
+        # ingest thread, np.asarray on a not-yet-ready array can starve
+        # until the other thread quiesces (jax 0.4.37 CPU: the host-copy
+        # wait loses to a busy dispatch stream indefinitely, while
+        # block_until_ready waits bounded by the queue depth — measured
+        # by the ISSUE 11 concurrent-ingest rung: 0.8 s vs 20+ s).
+        import jax
+
+        t_bd = time.perf_counter_ns()
+        jax.block_until_ready((est, union, jacc))
+        if ql is not None and ql.device_sample_due(self.dispatches):
+            # dispatch-to-completion device time, 1-in-N sampled (the
+            # OccupancySampler's cadence rule); off-sample batches pay
+            # only the block they needed anyway
+            ql.note_device_sample(
+                (time.perf_counter_ns() - t_bd) / 1e6)
         est = np.asarray(est)
         union = np.asarray(union)
         jacc = np.asarray(jacc)
+        t_done = time.perf_counter_ns()
+        if ql is not None and recs:
+            # contention accounting AFTER the block: any ingest fold
+            # that overlapped these queue waits has completed by now,
+            # so its measured busy window is already on record
+            ql.note_queue_exit(recs)
         ub = rq.union_bound(R)
         ob = rq.overlap_bound(k, R)
         now = time.monotonic()
         if self._served_t0 is None:
             self._served_t0 = now
-        for row, (idx, is_overlap, reply, qid, t0) in enumerate(items):
+        for row, (idx, is_overlap, reply, qid, t0, rec) in enumerate(
+                items):
             lat_ms = (now - t0) * 1000.0
             self._lat_ring.append(lat_ms)
             if self._hist is not None:
@@ -214,7 +346,7 @@ class ReachQueryServer:
             self.served += 1
             if self._c_served is not None:
                 self._c_served.inc()
-            self._safe_reply(reply, {
+            payload = {
                 "op": "overlap" if is_overlap else "union",
                 "estimate": round(float(est[row]), 2),
                 "union": round(float(union[row]), 2),
@@ -225,8 +357,30 @@ class ReachQueryServer:
                 "bound": round(ob if is_overlap else ub, 5),
                 "epoch": epoch,
                 "id": qid,
-            })
+            }
+            if rec is not None:
+                # server-side decomposition (up to reply-write start):
+                # the client splits round-trip into network-vs-server
+                payload["server"] = ql.server_block(rec, t_submit,
+                                                    t_done)
+            self._safe_reply(reply, payload)
+            if rec is not None:
+                ql.note_reply(rec, t_submit, t_done)
         self._served_t1 = time.monotonic()
+        if self._spans is not None:
+            # the query lane: batch-level spans on THIS worker thread,
+            # interleaved with the engine's ingest folds on the shared
+            # perf_counter clock in one perfetto trace
+            t_end = time.perf_counter_ns()
+            n = len(items)
+            self._spans.add("query_assembly", t_exit,
+                            t_submit - t_exit, cat="query",
+                            args={"queries": n})
+            self._spans.add("query_dispatch", t_submit,
+                            t_done - t_submit, cat="query",
+                            args={"queries": n, "epoch": epoch})
+            self._spans.add("query_reply", t_done, t_end - t_done,
+                            cat="query", args={"queries": n})
 
     @staticmethod
     def _safe_reply(reply, data: dict) -> None:
@@ -245,7 +399,10 @@ class ReachQueryServer:
             "dispatches": self.dispatches,
             "batch": self.batch,
             "queue_depth": self.depth,
+            "queue_high_water": self.queue_high_water,
         }
+        if self._queryattr is not None:
+            out["query_obs"] = self._queryattr.summary()
         if lats:
             out["p50_ms"] = round(lats[len(lats) // 2], 2)
             out["p99_ms"] = round(lats[min(len(lats) - 1,

@@ -1,0 +1,474 @@
+"""Query-path latency attribution for the reach serving tier
+(obs/queryattr.py + reach/serve.py wiring, ISSUE 11): segment
+decomposition summing to the submit->reply e2e, shed queue-only
+records reconciling with the shed counter, the bounded slow-query log,
+ingest-contention attribution from the span ring, reply-payload
+bit-identity when the flag is off, and the serving flight-recorder
+records."""
+
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.obs import MetricsRegistry, SpanTracer
+from streambench_tpu.obs.queryattr import (
+    SEGMENTS,
+    QueryLifecycle,
+    _interval_overlap_ns,
+)
+from streambench_tpu.ops import minhash
+from streambench_tpu.reach.serve import ReachQueryServer
+
+
+def tiny_state(C=4, k=16, R=16, seed=0):
+    rng = np.random.default_rng(seed)
+    st = minhash.init_state(C, k, R)
+    join = jnp.asarray(np.arange(C, dtype=np.int32))
+    B = 64
+    return minhash.step(
+        st, join,
+        jnp.asarray(rng.integers(0, C, B).astype(np.int32)),
+        jnp.asarray(rng.integers(0, 1 << 20, B).astype(np.int32)),
+        jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+        jnp.ones(B, bool))
+
+
+def make_server(campaigns=("a", "b", "c", "d"), *, depth=64, batch=8,
+                hold=False, slo_ms=0, slowlog_max=16, spans=None,
+                flightrec=None, registry=None):
+    reg = registry if registry is not None else MetricsRegistry()
+    ql = QueryLifecycle(reg, slo_ms=slo_ms, slowlog_max=slowlog_max,
+                        spans=spans)
+    srv = ReachQueryServer(list(campaigns), depth=depth, batch=batch,
+                           hold=hold, registry=reg, queryattr=ql,
+                           spans=spans, flightrec=flightrec)
+    st = tiny_state(C=len(campaigns))
+    srv.update_state(st.mins, st.registers, epoch=1)
+    return srv, ql, reg
+
+
+def drain(srv, got, n, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while len(got) < n and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert len(got) >= n, (len(got), n)
+
+
+# -------------------------------------------------- segment partition
+def test_segments_sum_to_e2e_exactly():
+    """The four segment histograms' SUMS total the e2e histogram's sum
+    exactly (same stamps, float rounding only) — the partition
+    contract, at sample resolution rather than bucket resolution."""
+    srv, ql, _ = make_server()
+    got = []
+    try:
+        for i in range(40):
+            srv.submit(["a", "b"], "overlap" if i % 2 else "union",
+                       lambda d: got.append(d), query_id=i)
+        drain(srv, got, 40)
+    finally:
+        srv.close()
+    s = ql.summary()
+    assert s["served_records"] == 40 and s["shed_records"] == 0
+    seg_sum = sum(s["segments"][seg]["sum"] for seg in SEGMENTS)
+    assert s["e2e_ms"]["count"] == 40
+    # summary() rounds each sum to 3 decimals: five independent
+    # roundings bound the partition check at ±2.5e-3
+    assert seg_sum == pytest.approx(s["e2e_ms"]["sum"], abs=3e-3)
+    # every segment histogram saw exactly one sample per served query
+    assert all(s["segments"][seg]["count"] == 40 for seg in SEGMENTS)
+
+
+def test_segment_p50_sum_explains_e2e_p50_on_paced_storm():
+    """Bucket-resolution check on a paced storm: the per-segment p50s
+    sum to within the one-bucket error of the e2e p50 (the acceptance
+    criterion's 10%)."""
+    srv, ql, _ = make_server(batch=4)
+    got = []
+    try:
+        for i in range(120):
+            srv.submit(["a"], "union", lambda d: got.append(d),
+                       query_id=i)
+            time.sleep(0.001)
+        drain(srv, got, 120)
+    finally:
+        srv.close()
+    s = ql.summary()
+    p50_sum = sum(s["segments"][seg].get("p50", 0.0)
+                  for seg in SEGMENTS)
+    e2e_p50 = s["e2e_ms"]["p50"]
+    # paced: every query gets its own near-empty batch, so segment
+    # p50s compose the typical path.  2^0.125 buckets are ~9% wide and
+    # four of them stack, hence the generous-but-meaningful bound.
+    assert e2e_p50 > 0
+    assert abs(p50_sum - e2e_p50) / e2e_p50 < 0.45, (p50_sum, e2e_p50)
+
+
+# ------------------------------------------------ shed reconciliation
+def test_shed_records_reconcile_with_shed_counter():
+    srv, ql, reg = make_server(depth=5, batch=4, hold=True)
+    got = []
+    try:
+        for i in range(17):
+            srv.submit(["a"], "union", lambda d: got.append(d),
+                       query_id=i)
+        assert srv.shed == 12
+        srv.resume()
+        drain(srv, got, 17)
+    finally:
+        srv.close()
+    s = ql.summary()
+    # every query has exactly one lifecycle record, shed or served
+    assert s["shed_records"] == 12 == srv.shed
+    assert s["served_records"] == 5 == srv.served
+    assert s["shed_queue_ms"]["count"] == 12
+    # the lifecycle shed count reconciles EXACTLY with the Prometheus
+    # shed counter (the acceptance criterion)
+    shed_counter = reg.counter("streambench_reach_shed_total")
+    assert shed_counter.value == 12
+    # shed replies carry the queue-only server block
+    shed_replies = [d for d in got if d.get("shed")]
+    assert len(shed_replies) == 12
+    assert all("queue_ms" in d["server"] for d in shed_replies)
+
+
+def test_close_time_sheds_count_and_reconcile():
+    """Stragglers shed at close (no state) get lifecycle records AND
+    bump streambench_reach_shed_total, so the reconciliation holds
+    across the drain-at-close path too."""
+    reg = MetricsRegistry()
+    ql = QueryLifecycle(reg)
+    srv = ReachQueryServer(["a"], depth=8, batch=4, registry=reg,
+                           queryattr=ql)      # no state pushed
+    got = []
+    srv.submit(["a"], "union", lambda d: got.append(d), query_id="s")
+    srv.close()
+    assert got and got[0].get("shed") is True
+    assert ql.summary()["shed_records"] == 1 == srv.shed
+    assert reg.counter("streambench_reach_shed_total").value == 1
+
+
+# ------------------------------------------------------ slow-query log
+def test_slowlog_captures_decomposition_and_evicts_bounded():
+    reg = MetricsRegistry()
+    ql = QueryLifecycle(reg, slo_ms=0, slowlog_max=4)
+    ql.slo_ms = 0  # capture nothing yet
+    rec = ql.admit(trace="t-0", qid=0)
+    rec.t_exit = rec.t_admit + 1_000_000
+    ql.note_reply(rec, rec.t_exit + 1_000_000, rec.t_exit + 2_000_000)
+    assert ql.slowlog() == []          # no objective, no log
+    ql.slo_ms = 1                      # 1 ms objective: everything slow
+    for i in range(7):
+        r = ql.admit(trace=f"t-{i + 1}", qid=i + 1)
+        r.t_admit -= 2_000_000                     # admitted 2 ms ago
+        r.t_exit = r.t_admit + 2_000_000           # 2 ms queue
+        ql.note_reply(r, r.t_exit, r.t_exit)
+    log = ql.slowlog()
+    assert len(log) == 4 and ql.slowlog_evicted == 3
+    assert [e["id"] for e in log] == [4, 5, 6, 7]  # oldest evicted
+    e = log[-1]
+    assert e["trace"] == "t-7"
+    assert set(e) >= {"e2e_ms", "queue_ms", "batch_ms", "dispatch_ms",
+                      "reply_ms", "ts_ms"}
+    assert e["e2e_ms"] == pytest.approx(
+        e["queue_ms"] + e["batch_ms"] + e["dispatch_ms"]
+        + e["reply_ms"], rel=1e-6)
+
+
+# ---------------------------------------------- contention attribution
+def test_interval_overlap_helper():
+    merged = [(10, 20), (30, 40)]
+    assert _interval_overlap_ns(0, 50, merged) == 20
+    assert _interval_overlap_ns(15, 35, merged) == 10
+    assert _interval_overlap_ns(20, 30, merged) == 0
+    assert _interval_overlap_ns(12, 18, merged) == 6
+
+
+def test_contention_ratio_from_synthetic_ingest_spans():
+    """Known geometry: a query whose queue wait half-overlaps one
+    ingest dispatch span must report ratio 0.5 (both sides stamp the
+    same perf_counter_ns clock)."""
+    reg = MetricsRegistry()
+    spans = SpanTracer(capacity=64)
+    ql = QueryLifecycle(reg, spans=spans)
+    t0 = spans.t0_ns
+    ms = 1_000_000
+    # ingest dispatch [t0+10ms, t0+20ms); an unrelated span is ignored
+    spans.add("device_scan", t0 + 10 * ms, 10 * ms, cat="stage")
+    spans.add("encode", t0 + 10 * ms, 10 * ms, cat="stage")
+    spans.add("query_dispatch", t0 + 10 * ms, 10 * ms, cat="query")
+    rec = ql.admit()
+    rec.t_admit = t0 + 15 * ms       # wait [15ms, 25ms): 5 ms overlap
+    rec.t_exit = t0 + 25 * ms
+    ql.note_queue_exit([rec])
+    assert ql.contention_ratio() == pytest.approx(0.5, abs=1e-6)
+    g = reg.gauge("streambench_reach_contention_ratio")
+    assert g.value == pytest.approx(0.5, abs=1e-3)
+    # two merged overlapping dispatch spans never double-count
+    spans.add("device_step", t0 + 12 * ms, 6 * ms, cat="stage")
+    rec2 = ql.admit()
+    rec2.t_admit = t0 + 10 * ms
+    rec2.t_exit = t0 + 20 * ms       # fully inside the merged busy set
+    ql.note_queue_exit([rec2])
+    s = ql.summary()["contention"]
+    assert s["queue_wait_ms"] == pytest.approx(20.0, abs=1e-3)
+    assert s["ingest_overlap_ms"] == pytest.approx(15.0, abs=1e-3)
+    assert s["ratio"] == pytest.approx(0.75, abs=1e-3)
+
+
+def test_contention_zero_without_spans():
+    reg = MetricsRegistry()
+    ql = QueryLifecycle(reg)       # no span tracer wired
+    rec = ql.admit()
+    rec.t_exit = rec.t_admit + 1_000_000
+    ql.note_queue_exit([rec])
+    assert ql.contention_ratio() == 0.0
+    assert ql.summary()["contention"]["spans_wired"] is False
+
+
+# ------------------------------------------------- query-lane spans
+def test_query_lane_spans_validate_in_chrome_trace(tmp_path):
+    from streambench_tpu.obs.spans import validate_chrome_trace
+
+    spans = SpanTracer(capacity=256)
+    spans.add("device_scan", spans.t0_ns, 2_000_000, cat="stage")
+    srv, ql, _ = make_server(spans=spans)
+    got = []
+    try:
+        for i in range(12):
+            srv.submit(["a", "c"], "union", lambda d: got.append(d),
+                       query_id=i)
+        drain(srv, got, 12)
+    finally:
+        srv.close()
+    path = str(tmp_path / "trace_q.json")
+    spans.dump(path, run="queryattr-test")
+    doc = json.load(open(path))
+    assert validate_chrome_trace(doc) == []
+    cats = {e.get("cat") for e in doc["traceEvents"] if e.get("ph") == "X"}
+    # both lanes share one trace: ingest stage spans + query spans
+    assert "query" in cats and "stage" in cats
+    names = {e["name"] for e in doc["traceEvents"]
+             if e.get("cat") == "query"}
+    assert {"query_assembly", "query_dispatch", "query_reply"} <= names
+    # the query lane rides the worker's real thread
+    q_tids = {e["tid"] for e in doc["traceEvents"]
+              if e.get("cat") == "query"}
+    meta = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M"}
+    assert all(meta[t] == "reach-query" for t in q_tids)
+
+
+# -------------------------------------------- off-flag bit-identity
+def test_reply_payloads_bit_identical_when_off():
+    """With jax.obs.query off the reply payloads are byte-for-byte the
+    PR 10 shape; with it on they differ ONLY by the added server
+    block."""
+    campaigns = ["a", "b", "c", "d"]
+    st = tiny_state(C=4)
+    off = ReachQueryServer(campaigns, depth=32, batch=4)
+    on_srv, _, _ = make_server(campaigns, batch=4)
+    off.update_state(st.mins, st.registers, epoch=1)
+    queries = [(["a", "b"], "union", 0), (["c"], "union", 1),
+               (["a", "b", "d"], "overlap", 2), (["b"], "overlap", 3)]
+    got_off, got_on = [], []
+    try:
+        for sel, op, qid in queries:
+            off.submit(sel, op, lambda d: got_off.append(d),
+                       query_id=qid)
+            on_srv.submit(sel, op, lambda d: got_on.append(d),
+                          query_id=qid)
+        drain(off, got_off, 4)
+        drain(on_srv, got_on, 4)
+    finally:
+        off.close()
+        on_srv.close()
+    by_id_off = {d["id"]: d for d in got_off}
+    by_id_on = {d["id"]: d for d in got_on}
+    for qid in range(4):
+        a, b = by_id_off[qid], dict(by_id_on[qid])
+        assert "server" not in a          # OFF: the PR 10 payload
+        server = b.pop("server")          # ON: exactly one extra key
+        assert set(server) == {"queue_ms", "batch_ms", "dispatch_ms",
+                               "total_ms"}
+        assert json.dumps(a, sort_keys=True) == json.dumps(
+            b, sort_keys=True)
+
+
+def test_trace_id_and_client_stamp_propagate_via_handle():
+    srv, ql, _ = make_server()
+    got = []
+    try:
+        srv.handle({"type": "reach", "campaigns": ["a"], "op": "union",
+                    "id": 9, "trace": "trc-9", "sent_ms": 1234},
+                   lambda d: got.append(d))
+        drain(srv, got, 1)
+    finally:
+        srv.close()
+    assert got[0]["server"]["trace"] == "trc-9"
+
+
+# -------------------------------------------- flight-recorder records
+def test_flightrec_carries_shed_and_high_water(tmp_path):
+    from streambench_tpu.obs import FlightRecorder
+
+    fr = FlightRecorder(str(tmp_path), capacity=64)
+    srv, ql, _ = make_server(depth=4, batch=4, hold=True, flightrec=fr)
+    got = []
+    try:
+        for i in range(20):
+            srv.submit(["a"], "union", lambda d: got.append(d),
+                       query_id=i)
+        srv.resume()
+        drain(srv, got, 20)
+    finally:
+        srv.close()
+    kinds = [r["kind"] for r in fr.snapshot()]
+    assert "reach_queue_high_water" in kinds
+    assert "reach_shed" in kinds
+    hw = [r for r in fr.snapshot()
+          if r["kind"] == "reach_queue_high_water"]
+    # doubling rate limit: at depth 4 the high-water records are O(log)
+    assert 1 <= len(hw) <= 4
+    assert all(r["depth"] == 4 for r in hw)
+    shed_recs = [r for r in fr.snapshot() if r["kind"] == "reach_shed"]
+    # rate-limited (1 Hz): the record carries the cumulative count at
+    # record time, not necessarily the final one
+    assert 1 <= shed_recs[-1]["shed_total"] <= srv.shed
+    # a serving crash dump explains the backlog
+    path = fr.dump("crash", terminal={"event": "crash",
+                                      "error": "Boom()"})
+    lines = [json.loads(l) for l in open(path)]
+    assert any(r["kind"] == "reach_shed" for r in lines)
+    assert lines[-1]["kind"] == "fault"
+
+
+def test_slo_breach_event_carries_segment_attribution(tmp_path):
+    from streambench_tpu.obs import FlightRecorder
+    from streambench_tpu.obs.slo import SloTracker
+    from streambench_tpu.reach.serve import LATENCY_HIST
+
+    clock = {"t": 0.0}
+    reg = MetricsRegistry()
+    fr = FlightRecorder(str(tmp_path), capacity=64)
+    ql = QueryLifecycle(reg, slo_ms=100)
+    # seed one full record so the segment histograms have quantiles
+    rec = ql.admit(qid="slow")
+    rec.t_exit = rec.t_admit + 5_000_000
+    ql.note_reply(rec, rec.t_exit + 1_000_000, rec.t_exit + 2_000_000)
+    slo = SloTracker(reg, reach_p99_ms=100, budget=0.1, fast_s=5,
+                     slow_s=20, flightrec=fr, queryattr=ql,
+                     clock=lambda: clock["t"])
+    hist = reg.histogram(LATENCY_HIST)
+    for _ in range(20):
+        clock["t"] += 1
+        hist.observe(10)
+        slo.collect({}, 1.0)
+    for _ in range(4):
+        clock["t"] += 1
+        hist.observe(10_000)
+        slo.collect({}, 1.0)
+    assert slo.breaches == 1
+    breach = [r for r in fr.snapshot() if r["kind"] == "slo_breach"]
+    assert breach and "reach_segments" in breach[-1]
+    assert "queue" in breach[-1]["reach_segments"]
+    assert "reach_contention_ratio" in breach[-1]
+    v = slo.verdict()
+    assert "reach_segments" in v and "reach_contention_ratio" in v
+
+
+# --------------------------------------- client-side latency split
+def test_client_splits_network_vs_server_time():
+    from streambench_tpu.dimensions.pubsub import (
+        PubSubClient,
+        PubSubServer,
+    )
+
+    srv, ql, _ = make_server()
+    ps = PubSubServer(port=0).start()
+    ps.register_query("reach", srv.handle)
+    host, port = ps.address
+    try:
+        c = PubSubClient(host, port, timeout_s=30)
+        c.request({"type": "reach", "campaigns": ["a", "b"],
+                   "op": "union", "id": 1, "trace": "trc-1",
+                   "sent_ms": 1})
+        data = c.recv()["data"]
+        split = c.latency_split(data)
+        c.close()
+    finally:
+        srv.close()
+        ps.close()
+    server = data["server"]
+    assert server["trace"] == "trc-1"
+    assert server["total_ms"] >= (server["queue_ms"] + server["batch_ms"]
+                                  + server["dispatch_ms"]) - 1e-6
+    assert split["rtt_ms"] >= server["total_ms"] - 1.0
+    assert split["network_ms"] == pytest.approx(
+        max(split["rtt_ms"] - server["total_ms"], 0.0), abs=1e-6)
+    # a second split for the same id: stamp consumed, None
+    assert c.latency_split(data) is None
+
+
+# ---------------------------------------------------- obs serve CLI
+def test_obs_serve_cli_renders_and_diffs(tmp_path, capsys):
+    from streambench_tpu.obs.__main__ import main as obs_main
+
+    srv, ql, _ = make_server()
+    got = []
+    try:
+        for i in range(8):
+            srv.submit(["a"], "union", lambda d: got.append(d),
+                       query_id=i)
+        drain(srv, got, 8)
+    finally:
+        srv.close()
+    path = tmp_path / "metrics.jsonl"
+    path.write_text(json.dumps(
+        {"kind": "snapshot", "reach_query": srv.summary()}) + "\n")
+    assert obs_main(["serve", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "reach serving attribution" in out
+    assert "contention ratio" in out and "queue" in out
+    assert obs_main(["serve", str(path), str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "reach serving diff" in out
+    # --json emits the dict
+    assert obs_main(["serve", str(path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["reach_query"]["query_obs"]["served_records"] == 8
+
+
+# ------------------------------------------------ FaultCounters.get
+def test_fault_counters_get_default():
+    from streambench_tpu.metrics import FaultCounters
+
+    fc = FaultCounters()
+    assert fc.get("never_bumped") == 0
+    assert fc.get("never_bumped", 7) == 7
+    fc.inc("sink_errors", 3)
+    assert fc.get("sink_errors", 99) == 3
+
+
+# --------------------------------- pub/sub server close-before-start
+def test_pubsub_close_before_start_is_noop():
+    from streambench_tpu.dimensions.pubsub import PubSubServer
+
+    ps = PubSubServer(port=0)       # start() never called
+    done = threading.Event()
+
+    def closer():
+        ps.close()                  # used to hang on serve_forever ack
+        done.set()
+
+    t = threading.Thread(target=closer, daemon=True)
+    t.start()
+    t.join(timeout=5)
+    assert done.is_set(), "close() hung without start()"
+    # a started server still closes cleanly (the normal path)
+    ps2 = PubSubServer(port=0).start()
+    ps2.close()

@@ -35,6 +35,33 @@ def test_normalize_bench_extracts_comparables():
     assert n["slo_pass"] is True
 
 
+def test_normalize_bench_reach_segments_and_contention():
+    """ISSUE 11 regress keys: per-segment p50 scalars (or full
+    summaries) + contention ratio out of the bench reach block, all
+    with declared 'lower is better' directions."""
+    from streambench_tpu.obs.regress import DEFAULT_TOLERANCES
+
+    doc = {"reach": {"qps": 2500.0, "p99_ms": 480.0,
+                     "segments": {"queue": 6.6, "batch": 0.06,
+                                  "dispatch": {"p50": 0.5, "p99": 1.0},
+                                  "reply": 0.2},
+                     "contention_ratio": 0.88}}
+    n = normalize_bench(doc, path="r.json")
+    assert n["reach_segment_queue_ms"] == 6.6
+    assert n["reach_segment_dispatch_ms"] == 0.5   # dict -> its p50
+    assert n["reach_contention_ratio"] == 0.88
+    for key in ("reach_segment_queue_ms", "reach_segment_batch_ms",
+                "reach_segment_dispatch_ms", "reach_segment_reply_ms",
+                "reach_contention_ratio"):
+        assert DEFAULT_TOLERANCES[key][0] == "lower", key
+    # direction-aware: a doubled queue segment past tolerance regresses
+    b = dict(n)
+    b["reach_segment_queue_ms"] = 6.6 * 2.5
+    res = compare(n, b)
+    rows = {r["metric"]: r["verdict"] for r in res["rows"]}
+    assert rows["reach_segment_queue_ms"] == "REGRESSED"
+
+
 def test_compare_directions_and_tolerances():
     a = normalize_bench(_bench_doc())
     # within every (generous) default tolerance
