@@ -196,6 +196,12 @@ class HeadlineEmitter:
                 if k in dev} or None,
             "methods": h.get("methods_compact"),
             "device_decode": h.get("device_decode_ab"),
+            # sliding A/B (ISSUE 12): legacy unrolled vs sliced fold
+            # ev/s over the same journal, row-equality oracle
+            "sliding_evps": (h.get("sliding_ab") or {}).get(
+                "sliding_evps"),
+            "sliding_sliced_evps": (h.get("sliding_ab") or {}).get(
+                "sliding_sliced_evps"),
             # measured bytes/event per wire format + the col-basis
             # packed/unpacked ratio (the MULTICHIP packed_col_ratio peer)
             "bytes_per_event": bpe or None,
@@ -226,6 +232,9 @@ class HeadlineEmitter:
             # jax.decode.device=auto consult
             "methods": self.headline.get("methods"),
             "device_decode_ab": self.headline.get("device_decode_ab"),
+            # sliding A/B (ISSUE 12): the sliced-fold measurement
+            # jax.sliding.sliced=auto consults, next to its oracle
+            "sliding_ab": self.headline.get("sliding_ab"),
             # per-window latency attribution of the best catchup rep
             # (obs.lifecycle; STREAMBENCH_BENCH_ATTRIBUTION=1 or a
             # metrics dir opts in) — the per-stage ms, per WINDOW
@@ -1736,6 +1745,113 @@ def main() -> int:
                 log(f"device-decode A/B failed (non-fatal): {e!r}")
                 dd_ab = {"error": repr(e)}
         emitter.update(device_decode_ab=dd_ab, phase="device_decode_ab")
+        emitter.emit()
+
+        # Sliding A/B (ISSUE 12): the legacy unrolled fold vs the sliced
+        # one-claim-one-scatter fold, each a full catchup over the SAME
+        # journal with a fresh store.  Oracle = exact row equality
+        # between the arms (the legacy arm is itself pinned to the
+        # reference sliding model by tests/test_windows.py) plus equal
+        # membership-granular dropped.  The measured sliding-family
+        # table lands in the shared cache so jax.sliding.sliced=auto
+        # resolves from measurement.
+        sliding_ab = None
+        if (os.environ.get("STREAMBENCH_BENCH_SLIDING", "1") != "0"
+                and time.monotonic() + 180 < bench_deadline):
+            try:
+                from streambench_tpu.engine.sketches import (
+                    SlidingTDigestEngine,
+                )
+                from streambench_tpu.io.redis_schema import (
+                    read_seen_counts,
+                )
+                sl_table = None
+                try:
+                    from streambench_tpu.ops import methodbench
+
+                    t0 = time.monotonic()
+                    sl_table = methodbench.measure_and_record_sliding(
+                        num_campaigns=cfg.jax_num_campaigns,
+                        window_slots=max(
+                            min(cfg.jax_window_slots, 2048), 128),
+                        batch_size=min(cfg.jax_batch_size, 4096),
+                        iters=10)
+                    log(f"sliding micro-bench "
+                        f"({time.monotonic() - t0:.1f}s): "
+                        f"winner={sl_table['winner']} "
+                        + " ".join(
+                            f"{m}={v.get('ns_per_event', 'err')}ns/ev"
+                            for m, v in sl_table["methods"].items()))
+                except Exception as e:
+                    log(f"sliding micro-bench failed (non-fatal): {e!r}")
+
+                def _sliding_arm(mode: str):
+                    """Best-of-N catchup (the headline/config-row
+                    methodology: this 1-core host swings 2-4x run to
+                    run; every rep's value is recorded)."""
+                    reps_sl = max(int(os.environ.get(
+                        "STREAMBENCH_BENCH_SLIDING_REPS", "3")), 1)
+                    vals = []
+                    rows = dropped = events_n = None
+                    for _ in range(reps_sl):
+                        if (vals and time.monotonic() + 90
+                                > bench_deadline):
+                            break
+                        r_sl = as_redis(make_store())
+                        seed_campaigns(r_sl,
+                                       sorted(set(mapping.values())))
+                        eng = SlidingTDigestEngine(
+                            cfg, mapping, redis=r_sl, sliced=mode)
+                        eng.warmup()
+                        runner_sl = StreamRunner(
+                            eng, broker.reader(cfg.kafka_topic))
+                        t0 = time.monotonic()
+                        stats_sl = runner_sl.run_catchup()
+                        eng.close()
+                        s = max(time.monotonic() - t0, 1e-9)
+                        vals.append(round(stats_sl.events / s, 1))
+                        dropped = int(eng.dropped)
+                        events_n = stats_sl.events
+                    # every rep replays the same journal into a fresh
+                    # store: rows are deterministic, so the cross-arm
+                    # oracle reads ONE store (the walk costs seconds at
+                    # sliding row volumes — off the timed window, but
+                    # on the bench budget)
+                    rows = read_seen_counts(r_sl)
+                    return max(vals), vals, rows, dropped, events_n
+
+                v_leg, reps_leg, rows_leg, d_leg, ev_sl = \
+                    _sliding_arm("off")
+                v_sl, reps_sl_v, rows_sl, d_sl, _ = _sliding_arm("on")
+                match = rows_leg == rows_sl and d_leg == d_sl
+                sliding_ab = {
+                    "events": ev_sl,
+                    "sliding_evps": v_leg,
+                    "sliding_sliced_evps": v_sl,
+                    "reps_evps": reps_leg,
+                    "sliced_reps_evps": reps_sl_v,
+                    "dropped": d_leg,
+                    "oracle": ("exact" if match else
+                               f"ROWS DIFFER: legacy={len(rows_leg)} "
+                               f"sliced={len(rows_sl)} "
+                               f"dropped {d_leg}/{d_sl}"),
+                    "winner": ("sliced" if match and v_sl > v_leg
+                               else "legacy"),
+                    "table": ({"winner": sl_table["winner"],
+                               "ns_per_event": {
+                                   m: v.get("ns_per_event")
+                                   for m, v in
+                                   sl_table["methods"].items()}}
+                              if sl_table else None),
+                }
+                log(f"sliding A/B: legacy {v_leg:,.0f} ev/s vs sliced "
+                    f"{v_sl:,.0f} ev/s ({v_sl / max(v_leg, 1e-9):.2f}x, "
+                    f"oracle {sliding_ab['oracle']}) -> auto resolves "
+                    f"{sliding_ab['winner']}")
+            except Exception as e:  # must not kill the headline
+                log(f"sliding A/B failed (non-fatal): {e!r}")
+                sliding_ab = {"error": repr(e)}
+        emitter.update(sliding_ab=sliding_ab, phase="sliding_ab")
         emitter.emit()
 
         # Data-path transfer + memory probe (ISSUE 9): measured

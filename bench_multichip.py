@@ -74,11 +74,13 @@ def compact_line(obj: dict) -> str:
         return line
     obj = json.loads(line)  # deep copy before mutating
     # progressively shed: per-arm by_kind, step arms, per-arm ms, runs'
-    # hll block — the scan collective table is the last thing to go
+    # hll + sliding/session detail blocks — the scan collective table
+    # is the last thing to go (the hoist-ops headlines stay)
     for strip in ("by_kind", "device_wait_ms", "step",
-                  "straggler_spread_ms", "ms_per_dispatch", "hll"):
+                  "straggler_spread_ms", "ms_per_dispatch", "hll",
+                  "sliding_scan", "session_scan"):
         for run in obj.get("runs", []):
-            if strip in ("step", "hll"):
+            if strip in ("step", "hll", "sliding_scan", "session_scan"):
                 run.pop(strip, None)
             else:
                 for arm in (run.get("scan") or {}).values():
@@ -270,6 +272,91 @@ def _worker(args) -> int:
         arm["straggler_spread_ms"] = round(
             (max(waits) - min(waits)) * 1e3, 3) if waits else None
         out["scan"][name] = arm
+
+    # -- sliding + session scan arms (ISSUE 12): the PR 7 hoist
+    # treatment extended to the remaining sketch families.  The HLO
+    # collective table is the claim: hoisted sliding scans issue
+    # (cols+1) collectives per dispatch vs K*(cols+1) per-batch, and
+    # the hoisted session scan's body is collective-free (stacked
+    # post-scan merges) vs ~K*16 inside the loop.
+    if time.monotonic() < deadline - 45:
+        from streambench_tpu.engine.sketches import LAT_BINS
+        from streambench_tpu.parallel.sketches import (
+            _build_session_scan,
+            _build_sliding_scan,
+        )
+
+        Cs, Ws, Ss, TD = cfg.jax_num_campaigns, 128, 10, 16
+        sl_cols = (jt, jnp.int32(0), jnp.asarray(ad), jnp.asarray(et),
+                   jnp.asarray(tm), jnp.asarray(va))
+        out["sliding_scan"] = {}
+        for name, (hoist, sliced) in {
+            "legacy_perbatch": (False, False),
+            "legacy_hoisted": (True, False),
+            "sliced_hoisted": (True, True),
+        }.items():
+            if time.monotonic() > deadline - 30:
+                out["sliding_scan"][name] = {"skipped": "budget"}
+                continue
+            counts = (jnp.zeros((Cs, Ss, Ws), jnp.int32) if sliced
+                      else jnp.zeros((Cs, Ws), jnp.int32))
+            stt = (counts, jnp.full((Ws,), -1, jnp.int32),
+                   jnp.int32(0), jnp.int32(0),
+                   jnp.zeros((Cs, TD), jnp.float32),
+                   jnp.zeros((Cs, TD), jnp.float32))
+            fn = _build_sliding_scan(mesh, 10_000, 1_000, 60_000, 0,
+                                     hoist, sliced)
+            rep = collectives.report_for(fn, *stt, *sl_cols, scan_len=K)
+            entry = {"ops": rep["per_dispatch"]["ops"],
+                     "bytes": rep["per_dispatch"]["bytes"],
+                     "loop_ops": rep["per_loop_iteration"]["ops"]}
+            o = fn(*stt, *sl_cols)  # compile + warm
+            jax.block_until_ready(o[0])
+            t0 = time.perf_counter()
+            o = fn(*o, *sl_cols)
+            jax.block_until_ready(o[0])
+            dt = time.perf_counter() - t0
+            entry["ms_per_dispatch"] = round(dt * 1e3, 2)
+            entry["ev_s"] = round(K * args.batch / max(dt, 1e-9))
+            out["sliding_scan"][name] = entry
+
+        U, M = 1 << 10, 128
+        if time.monotonic() < deadline - 30:
+            users = rng.integers(0, U, (K, B)).astype(np.int32)
+            sess_cols = (jnp.int32(0), jnp.asarray(users),
+                         jnp.asarray(et), jnp.asarray(tm),
+                         jnp.asarray(va))
+            sess_state = (
+                jnp.full((U,), -1, jnp.int32), jnp.zeros((U,), jnp.int32),
+                jnp.zeros((U,), jnp.int32), jnp.int32(0), jnp.int32(0),
+                jnp.zeros((4, 2048), jnp.int32), jnp.int32(0),
+                jnp.full((M,), -1, jnp.int32),
+                jnp.full((M,), -1, jnp.int32),
+                jnp.int32(0), jnp.int32(0),
+                jnp.zeros((LAT_BINS,), jnp.int32))
+            out["session_scan"] = {}
+            for name, hoist in {"perbatch": False, "hoisted": True}.items():
+                fn = _build_session_scan(mesh, 30_000, 60_000, U, hoist)
+                rep = collectives.report_for(fn, *sess_state, *sess_cols,
+                                             scan_len=K)
+                out["session_scan"][name] = {
+                    "ops": rep["per_dispatch"]["ops"],
+                    "bytes": rep["per_dispatch"]["bytes"],
+                    "loop_ops": rep["per_loop_iteration"]["ops"]}
+        # the headline the CI smoke asserts: hoisted scans carry no
+        # loop-body collectives and far fewer per dispatch
+        sl = out["sliding_scan"]
+        if "ops" in sl.get("legacy_hoisted", {}):
+            out["sliding_hoist_ops"] = {
+                "hoisted": sl["legacy_hoisted"]["ops"],
+                "sliced_hoisted": sl.get("sliced_hoisted", {}).get("ops"),
+                "perbatch": sl["legacy_perbatch"]["ops"],
+            }
+        if "ops" in (out.get("session_scan") or {}).get("hoisted", {}):
+            out["session_hoist_ops"] = {
+                "hoisted": out["session_scan"]["hoisted"]["ops"],
+                "perbatch": out["session_scan"]["perbatch"]["ops"],
+            }
 
     # headline ratios the artifact cites (collective structure is the
     # transferable result; guard n=1 where XLA elides the collectives)

@@ -121,6 +121,14 @@ class BenchmarkConfig:
     # "auto" enables it only where the measured A/B says the device arm
     # wins (bench.py records it; accelerator backends default on).
     jax_decode_device: str = "off"
+    # --- sliced sliding windows (ops.sliding; ISSUE 12) ---
+    # "off" keeps the unrolled per-k sliding fold (S ring-claim passes
+    # per batch); "on" forces the sliced fold — one claim + one scatter
+    # into a [C, S, W] slide-bucket plane, window counts summed from S
+    # live buckets only at drain time, flushed rows bit-identical;
+    # "auto" (default) uses the sliced fold wherever the plane fits and
+    # the measured sliding-family winner (ops.methodbench) agrees.
+    jax_sliding_sliced: str = "auto"
     # --- robustness knobs (ROBUSTNESS.md; the reference has none of these:
     # a Redis outage is a Jedis stack trace and enableCheckpointing is
     # commented out, AdvertisingTopologyNative.java:81-84) ---
@@ -317,6 +325,11 @@ class BenchmarkConfig:
             raise ConfigError(
                 f"config key 'jax.decode.device' must be one of "
                 f"off/on/auto: {decode_mode!r}")
+        sliced_mode = gets("jax.sliding.sliced", "auto").strip().lower()
+        if sliced_mode not in ("off", "on", "auto"):
+            raise ConfigError(
+                f"config key 'jax.sliding.sliced' must be one of "
+                f"off/on/auto: {sliced_mode!r}")
         mesh_shape = conf.get("jax.mesh.shape", (1,))
         mesh_axes = conf.get("jax.mesh.axes", ("data",))
         try:
@@ -366,6 +379,7 @@ class BenchmarkConfig:
             jax_ingest_batch_queue=max(geti("jax.ingest.batch.queue", 4), 1),
             jax_use_native_encoder=getb("jax.use.native.encoder", True),
             jax_decode_device=decode_mode,
+            jax_sliding_sliced=sliced_mode,
             jax_sink_exactly_once=getb("jax.sink.exactly_once", False),
             jax_sink_retry_base_ms=geti("jax.sink.retry.base.ms", 100),
             jax_sink_retry_cap_ms=geti("jax.sink.retry.cap.ms", 5000),

@@ -77,6 +77,24 @@ def default_method(num_campaigns: int | None = None) -> str:
     return "matmul"
 
 
+def _unique_ts(ts: np.ndarray) -> np.ndarray:
+    """``np.unique`` for window-timestamp columns, without the sort
+    where the value range is dense: sliding-family flushes carry
+    millions of rows over only thousands of distinct divisor-aligned
+    windows, and per-flush sort-based dedup was measured at ~0.5 s of a
+    6 s catchup (ISSUE 12).  A bounded flag array dedups in O(n); wide
+    or tiny inputs keep the sort path."""
+    if ts.size < (1 << 12):
+        return np.unique(ts)
+    tmin = int(ts.min())
+    span = int(ts.max()) - tmin + 1
+    if span > 16 * ts.size or span > (1 << 26):
+        return np.unique(ts)
+    flags = np.zeros(span, bool)
+    flags[ts - tmin] = True
+    return np.flatnonzero(flags) + tmin
+
+
 class _ArrayRows:
     """A flush batch as numpy columns — (campaign_idx, abs_window_ts,
     count) — plus the campaign-name table needed to write or recover
@@ -1794,7 +1812,7 @@ class AdAnalyticsEngine:
         cadence — never the host loop)."""
         if isinstance(payload, _ArrayRows):
             self.windows_written += len(payload)
-            uniq = [int(t) for t in np.unique(payload.ts).tolist()]
+            uniq = [int(t) for t in _unique_ts(payload.ts).tolist()]
             for t in uniq:
                 self.window_latency[t] = stamp - t
             if self._obs_hist is not None:

@@ -392,6 +392,24 @@ class ReachSketchEngine(_SketchEngineBase):
         return int(self.state.dropped)
 
 
+def _sliced_auto(backend: str, S: int, C: int, W: int) -> bool:
+    """Resolve ``jax.sliding.sliced=auto``: the sliced fold wherever
+    its [C, S, W] class plane fits and the measured sliding-family
+    winner (``ops.methodbench``, cached per backend/S-bucket) does not
+    say otherwise.  Unmeasured geometries default ON — one claim + one
+    scatter beats S claims + S scatters on every backend measured so
+    far, and the bit-identity sweep pins correctness either way."""
+    if S > W or C * S * W > (1 << 27):
+        return False
+    try:
+        from streambench_tpu.ops import methodbench
+
+        winner = methodbench.sliding_winner(backend, S)
+    except Exception:
+        winner = None
+    return winner is None or winner == "sliced"
+
+
 @functools.partial(jax.jit, static_argnames=("size_ms", "slide_ms",
                                              "lateness_ms", "method"))
 def _sliding_tdigest_scan(win_state, digest, join_table, now_rel,
@@ -461,6 +479,93 @@ def _sliding_tdigest_scan_packed(win_state, digest, join_table, now_rel,
     return st, tdigest.absorb_hist(digest, hn, hw)
 
 
+@functools.partial(jax.jit, static_argnames=("size_ms", "slide_ms",
+                                             "lateness_ms", "sliced",
+                                             "method"))
+def _sliding_tdigest_step(win_state, digest, join_table, now_rel,
+                          ad_idx, event_type, event_time, valid,
+                          *, size_ms: int, slide_ms: int,
+                          lateness_ms: int, sliced: bool,
+                          method: str = "scatter"):
+    """ONE compiled program for the per-batch fold + latency sample.
+
+    The un-fused form (separate ``sliding.step`` dispatch + eager
+    ``jnp.maximum``/mask arithmetic + ``tdigest.update`` dispatch) paid
+    several op-by-op dispatches per partial batch — measured ~1 s of a
+    2M-event catchup on the 1-core host, most of it dispatch overhead,
+    not compute (ISSUE 12)."""
+    step = sliding.step_sliced_core if sliced else sliding.step
+    st = step(win_state, join_table, ad_idx, event_type, event_time,
+              valid, size_ms=size_ms, slide_ms=slide_ms,
+              lateness_ms=lateness_ms, method=method)
+    lat = jnp.maximum(now_rel - event_time, 0)
+    campaign = join_table[ad_idx]
+    mask = valid & (event_type == 0) & (campaign >= 0)
+    dg = tdigest.update(digest, campaign, lat, mask)
+    return st, dg
+
+
+@functools.partial(jax.jit, static_argnames=("size_ms", "slide_ms",
+                                             "lateness_ms", "method"))
+def _sliding_tdigest_scan_sliced(win_state, digest, join_table, now_rel,
+                                 ad_idx, event_type, event_time, valid,
+                                 *, size_ms: int, slide_ms: int,
+                                 lateness_ms: int,
+                                 method: str = "scatter"):
+    """``_sliding_tdigest_scan`` over the SLICED fold (ISSUE 12): the
+    scan body pays one ring claim + one bucket scatter per batch
+    instead of S claim passes; the t-digest half is unchanged."""
+    N = digest.means.shape[0]
+
+    def body(carry, xs):
+        st, hn, hw = carry
+        a, et, t, v = xs
+        st = sliding.step_sliced_core(
+            st, join_table, a, et, t, v, size_ms=size_ms,
+            slide_ms=slide_ms, lateness_ms=lateness_ms, method=method)
+        lat = jnp.maximum(now_rel - t, 0)
+        campaign = join_table[a]
+        mask = v & (et == 0) & (campaign >= 0)
+        w = jnp.where(mask, 1.0, 0.0).astype(jnp.float32)
+        hn, hw = tdigest.fold_hist(hn, hw, campaign, lat, w, N)
+        return (st, hn, hw), None
+
+    (st, hn, hw), _ = jax.lax.scan(
+        body, (win_state,) + tdigest.hist_init(N),
+        (ad_idx, event_type, event_time, valid))
+    return st, tdigest.absorb_hist(digest, hn, hw)
+
+
+@functools.partial(jax.jit, static_argnames=("size_ms", "slide_ms",
+                                             "lateness_ms", "method"))
+def _sliding_tdigest_scan_sliced_packed(win_state, digest, join_table,
+                                        now_rel, packed, event_time,
+                                        *, size_ms: int, slide_ms: int,
+                                        lateness_ms: int,
+                                        method: str = "scatter"):
+    """Sliced fold over the packed wire word (8 B/event)."""
+    N = digest.means.shape[0]
+
+    def body(carry, xs):
+        st, hn, hw = carry
+        p, t = xs
+        a, et, v = wc.unpack_columns(p)
+        st = sliding.step_sliced_core(
+            st, join_table, a, et, t, v, size_ms=size_ms,
+            slide_ms=slide_ms, lateness_ms=lateness_ms, method=method)
+        lat = jnp.maximum(now_rel - t, 0)
+        campaign = join_table[a]
+        mask = v & (et == 0) & (campaign >= 0)
+        w = jnp.where(mask, 1.0, 0.0).astype(jnp.float32)
+        hn, hw = tdigest.fold_hist(hn, hw, campaign, lat, w, N)
+        return (st, hn, hw), None
+
+    (st, hn, hw), _ = jax.lax.scan(
+        body, (win_state,) + tdigest.hist_init(N),
+        (packed, event_time))
+    return st, tdigest.absorb_hist(digest, hn, hw)
+
+
 class SlidingTDigestEngine(_SketchEngineBase):
     """Sliding-window view counts + per-campaign latency t-digest.
 
@@ -480,6 +585,7 @@ class SlidingTDigestEngine(_SketchEngineBase):
                  size_ms: int | None = None, slide_ms: int = 1_000,
                  window_slots: int | None = None,
                  compression: int = 64,
+                 sliced: str | None = None,
                  input_format: str = "json"):
         size = size_ms if size_ms is not None else cfg.jax_time_divisor_ms
         late_eff = sliding.effective_lateness(size, slide_ms,
@@ -508,6 +614,24 @@ class SlidingTDigestEngine(_SketchEngineBase):
         self.size_ms = size
         self.slide_ms = slide_ms
         self.base_lateness = cfg.jax_allowed_lateness_ms
+        # Sliced fold (ISSUE 12; jax.sliding.sliced off/on/auto): the
+        # [C, S, W] bucket-plane state replaces the [C, W] window ring;
+        # flushed rows are bit-identical, the per-batch device work is
+        # one claim + one scatter instead of S of each.
+        mode = (sliced if sliced is not None
+                else getattr(cfg, "jax_sliding_sliced", "auto"))
+        mode = str(mode).strip().lower()
+        if mode not in ("off", "on", "auto"):
+            raise ValueError(f"sliced must be off/on/auto: {mode!r}")
+        S = size // slide_ms
+        if mode == "auto":
+            self.sliced = _sliced_auto(jax.default_backend(), S,
+                                       self.encoder.num_campaigns, self.W)
+        else:
+            self.sliced = mode == "on"
+        if self.sliced:
+            self.state = sliding.init_sliced(self.encoder.num_campaigns,
+                                             self.W, S)
         self.digest = tdigest.init_state(self.encoder.num_campaigns,
                                          compression=compression)
         # The fused scan carries a [C, HIST_BINS] x2 float32 histogram
@@ -527,18 +651,39 @@ class SlidingTDigestEngine(_SketchEngineBase):
     PARALLEL_ENCODE_OK = True
 
     def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
-        self.state, self.digest = _sliding_tdigest_scan(
+        fn = (_sliding_tdigest_scan_sliced if self.sliced
+              else _sliding_tdigest_scan)
+        self.state, self.digest = fn(
             self.state, self.digest, self.join_table, self._now_rel(),
             ad_idx, event_type, event_time, valid,
             size_ms=self.size_ms, slide_ms=self.slide_ms,
             lateness_ms=self.base_lateness, method=self.method)
 
     def _device_scan_packed(self, packed, event_time) -> None:
-        self.state, self.digest = _sliding_tdigest_scan_packed(
+        fn = (_sliding_tdigest_scan_sliced_packed if self.sliced
+              else _sliding_tdigest_scan_packed)
+        self.state, self.digest = fn(
             self.state, self.digest, self.join_table, self._now_rel(),
             packed, event_time,
             size_ms=self.size_ms, slide_ms=self.slide_ms,
             lateness_ms=self.base_lateness, method=self.method)
+
+    # -- sliced drain + host bookkeeping -------------------------------
+    def _track_dirty_rows(self) -> bool:
+        # the sliced drain reconstructs windows from the whole bucket
+        # plane; per-row gathers don't apply to it
+        return False if self.sliced else super()._track_dirty_rows()
+
+    def _drain_device(self) -> None:
+        if not self.sliced:
+            return super()._drain_device()
+        # window deltas reconstructed on device (flush_deltas contract),
+        # parked for the SHARED host materialization path
+        deltas, wids, self.state = sliding.flush_sliced(
+            self.state, size_ms=self.size_ms, slide_ms=self.slide_ms,
+            lateness_ms=self.base_lateness)
+        self._park(("dense", deltas, wids))
+        self._span_start = None
 
     def snapshot(self, offset: int):
         from streambench_tpu.checkpoint import Snapshot
@@ -546,10 +691,17 @@ class SlidingTDigestEngine(_SketchEngineBase):
         self._snapshot_sync()
         meta = self._snapshot_meta()
         meta.update(size_ms=self.size_ms, slide_ms=self.slide_ms,
-                    compression=int(self.digest.means.shape[1]))
+                    compression=int(self.digest.means.shape[1]),
+                    sliced=int(self.sliced))
+        # sliced state rides the counts slot as the flattened
+        # [C, S*W] bucket plane (Snapshot.counts stays 2-D); restore
+        # reshapes it back — geometry is pinned by size/slide/W below
+        counts = np.asarray(self.state.counts)
+        if self.sliced:
+            counts = counts.reshape(counts.shape[0], -1)
         return self._xo_decorate(Snapshot(
             offset=offset, meta=meta,
-            counts=np.asarray(self.state.counts),
+            counts=counts,
             window_ids=np.asarray(self.state.window_ids),
             watermark=int(self.state.watermark),
             dropped=int(self.state.dropped),
@@ -563,7 +715,8 @@ class SlidingTDigestEngine(_SketchEngineBase):
     def restore(self, snap) -> None:
         self._check_geometry(snap, extra=dict(
             size_ms=self.size_ms, slide_ms=self.slide_ms,
-            compression=int(self.digest.means.shape[1])))
+            compression=int(self.digest.means.shape[1]),
+            sliced=int(self.sliced)))
         self.state = self._put_state(
             snap.counts, snap.window_ids, snap.watermark, snap.dropped)
         self.digest = tdigest.TDigestState(
@@ -572,16 +725,20 @@ class SlidingTDigestEngine(_SketchEngineBase):
         self._restore_interns(snap)
         self._restore_host(snap)
 
+    def _put_state(self, counts, window_ids, watermark, dropped):
+        if not self.sliced:
+            return super()._put_state(counts, window_ids, watermark,
+                                      dropped)
+        S = self.size_ms // self.slide_ms
+        plane = np.asarray(counts).reshape(-1, S, self.W)
+        return sliding.SlicedWindowState(
+            counts=jnp.asarray(plane),
+            window_ids=jnp.asarray(window_ids),
+            watermark=jnp.int32(watermark), dropped=jnp.int32(dropped))
+
     def _device_step(self, batch) -> None:
-        ad = jnp.asarray(batch.ad_idx)
-        et = jnp.asarray(batch.event_type)
-        tm = jnp.asarray(batch.event_time)
-        valid = jnp.asarray(batch.valid)
-        self.state = sliding.step(
-            self.state, self.join_table, ad, et, tm, valid,
-            size_ms=self.size_ms, slide_ms=self.slide_ms,
-            lateness_ms=self.base_lateness, method=self.method)
-        # Latency sample per view event, bucketed per campaign.
+        # Fold + latency sample in ONE fused program (see
+        # _sliding_tdigest_step).  Latency is bucketed per campaign.
         # TWO-CLOCK CAVEAT (SURVEY.md §7 "faithful latency semantics"):
         # now_ms() is THIS host's clock, event_time the generator's; the
         # difference is only meaningful when both run on one node or are
@@ -590,10 +747,13 @@ class SlidingTDigestEngine(_SketchEngineBase):
         # update times the same way).  Cross-host skew shifts the whole
         # digest by the offset; the _now_rel clamp only stops negative
         # skew from corrupting the digest with negative "latencies".
-        lat = jnp.maximum(self._now_rel() - tm, 0)
-        campaign = self.join_table[ad]
-        mask = valid & (et == 0) & (campaign >= 0)
-        self.digest = tdigest.update(self.digest, campaign, lat, mask)
+        self.state, self.digest = _sliding_tdigest_step(
+            self.state, self.digest, self.join_table, self._now_rel(),
+            jnp.asarray(batch.ad_idx), jnp.asarray(batch.event_type),
+            jnp.asarray(batch.event_time), jnp.asarray(batch.valid),
+            size_ms=self.size_ms, slide_ms=self.slide_ms,
+            lateness_ms=self.base_lateness, sliced=self.sliced,
+            method=self.method)
 
     def quantiles(self) -> np.ndarray:
         """Per-campaign latency quantiles ``[C, len(QUANTILES)]`` (ms)."""

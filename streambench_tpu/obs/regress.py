@@ -74,6 +74,10 @@ DEFAULT_TOLERANCES: dict = {
     "reach_segment_dispatch_ms": ("lower", 1.0),
     "reach_segment_reply_ms": ("lower", 1.0),
     "reach_contention_ratio": ("lower", 1.0),
+    # sliding A/B (ISSUE 12): both arms' catchup throughput regresses
+    # DOWN; generous like every timing row on the 1-core host
+    "sliding_evps": ("higher", 0.5),
+    "sliding_sliced_evps": ("higher", 0.5),
 }
 
 
@@ -130,6 +134,11 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
     if isinstance(dm, dict):
         out["devmem_peak_footprint_bytes"] = _num(
             dm.get("peak_footprint_bytes"))
+    # sliding A/B block (ISSUE 12): legacy vs sliced fold ev/s
+    sab = doc.get("sliding_ab")
+    if isinstance(sab, dict):
+        out["sliding_evps"] = _num(sab.get("sliding_evps"))
+        out["sliding_sliced_evps"] = _num(sab.get("sliding_sliced_evps"))
     # reach serving block (bench_reach.py artifact / engine stats line)
     reach = doc.get("reach")
     if isinstance(reach, dict):

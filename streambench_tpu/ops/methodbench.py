@@ -28,6 +28,11 @@ import time
 import numpy as np
 
 METHODS = ("scatter", "matmul", "pallas")
+# Sliding-family arms (ISSUE 12): the unrolled per-k fold with its
+# scatter or factored-matmul membership landing, vs the sliced fold
+# (one claim + one scatter into the [C, S, W] bucket plane).  Keyed per
+# (backend, S-bucket) — S = size/slide drives the unrolled forms' cost.
+SLIDING_METHODS = ("scatter", "matmul", "sliced")
 _DEFAULT_CACHE = os.path.join(
     os.path.expanduser("~"), ".cache", "streambench_tpu",
     "method_bench.json")
@@ -197,6 +202,123 @@ def measure_and_record(num_campaigns: int = 100, window_slots: int = 16,
     return res
 
 
+# ----------------------------------------------------------------------
+# Sliding family (ISSUE 12): the real compiled sliding step per arm.
+# ----------------------------------------------------------------------
+
+def sliding_key(backend: str, memberships: int) -> str:
+    return f"{backend}/sliding/S{int(memberships)}"
+
+
+def sliding_winner(backend: str, memberships: int) -> str | None:
+    """Measured sliding-family winner for this backend + S-bucket, or
+    None when nothing was measured (``jax.sliding.sliced=auto`` then
+    falls back to its fits-the-plane heuristic)."""
+    entry = cached_value(sliding_key(backend, memberships))
+    if entry is None:
+        return None
+    winner = entry.get("winner")
+    return winner if winner in SLIDING_METHODS else None
+
+
+def measure_sliding(num_campaigns: int = 100, window_slots: int = 128,
+                    batch_size: int = 8192, size_ms: int = 10_000,
+                    slide_ms: int = 1_000, iters: int = 20,
+                    methods: tuple = SLIDING_METHODS,
+                    time_budget_s: float = 5.0, seed: int = 0) -> dict:
+    """Time the compiled SLIDING step per arm at a given geometry.
+
+    Arms: ``scatter``/``matmul`` run the unrolled per-k fold with that
+    membership landing; ``sliced`` runs the one-claim-one-scatter fold
+    (its bucket scatter uses the tumbling-family measured winner where
+    one exists, else scatter).  Same sampling discipline as
+    ``measure_methods``.
+    """
+    import jax
+
+    from streambench_tpu.ops import sliding
+    from streambench_tpu.ops import windowcount as wc
+
+    rng = np.random.default_rng(seed)
+    C, W, B = int(num_campaigns), int(window_slots), int(batch_size)
+    S = int(size_ms) // int(slide_ms)
+    join_table = np.concatenate(
+        [np.arange(C, dtype=np.int32), np.array([-1], np.int32)])
+    ad_idx = rng.integers(0, C, B).astype(np.int32)
+    event_type = np.zeros(B, np.int32)
+    event_time = np.sort(rng.integers(
+        0, max(W - S, 1), B).astype(np.int32) * np.int32(slide_ms))
+    valid = np.ones(B, bool)
+    jt = jax.numpy.asarray(join_table)
+    cols = [jax.numpy.asarray(c)
+            for c in (ad_idx, event_type, event_time, valid)]
+    bucket_method = cached_winner(jax.default_backend(), C) or "scatter"
+    if bucket_method == "pallas":
+        bucket_method = "scatter"   # pallas tiles consume pairs, not rows
+
+    out: dict = {
+        "backend": jax.default_backend(),
+        "num_campaigns": C, "window_slots": W, "batch_size": B,
+        "size_ms": int(size_ms), "slide_ms": int(slide_ms),
+        "memberships": S, "iters": int(iters), "methods": {},
+    }
+    per_budget = time_budget_s / max(len(methods), 1)
+    for method in methods:
+        def run(st, method=method):
+            if method == "sliced":
+                return sliding.step_sliced(
+                    st, jt, *cols, size_ms=size_ms, slide_ms=slide_ms,
+                    method=bucket_method)
+            return sliding.step(st, jt, *cols, size_ms=size_ms,
+                                slide_ms=slide_ms, method=method)
+
+        try:
+            state = (sliding.init_sliced(C, W, S) if method == "sliced"
+                     else wc.init_state(C, W))
+            st = run(state)
+            jax.block_until_ready(st.counts)      # compile + warm
+            t0 = time.perf_counter()
+            st = run(state)
+            jax.block_until_ready(st.counts)
+            warm_s = time.perf_counter() - t0
+            n = (1 if warm_s > per_budget
+                 else max(1, min(iters, int(per_budget / max(warm_s,
+                                                             1e-7)))))
+            t0 = time.perf_counter()
+            for _ in range(n):
+                st = run(st)
+            jax.block_until_ready(st.counts)
+            per_call = (time.perf_counter() - t0) / n
+            out["methods"][method] = {
+                "ns_per_event": round(per_call * 1e9 / B, 2),
+                "ms_per_step": round(per_call * 1e3, 4),
+                "timed_iters": n,
+            }
+        except Exception as e:  # a broken arm must not kill the table
+            out["methods"][method] = {"error": repr(e)}
+    ranked = sorted(
+        (m for m, v in out["methods"].items() if "ns_per_event" in v),
+        key=lambda m: out["methods"][m]["ns_per_event"])
+    out["winner"] = ranked[0] if ranked else None
+    return out
+
+
+def measure_and_record_sliding(num_campaigns: int = 100,
+                               window_slots: int = 128,
+                               batch_size: int = 8192,
+                               size_ms: int = 10_000,
+                               slide_ms: int = 1_000, **kw) -> dict:
+    """Measure + persist under the backend/sliding/S-bucket key the
+    ``jax.sliding.sliced=auto`` resolution consults."""
+    res = measure_sliding(num_campaigns=num_campaigns,
+                          window_slots=window_slots,
+                          batch_size=batch_size, size_ms=size_ms,
+                          slide_ms=slide_ms, **kw)
+    if res.get("winner"):
+        record(sliding_key(res["backend"], res["memberships"]), res)
+    return res
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -212,16 +334,31 @@ def main(argv=None) -> int:
                          "measured path end to end)")
     ap.add_argument("--no-record", action="store_true",
                     help="print the table without touching the cache")
+    ap.add_argument("--family", default="all",
+                    choices=("count", "sliding", "all"),
+                    help="which kernel family to measure")
     args = ap.parse_args(argv)
     if args.smoke:
         args.campaigns, args.window_slots = 8, 4
         args.batch, args.iters = 128, 2
-    fn = measure_methods if args.no_record else measure_and_record
-    res = fn(num_campaigns=args.campaigns,
-             window_slots=args.window_slots, batch_size=args.batch,
-             iters=args.iters, scan_batches=args.scan_batches)
+    res = {}
+    if args.family in ("count", "all"):
+        fn = measure_methods if args.no_record else measure_and_record
+        res["count"] = fn(num_campaigns=args.campaigns,
+                          window_slots=args.window_slots,
+                          batch_size=args.batch, iters=args.iters,
+                          scan_batches=args.scan_batches)
+    if args.family in ("sliding", "all"):
+        # the sliding ring must hold S memberships; the smoke's tiny
+        # W=4 ring can't, so size the sliding geometry independently
+        fn = (measure_sliding if args.no_record
+              else measure_and_record_sliding)
+        res["sliding"] = fn(
+            num_campaigns=args.campaigns,
+            window_slots=max(args.window_slots, 128),
+            batch_size=args.batch, iters=args.iters)
     print(json.dumps(res, indent=1, sort_keys=True))
-    return 0 if res.get("winner") else 1
+    return 0 if all(v.get("winner") for v in res.values()) else 1
 
 
 if __name__ == "__main__":

@@ -31,17 +31,55 @@ All methods are bit-identical (tested).  State shape is identical to the
 tumbling ``WindowState``; ``flush_deltas`` works unchanged when called
 with the same effective lateness.  ``dropped`` counts lost *memberships*
 (an event has S of them), not events.
+
+Sliced fold (ISSUE 12)
+----------------------
+The unrolled forms above still pay S ring-claim passes per batch.  The
+*sliced* fold (``step_sliced`` + ``flush_sliced``) is the classic
+stream-slicing move (panes / Scotty / Flink slicing): count per-slide
+**buckets** with ONE ``assign_windows`` claim (``divisor = slide``, the
+same effective lateness) and ONE ``apply_count`` scatter, and only at
+drain time materialize each window's count as the sum of its S live
+buckets — a windowed prefix-sum over the ring.  The sliding fold
+becomes a tumbling fold plus an O(C*S*W) drain.
+
+Exactness under allowed lateness needs one refinement: an event can be
+late for its *older* windows but on time for its bucket (legacy drops
+the memberships into already-closed windows, judged against the
+batch-start watermark).  The bucket plane therefore carries a third
+axis of S **lateness classes**: an event lands in class
+``d = clip(bucket - min_open_window, 0, S-1)`` — it is countable for
+exactly its newest ``d+1`` windows — still one scatter, into
+``[C, S, W]``.  ``flush_sliced`` takes a reversed cumulative sum over
+the class axis, so window ``wid`` (= bucket ``wid+k`` at offset ``k``)
+sums class-``>=k`` counts only.  Windows that closed before the
+previous drain provably reconstruct to zero (every later event's class
+excludes them), so the emitted rows are bit-identical to the legacy
+flush wherever legacy itself is well-defined (live window-id span under
+W — the span-guard regime; at ring wrap legacy misattributes evicted
+slots equally).
+
+``dropped`` conversion, exact: the sliced claim drops *events* (bucket
+late or evicted) where legacy drops *memberships*.  The fold converts
+at batch granularity — each accepted event counts ``d+1`` memberships,
+each rejected wanted event drops all ``S`` — so
+``dropped += S * wanted - sum(accepted ? d+1 : 0)`` reproduces the
+legacy membership-granular counter bit for bit (in the same
+no-eviction domain).
 """
 
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 from streambench_tpu.ops.windowcount import (
+    NEG,
     WindowState,
+    _still_open,
     apply_count,
     assign_windows,
 )
@@ -109,3 +147,172 @@ def step(state: WindowState, join_table: jax.Array,
             preferred_element_type=jnp.float32)                  # [C, W]
         counts = counts + delta.astype(jnp.int32)
     return WindowState(counts, ids, watermark, dropped)
+
+
+# ----------------------------------------------------------------------
+# Sliced fold: one claim + one scatter per batch, window sums at drain
+# (module docstring "Sliced fold").
+# ----------------------------------------------------------------------
+
+class SlicedWindowState(NamedTuple):
+    """Device-resident sliced sliding state (all int32).
+
+    counts:     [C, S, W] per-slide bucket deltas since last flush,
+                split by lateness class d (the event is countable for
+                its newest d+1 windows; fully-on-time events land in
+                class S-1)
+    window_ids: [W]  absolute BUCKET id per ring slot; -1 empty.  The
+                ring is claimed with ``divisor = slide`` and the
+                effective lateness, so a bucket's slot frees exactly
+                when the last window containing it closes.
+    watermark:  []   max valid event_time seen (relative ms)
+    dropped:    []   lost *memberships*, legacy-convention (see the
+                module docstring's dropped conversion)
+    """
+
+    counts: jax.Array
+    window_ids: jax.Array
+    watermark: jax.Array
+    dropped: jax.Array
+
+
+def init_sliced(num_campaigns: int, window_slots: int,
+                memberships: int) -> SlicedWindowState:
+    return SlicedWindowState(
+        counts=jnp.zeros((num_campaigns, memberships, window_slots),
+                         jnp.int32),
+        window_ids=jnp.full((window_slots,), -1, jnp.int32),
+        watermark=jnp.int32(0),
+        dropped=jnp.int32(0),
+    )
+
+
+def _sliced_geometry(state: SlicedWindowState, size_ms: int,
+                     slide_ms: int) -> tuple[int, int, int]:
+    if size_ms % slide_ms:
+        raise ValueError("size_ms must be a multiple of slide_ms")
+    S = size_ms // slide_ms
+    C, Sp, W = state.counts.shape
+    if Sp != S:
+        raise ValueError(
+            f"sliced plane carries {Sp} lateness classes, geometry "
+            f"needs S={S}")
+    if S > W:
+        raise ValueError(f"ring too small: {W} slots < {S} memberships")
+    return C, S, W
+
+
+def step_sliced_core(state: SlicedWindowState, join_table: jax.Array,
+                     ad_idx: jax.Array, event_type: jax.Array,
+                     event_time: jax.Array, valid: jax.Array,
+                     *, size_ms: int, slide_ms: int, lateness_ms: int,
+                     view_type: int = 0,
+                     method: str = "scatter") -> SlicedWindowState:
+    """Traced body of ``step_sliced`` (reused by the fused engine scans
+    and the sharded builders): ONE ring claim on per-slide buckets, ONE
+    ``apply_count`` scatter into the ``[C, S, W]`` class plane."""
+    C, S, W = _sliced_geometry(state, size_ms, slide_ms)
+    late_eff = effective_lateness(size_ms, slide_ms, lateness_ms)
+
+    campaign = join_table[ad_idx]
+    bid = event_time // slide_ms
+    wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+    slot, count_mask, ids, watermark = assign_windows(
+        state.window_ids, state.watermark, bid, wanted, valid, event_time,
+        divisor_ms=slide_ms, lateness_ms=late_eff)
+
+    # Lateness class: the event counts toward its newest d+1 windows
+    # (min_open judged against the batch-start watermark, exactly the
+    # per-membership mask of the unrolled forms).
+    min_open = jnp.maximum((state.watermark - late_eff) // slide_ms, 0)
+    d = jnp.clip(bid - min_open, 0, S - 1)
+
+    # One scatter: the [C, S, W] plane flattened to [C*S, W] rows keeps
+    # apply_count's measured method choice (scatter/matmul/...) intact.
+    row = campaign * S + d
+    counts = apply_count(state.counts.reshape(C * S, W), row, slot,
+                         count_mask, method).reshape(C, S, W)
+
+    # Membership-granular dropped, converted exactly (module docstring).
+    counted = jnp.sum(jnp.where(count_mask, d + 1, 0))
+    dropped = state.dropped + (
+        S * jnp.sum(wanted.astype(jnp.int32)) - counted)
+    return SlicedWindowState(counts, ids, watermark, dropped)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("size_ms", "slide_ms", "lateness_ms", "view_type",
+                     "method"))
+def step_sliced(state: SlicedWindowState, join_table: jax.Array,
+                ad_idx: jax.Array, event_type: jax.Array,
+                event_time: jax.Array, valid: jax.Array,
+                *, size_ms: int = 10_000, slide_ms: int = 1_000,
+                lateness_ms: int = 60_000, view_type: int = 0,
+                method: str = "scatter") -> SlicedWindowState:
+    """Fold one micro-batch into the sliced bucket plane."""
+    return step_sliced_core(state, join_table, ad_idx, event_type,
+                            event_time, valid, size_ms=size_ms,
+                            slide_ms=slide_ms, lateness_ms=lateness_ms,
+                            view_type=view_type, method=method)
+
+
+def flush_sliced_core(state: SlicedWindowState, *, size_ms: int,
+                      slide_ms: int, lateness_ms: int):
+    """Traced body of ``flush_sliced`` (reused by the sharded drain).
+
+    Windowed prefix-sum over the ring: for the window anchored at slot
+    ``s``, offset-k buckets live at slot ``(s+k) % W`` and contribute
+    their lateness-class ``>= k`` counts (the reversed class cumsum).
+    A slot's window id is the max consistent candidate
+    ``bucket_id[(s+k)%W] - k`` — candidates from buckets outside the
+    window (evicted or wrapped slots) are masked out, which is the
+    "mask-aware of evicted slots" rule.
+    """
+    C, S, W = _sliced_geometry(state, size_ms, slide_ms)
+    late_eff = effective_lateness(size_ms, slide_ms, lateness_ms)
+    ids = state.window_ids
+
+    # rcum[:, k, :] = counts of lateness class >= k (countable at
+    # window offset k)
+    rcum = jnp.cumsum(state.counts[:, ::-1, :], axis=1)[:, ::-1, :]
+
+    sl = jnp.arange(W, dtype=jnp.int32)
+    best = jnp.full((W,), NEG, jnp.int32)
+    for k in range(S):
+        bk = ids[(sl + k) % W]
+        best = jnp.maximum(best, jnp.where(bk >= 0, bk - k, NEG))
+    wid = jnp.where(best >= 0, best, -1)
+
+    win = jnp.zeros((C, W), jnp.int32)
+    for k in range(S):
+        idx = (sl + k) % W
+        bk = ids[idx]
+        take = (bk >= 0) & (bk - k == wid) & (wid >= 0)
+        win = win + jnp.where(take[None, :], rcum[:, k, idx], 0)
+
+    new_state = SlicedWindowState(
+        counts=jnp.zeros_like(state.counts),
+        window_ids=_still_open(ids, state.watermark, slide_ms, late_eff),
+        watermark=state.watermark,
+        dropped=state.dropped,
+    )
+    return win, wid, new_state
+
+
+@functools.partial(
+    jax.jit, static_argnames=("size_ms", "slide_ms", "lateness_ms"))
+def flush_sliced(state: SlicedWindowState, *, size_ms: int = 10_000,
+                 slide_ms: int = 1_000, lateness_ms: int = 60_000):
+    """Drain window deltas from the sliced bucket plane.
+
+    Returns ``(delta_counts [C, W], window_ids [W], new_state)`` in the
+    exact ``flush_deltas`` contract (window id per output slot, deltas
+    per campaign, planes zeroed, closed bucket slots freed) — the host
+    materialization path is shared verbatim with the legacy fold.
+    Emitted rows are bit-identical to the legacy per-k fold's flush in
+    the span-guard regime (module docstring).
+    """
+    return flush_sliced_core(state, size_ms=size_ms, slide_ms=slide_ms,
+                             lateness_ms=lateness_ms)

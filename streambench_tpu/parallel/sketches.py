@@ -593,36 +593,47 @@ class ShardedHLLEngine(HLLDistinctEngine):
 # Sharded sliding windows + t-digest
 # ----------------------------------------------------------------------
 
-def _sliding_td_fold(counts, window_ids, watermark, dropped, means,
-                     weights, join_table, now_rel,
-                     ad_idx, event_type, event_time, valid,
-                     *, size_ms: int, slide_ms: int, lateness_ms: int,
-                     view_type: int, hist=None):
-    """One batch folded into a campaign shard: S sliding memberships
-    into the counts ring + latency samples into the shard's t-digests.
+def _sliding_digest_local(means, weights, now_rel, local_c, tm, dmask,
+                          Cl, hist):
+    """The shared digest half of every sharded sliding fold: step form
+    compresses the batch into the digest, scan form folds the O(B)
+    histogram only (one absorb per chunk)."""
+    lat = jnp.maximum(now_rel - tm, 0)
+    if hist is None:
+        dg = tdigest.update(
+            tdigest.TDigestState(means, weights), local_c, lat, dmask)
+        return dg.means, dg.weights, None
+    w = jnp.where(dmask, 1.0, 0.0).astype(jnp.float32)
+    hn, hw = tdigest.fold_hist(hist[0], hist[1], local_c, lat, w, Cl)
+    return means, weights, (hn, hw)
 
-    The batch columns ``all_gather`` over the data axis and each
-    campaign shard folds the full batch masked to its own campaigns —
-    the digest "merge" is OWNERSHIP (every campaign's digest has exactly
-    one writer), the same unifier-by-routing as the exact engine's
-    psum-free counts (``ApplicationDimensionComputation.java:120`` is
-    the reference's explicit-unifier analog); ``ops.tdigest.merge``
-    remains the explicit union for offline digest joins.  Mirrors
+
+def _sliding_td_fold_local(counts, window_ids, watermark, means, weights,
+                           join_table, now_rel, ad, et, tm, v,
+                           *, size_ms: int, slide_ms: int,
+                           lateness_ms: int, view_type: int, hist=None):
+    """The collective-free legacy (unrolled per-k) sliding fold over
+    ALREADY-REPLICATED columns: S sliding memberships into the counts
+    ring + latency samples into the shard's t-digests.
+
+    Each campaign shard folds the full batch masked to its own
+    campaigns — the digest "merge" is OWNERSHIP (every campaign's
+    digest has exactly one writer), the same unifier-by-routing as the
+    exact engine's psum-free counts
+    (``ApplicationDimensionComputation.java:120`` is the reference's
+    explicit-unifier analog); ``ops.tdigest.merge`` remains the
+    explicit union for offline digest joins.  Mirrors
     ``ops.sliding.step`` + ``SlidingTDigestEngine._device_step``
     semantics exactly (within-key ranks are key-local, so shard-local
     folding is bit-compatible with the single-device digest up to
-    float-add ordering inside a centroid).
+    float-add ordering inside a centroid).  Returns ``counted_local``
+    for the caller to psum — per batch (``_sliding_td_fold``) or once
+    per dispatch (the hoisted scan; psum is linear over int32 sums, so
+    deferring the merge is bit-identical).
     """
     Cl, W = counts.shape
     S = size_ms // slide_ms
     late_eff = sliding.effective_lateness(size_ms, slide_ms, lateness_ms)
-
-    gather = functools.partial(jax.lax.all_gather, axis_name=DATA_AXIS,
-                               tiled=True)
-    ad = gather(ad_idx)
-    et = gather(event_type)
-    tm = gather(event_time)
-    v = gather(valid)
 
     campaign = join_table[ad]
     base_wid = tm // slide_ms
@@ -646,61 +657,128 @@ def _sliding_td_fold(counts, window_ids, watermark, dropped, means,
                   .at[flat].add(1, mode="drop")
                   .reshape(Cl, W))
         counted_acc = counted_acc + jnp.sum(in_shard.astype(jnp.int32))
+
+    means, weights, hist = _sliding_digest_local(
+        means, weights, now_rel, local_c, tm, wanted & shard_mask, Cl,
+        hist)
+    out = (counts, ids, new_wm, wanted_n, counted_acc, means, weights)
+    return out if hist is None else out + (hist,)
+
+
+def _sliding_sliced_fold_local(counts, window_ids, watermark, means,
+                               weights, join_table, now_rel, ad, et, tm,
+                               v, *, size_ms: int, slide_ms: int,
+                               lateness_ms: int, view_type: int,
+                               hist=None):
+    """The SLICED sharded sliding fold (ISSUE 12) over already-replicated
+    columns: one ring claim on per-slide buckets + one scatter into the
+    campaign shard's ``[Cl, S, W]`` lateness-class plane — the sharded
+    form of ``ops.sliding.step_sliced_core`` (same dropped conversion:
+    an accepted event owns d+1 memberships, counted on its owner shard
+    only, so the deferred psum reproduces the single-device counter)."""
+    Cl, S, W = counts.shape
+    late_eff = sliding.effective_lateness(size_ms, slide_ms, lateness_ms)
+
+    campaign = join_table[ad]
+    bid = tm // slide_ms
+    wanted = v & (et == view_type) & (campaign >= 0)
+    c0 = jax.lax.axis_index(CAMPAIGN_AXIS) * Cl
+    local_c = campaign - c0
+    shard_mask = (local_c >= 0) & (local_c < Cl)
+    wanted_n = jnp.sum(wanted.astype(jnp.int32))
+
+    slot, count_mask, ids, new_wm = assign_windows(
+        window_ids, watermark, bid, wanted, v, tm,
+        divisor_ms=slide_ms, lateness_ms=late_eff)
+    min_open = jnp.maximum((watermark - late_eff) // slide_ms, 0)
+    d = jnp.clip(bid - min_open, 0, S - 1)
+    in_shard = count_mask & shard_mask
+    flat = jnp.where(in_shard, (local_c * S + d) * W + slot, Cl * S * W)
+    counts = (counts.reshape(-1)
+              .at[flat].add(1, mode="drop")
+              .reshape(Cl, S, W))
+    counted_acc = jnp.sum(jnp.where(in_shard, d + 1, 0))
+
+    means, weights, hist = _sliding_digest_local(
+        means, weights, now_rel, local_c, tm, wanted & shard_mask, Cl,
+        hist)
+    out = (counts, ids, new_wm, wanted_n, counted_acc, means, weights)
+    return out if hist is None else out + (hist,)
+
+
+def _sliding_td_fold(counts, window_ids, watermark, dropped, means,
+                     weights, join_table, now_rel,
+                     ad_idx, event_type, event_time, valid,
+                     *, size_ms: int, slide_ms: int, lateness_ms: int,
+                     view_type: int, sliced: bool = False, hist=None):
+    """One batch folded into a campaign shard: gather the data-sharded
+    columns, run the (legacy or sliced) local fold, psum the membership
+    counter — the per-batch collective arm."""
+    S = size_ms // slide_ms
+    ad, et, tm, v = _gather_cols(ad_idx, event_type, event_time, valid)
+    fold = (_sliding_sliced_fold_local if sliced
+            else _sliding_td_fold_local)
+    counts, ids, new_wm, wanted_n, counted, means, weights, *h = fold(
+        counts, window_ids, watermark, means, weights, join_table,
+        now_rel, ad, et, tm, v, size_ms=size_ms, slide_ms=slide_ms,
+        lateness_ms=lateness_ms, view_type=view_type, hist=hist)
     # ONE scalar psum for all S memberships (psum is linear; per-slot
     # psums would put S collectives on the hot path for the same result)
-    dropped = dropped + S * wanted_n - jax.lax.psum(counted_acc,
+    dropped = dropped + S * wanted_n - jax.lax.psum(counted,
                                                     CAMPAIGN_AXIS)
-
-    # Latency sample per view event into the owner shard's digest.
-    lat = jnp.maximum(now_rel - tm, 0)
-    dmask = wanted & shard_mask
-    if hist is None:
-        # step form: fold + compress this one batch into the digest
-        # (tdigest.update masks out-of-range keys itself; local_c raw)
-        dg = tdigest.update(
-            tdigest.TDigestState(means, weights), local_c, lat, dmask)
-        return counts, ids, new_wm, dropped, dg.means, dg.weights
-    # scan form: O(B) histogram fold only; the caller absorbs once per
-    # chunk (fold_hist masks out-of-range local_c itself)
-    w = jnp.where(dmask, 1.0, 0.0).astype(jnp.float32)
-    hn, hw = tdigest.fold_hist(hist[0], hist[1], local_c, lat, w, Cl)
-    return counts, ids, new_wm, dropped, means, weights, (hn, hw)
+    out = (counts, ids, new_wm, dropped, means, weights)
+    return out if hist is None else out + tuple(h)
 
 
 _SLIDING_STATE_SPECS = (P(CAMPAIGN_AXIS, None), P(), P(), P(),
                         P(CAMPAIGN_AXIS, None), P(CAMPAIGN_AXIS, None))
+# sliced counts carry the [Cl, S, W] lateness-class plane
+_SLICED_STATE_SPECS = (P(CAMPAIGN_AXIS, None, None), P(), P(), P(),
+                       P(CAMPAIGN_AXIS, None), P(CAMPAIGN_AXIS, None))
 
 
 @functools.lru_cache(maxsize=None)
 def _build_sliding_step(mesh: Mesh, size_ms: int, slide_ms: int,
-                        lateness_ms: int, view_type: int = 0):
+                        lateness_ms: int, view_type: int = 0,
+                        sliced: bool = False):
     def body(counts, ids, wm, dr, means, weights, join_table, now_rel,
              ad_idx, event_type, event_time, valid):
         return _sliding_td_fold(
             counts, ids, wm, dr, means, weights, join_table, now_rel,
             ad_idx, event_type, event_time, valid, size_ms=size_ms,
             slide_ms=slide_ms, lateness_ms=lateness_ms,
-            view_type=view_type)
+            view_type=view_type, sliced=sliced)
 
+    state_specs = _SLICED_STATE_SPECS if sliced else _SLIDING_STATE_SPECS
     mapped = shard_map(
         body, mesh=mesh,
-        in_specs=_SLIDING_STATE_SPECS + (P(), P(), P(DATA_AXIS),
-                                         P(DATA_AXIS), P(DATA_AXIS),
-                                         P(DATA_AXIS)),
-        out_specs=_SLIDING_STATE_SPECS,
+        in_specs=state_specs + (P(), P(), P(DATA_AXIS),
+                                P(DATA_AXIS), P(DATA_AXIS),
+                                P(DATA_AXIS)),
+        out_specs=state_specs,
     )
     return jax.jit(mapped, donate_argnums=(0, 4, 5))
 
 
 @functools.lru_cache(maxsize=None)
 def _build_sliding_scan(mesh: Mesh, size_ms: int, slide_ms: int,
-                        lateness_ms: int, view_type: int = 0):
+                        lateness_ms: int, view_type: int = 0,
+                        hoist: bool = True, sliced: bool = False):
     """Scanned sharded sliding+t-digest: fold ``[K, B]`` stacked batches
     in one dispatch (the catchup hot path, peer of
-    ``engine.sketches._sliding_tdigest_scan``)."""
+    ``engine.sketches._sliding_tdigest_scan``).  ``hoist=True`` (the
+    engine default) gathers the stacked columns ONCE per dispatch and
+    psums the membership counter once after the scan — 5 collectives
+    per dispatch instead of K * 5 (the PR 7 treatment, extended to the
+    sliding family); ``hoist=False`` keeps the per-batch collectives as
+    the measured baseline arm and equivalence oracle.  ``sliced=True``
+    scans the one-claim-one-scatter fold over the [Cl, S, W] plane."""
+    S = size_ms // slide_ms
+    fold_local = (_sliding_sliced_fold_local if sliced
+                  else _sliding_td_fold_local)
 
-    def body(counts, ids, wm, dr, means, weights, join_table, now_rel,
-             ad_idx, event_type, event_time, valid):
+    def body_per_batch(counts, ids, wm, dr, means, weights, join_table,
+                       now_rel, ad_idx, event_type, event_time, valid):
         Cl = counts.shape[0]
 
         def one(carry, xs):
@@ -710,7 +788,7 @@ def _build_sliding_scan(mesh: Mesh, size_ms: int, slide_ms: int,
                 c, i, w_, d, means, weights, join_table, now_rel,
                 a, e, t, v, size_ms=size_ms, slide_ms=slide_ms,
                 lateness_ms=lateness_ms, view_type=view_type,
-                hist=(hn, hw))
+                sliced=sliced, hist=(hn, hw))
             return (c, i, w_, d, hn, hw), None
 
         (c, i, w_, d, hn, hw), _ = jax.lax.scan(
@@ -721,13 +799,40 @@ def _build_sliding_scan(mesh: Mesh, size_ms: int, slide_ms: int,
             tdigest.TDigestState(means, weights), hn, hw)
         return c, i, w_, d, dg.means, dg.weights
 
+    def body_hoisted(counts, ids, wm, dr, means, weights, join_table,
+                     now_rel, ad_idx, event_type, event_time, valid):
+        Cl = counts.shape[0]
+        cols = _gather_cols(ad_idx, event_type, event_time, valid)
+
+        def one(carry, xs):
+            c, i, w_, hn, hw = carry
+            a, e, t, v = xs
+            c, i, w_, wn, cl, _, _, (hn, hw) = fold_local(
+                c, i, w_, means, weights, join_table, now_rel,
+                a, e, t, v, size_ms=size_ms, slide_ms=slide_ms,
+                lateness_ms=lateness_ms, view_type=view_type,
+                hist=(hn, hw))
+            return (c, i, w_, hn, hw), (wn, cl)
+
+        (c, i, w_, hn, hw), (wns, cls) = jax.lax.scan(
+            one, (counts, ids, wm) + tdigest.hist_init(Cl),
+            cols)
+        # deferred membership merge: ONE psum per dispatch (linear over
+        # the int32 per-batch sums, bit-identical to per-batch merges)
+        d = dr + S * jnp.sum(wns) - jax.lax.psum(jnp.sum(cls),
+                                                 CAMPAIGN_AXIS)
+        dg = tdigest.absorb_hist(
+            tdigest.TDigestState(means, weights), hn, hw)
+        return c, i, w_, d, dg.means, dg.weights
+
+    state_specs = _SLICED_STATE_SPECS if sliced else _SLIDING_STATE_SPECS
     mapped = shard_map(
-        body, mesh=mesh,
-        in_specs=_SLIDING_STATE_SPECS + (P(), P(), P(None, DATA_AXIS),
-                                         P(None, DATA_AXIS),
-                                         P(None, DATA_AXIS),
-                                         P(None, DATA_AXIS)),
-        out_specs=_SLIDING_STATE_SPECS,
+        body_hoisted if hoist else body_per_batch, mesh=mesh,
+        in_specs=state_specs + (P(), P(), P(None, DATA_AXIS),
+                                P(None, DATA_AXIS),
+                                P(None, DATA_AXIS),
+                                P(None, DATA_AXIS)),
+        out_specs=state_specs,
     )
     return jax.jit(mapped, donate_argnums=(0, 4, 5))
 
@@ -750,11 +855,12 @@ class ShardedSlidingTDigestEngine(SlidingTDigestEngine):
                  redis: RedisLike | None = None,
                  size_ms: int | None = None, slide_ms: int = 1_000,
                  window_slots: int | None = None, compression: int = 64,
+                 sliced: str | None = None,
                  input_format: str = "json"):
         super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
                          redis=redis, size_ms=size_ms, slide_ms=slide_ms,
                          window_slots=window_slots, compression=compression,
-                         input_format=input_format)
+                         sliced=sliced, input_format=input_format)
         self.mesh = mesh
         # Non-divisible batch sizes pad with invalid rows at dispatch,
         # exactly like the exact-count engine (parallel.sharded).
@@ -774,9 +880,13 @@ class ShardedSlidingTDigestEngine(SlidingTDigestEngine):
                            (a.ndim - 1))
             return a
 
-        self.state = WindowState(
+        state_cls = type(self.state)
+        counts_sharding = (NamedSharding(
+            self.mesh, P(CAMPAIGN_AXIS, None, None))
+            if self.sliced else cshard)
+        self.state = state_cls(
             counts=jax.device_put(jnp.asarray(pad_rows(self.state.counts)),
-                                  cshard),
+                                  counts_sharding),
             window_ids=jax.device_put(
                 jnp.asarray(np.asarray(self.state.window_ids)), rep),
             watermark=jax.device_put(jnp.int32(self.state.watermark), rep),
@@ -796,12 +906,13 @@ class ShardedSlidingTDigestEngine(SlidingTDigestEngine):
 
     def _uncarry(self, out) -> None:
         counts, ids, wm, dr, means, weights = out
-        self.state = WindowState(counts, ids, wm, dr)
+        state_cls = type(self.state)
+        self.state = state_cls(counts, ids, wm, dr)
         self.digest = tdigest.TDigestState(means, weights)
 
     def _device_step(self, batch) -> None:
         fn = _build_sliding_step(self.mesh, self.size_ms, self.slide_ms,
-                                 self.base_lateness)
+                                 self.base_lateness, 0, self.sliced)
         cols = pad_data_cols(self._data_pad, batch.ad_idx,
                              batch.event_type, batch.event_time,
                              batch.valid)
@@ -810,11 +921,52 @@ class ShardedSlidingTDigestEngine(SlidingTDigestEngine):
 
     def _device_scan(self, ad_idx, event_type, event_time, valid) -> None:
         fn = _build_sliding_scan(self.mesh, self.size_ms, self.slide_ms,
-                                 self.base_lateness)
+                                 self.base_lateness, 0, True, self.sliced)
         cols = pad_data_cols(self._data_pad, ad_idx, event_type,
                              event_time, valid)
         self._uncarry(fn(*self._carry(), self.join_table, self._now_rel(),
                          *cols))
+
+    def attach_obs(self, registry, lifecycle: bool = False,
+                   spans=None, occupancy=None, xfer=None,
+                   shard=None) -> None:
+        super().attach_obs(registry, lifecycle, spans=spans,
+                           occupancy=occupancy, xfer=xfer, shard=shard)
+        self._obs_reg = registry
+
+    def collective_report(self, k: int | None = None) -> dict:
+        """Per-dispatch collective costs of the compiled sliding kernels
+        (see ``ShardedWindowEngine.collective_report``): the ISSUE 12
+        HLO-measured number for the hoisted sliding scan."""
+        from streambench_tpu.parallel import collectives
+
+        k = int(k or self.scan_batches)
+        B = self.batch_size + self._data_pad
+        zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+        carry = self._carry()
+        now = jnp.int32(0)
+        step_fn = _build_sliding_step(self.mesh, self.size_ms,
+                                      self.slide_ms, self.base_lateness,
+                                      0, self.sliced)
+        scan_fn = _build_sliding_scan(self.mesh, self.size_ms,
+                                      self.slide_ms, self.base_lateness,
+                                      0, True, self.sliced)
+        report = {
+            "batch_events": self.batch_size,
+            "scan_batches": k,
+            "sliced": bool(self.sliced),
+            "step": collectives.report_for(
+                step_fn, *carry, self.join_table, now, zi(B), zi(B),
+                zi(B), jnp.zeros((B,), bool)),
+            "scan": collectives.report_for(
+                scan_fn, *carry, self.join_table, now, zi(k, B),
+                zi(k, B), zi(k, B), jnp.zeros((k, B), bool),
+                scan_len=k),
+        }
+        reg = getattr(self, "_obs_reg", None)
+        if reg is not None:
+            collectives.publish_gauges(reg, report)
+        return report
 
     def quantiles(self) -> np.ndarray:
         # padded campaign rows are empty digests; slice them off
@@ -954,13 +1106,28 @@ def _build_session_step(mesh: Mesh, gap_ms: int, lateness_ms: int,
 
 @functools.lru_cache(maxsize=None)
 def _build_session_scan(mesh: Mesh, gap_ms: int, lateness_ms: int,
-                        user_capacity: int):
+                        user_capacity: int, hoist: bool = True):
     """Scanned sharded session+CMS: the whole config-#4 pipeline over
-    ``[K, B]`` stacked batches in one dispatch, collectives inside the
-    scan body (peer of ``engine.sketches._session_cms_scan``)."""
+    ``[K, B]`` stacked batches in one dispatch (peer of
+    ``engine.sketches._session_cms_scan``).
 
-    def body(lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl, hist,
-             now_rel, user_idx, event_type, event_time, valid):
+    ``hoist=False`` keeps the collectives inside the scan body — per
+    batch, per closure group: a CMS-delta psum, five closed-row
+    all_gathers for the candidate ring, and the counter psums, i.e.
+    ~K * 16 collectives per dispatch.  ``hoist=True`` (the engine
+    default, the PR 7 treatment extended to the session family) makes
+    the scan body collective-free: each batch's per-shard CMS deltas,
+    closed rows, and counters ride the scan ys, merge in ONE stacked
+    psum / all_gather each after the scan (int adds are linear; the
+    gathered row order per (batch, closure) slice is identical), and a
+    collective-free replay loop then applies the 2K candidate-ring
+    updates against the same evolving CMS prefix states the per-batch
+    arm saw — bit-identical output, ~4 collectives per dispatch.
+    """
+
+    def body_per_batch(lt, ss, ck, wm, dr, table, total, tkk, tke, cn,
+                       cl, hist, now_rel, user_idx, event_type,
+                       event_time, valid):
         def one(carry, xs):
             u, e, t, v = xs
             return _session_fold(*carry, now_rel, u, e, t, v,
@@ -974,8 +1141,94 @@ def _build_session_scan(mesh: Mesh, gap_ms: int, lateness_ms: int,
             (user_idx, event_type, event_time, valid))
         return carry
 
+    def body_hoisted(lt, ss, ck, wm, dr, table, total, tkk, tke, cn,
+                     cl, hist, now_rel, user_idx, event_type,
+                     event_time, valid):
+        Ul = lt.shape[0]
+        u0 = _shard_index() * Ul
+        D, Wd = table.shape
+
+        def one(carry, xs):
+            lt, ss, ck, wm, dr = carry
+            u, e, t, v = xs
+            lu = u - u0
+            in_shard = (lu >= 0) & (lu < Ul)
+            vv = v & in_shard
+            local = session.SessionState(lt, ss, ck, wm, jnp.int32(0))
+            st, c_in, c_carry = session.step(
+                local, jnp.where(vv, lu, -1), e, t, vv,
+                gap_ms=gap_ms, lateness_ms=lateness_ms)
+            # global watermark / drop facts from the replicated batch
+            # (identical math to _session_fold)
+            batch_max = jnp.max(jnp.where(v, t, NEG))
+            new_wm = jnp.maximum(wm, batch_max)
+            min_t = wm - lateness_ms
+            ok = (v & (t >= min_t) & (u >= 0) & (u < user_capacity))
+            new_dr = dr + jnp.sum(v.astype(jnp.int32)) \
+                - jnp.sum(ok.astype(jnp.int32))
+            det_bin = jnp.clip(
+                jnp.maximum(now_rel - jnp.max(jnp.where(v, t, NEG)), 0)
+                // LAT_BIN_MS, 0, LAT_BINS - 1)
+            ys = []
+            for closed in (_globalize(c_in, u0),
+                           _globalize(c_carry, u0)):
+                zero = cms.CMSState(
+                    table=jnp.zeros((D, Wd), jnp.int32),
+                    total=jnp.int32(0))
+                loc = cms.update(zero, closed.user, closed.clicks,
+                                 closed.valid)
+                ys.append((loc.table, loc.total, closed.user,
+                           closed.valid,
+                           jnp.sum(closed.valid.astype(jnp.int32)),
+                           jnp.sum(jnp.where(closed.valid,
+                                             closed.clicks, 0))))
+            stack = tuple(jnp.stack(parts) for parts in zip(*ys))
+            return (st.last_time, st.sess_start, st.clicks, new_wm,
+                    new_dr), stack + (det_bin,)
+
+        (lt, ss, ck, wm, dr), ys = jax.lax.scan(
+            one, (lt, ss, ck, wm, dr),
+            (user_idx, event_type, event_time, valid))
+        d_tab, d_tot, c_user, c_valid, c_n, c_clicks, det_bins = ys
+
+        # the deferred merges: ONE stacked psum for the CMS deltas, ONE
+        # for the packed scalar counters, ONE all_gather per closed-row
+        # column — vs one of each per (batch, closure) in the loop arm
+        d_tab = jax.lax.psum(d_tab, MESH_AXES)              # [K, 2, D, Wd]
+        scalars = jax.lax.psum(
+            jnp.stack([d_tot, c_n, c_clicks], axis=-1),
+            MESH_AXES)                                      # [K, 2, 3]
+        g = functools.partial(jax.lax.all_gather,
+                              axis_name=MESH_AXES, axis=2, tiled=True)
+        c_user = g(c_user)                                  # [K, 2, B*n]
+        c_valid = g(c_valid)
+
+        # collective-free replay: the candidate ring consumes every
+        # closure against the SAME evolving CMS prefix the per-batch
+        # arm used (delta adds are reassociated, values identical)
+        K2 = d_tab.shape[0] * 2
+        def absorb(carry, xs):
+            table, total, tkk, tke, cn, cl, hist = carry
+            dt, sc, gu, gv, db = xs
+            table = table + dt
+            total = total + sc[0]
+            tk = cms.update_topk(cms.CMSState(table, total),
+                                 cms.TopKState(tkk, tke), gu, gv)
+            return (table, total, tk.keys, tk.ests, cn + sc[1],
+                    cl + sc[2], hist.at[db].add(sc[1])), None
+
+        (table, total, tkk, tke, cn, cl, hist), _ = jax.lax.scan(
+            absorb, (table, total, tkk, tke, cn, cl, hist),
+            (d_tab.reshape((K2,) + d_tab.shape[2:]),
+             scalars.reshape(K2, 3),
+             c_user.reshape(K2, -1),
+             c_valid.reshape(K2, -1),
+             jnp.repeat(det_bins, 2)))
+        return (lt, ss, ck, wm, dr, table, total, tkk, tke, cn, cl,
+                hist)
+
     mapped = shard_map(
-        body, mesh=mesh,
+        body_hoisted if hoist else body_per_batch, mesh=mesh,
         in_specs=_SESS_STATE_SPECS + (P(), P(None, None), P(None, None),
                                       P(None, None), P(None, None)),
         out_specs=_SESS_STATE_SPECS,
@@ -1114,9 +1367,47 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
 
     def _device_scan(self, user_idx, event_type, event_time, valid) -> None:
         fn = _build_session_scan(self.mesh, self.gap_ms, self.lateness,
-                                 self.user_capacity)
+                                 self.user_capacity, True)
         self._uncarry(fn(*self._carry(), self._now_rel(), user_idx,
                          event_type, event_time, valid))
+
+    def attach_obs(self, registry, lifecycle: bool = False,
+                   spans=None, occupancy=None, xfer=None,
+                   shard=None) -> None:
+        super().attach_obs(registry, lifecycle, spans=spans,
+                           occupancy=occupancy, xfer=xfer, shard=shard)
+        self._obs_reg = registry
+
+    def collective_report(self, k: int | None = None) -> dict:
+        """Per-dispatch collective costs of the compiled session kernels
+        — the ISSUE 12 HLO-measured number for the hoisted session scan
+        (collective-free scan body, stacked post-scan merges)."""
+        from streambench_tpu.parallel import collectives
+
+        k = int(k or self.scan_batches)
+        B = self.batch_size
+        zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+        carry = self._carry()
+        now = jnp.int32(0)
+        step_fn = _build_session_step(self.mesh, self.gap_ms,
+                                      self.lateness, self.user_capacity)
+        scan_fn = _build_session_scan(self.mesh, self.gap_ms,
+                                      self.lateness, self.user_capacity,
+                                      True)
+        report = {
+            "batch_events": B,
+            "scan_batches": k,
+            "step": collectives.report_for(
+                step_fn, *carry, now, zi(B), zi(B), zi(B),
+                jnp.zeros((B,), bool)),
+            "scan": collectives.report_for(
+                scan_fn, *carry, now, zi(k, B), zi(k, B), zi(k, B),
+                jnp.zeros((k, B), bool), scan_len=k),
+        }
+        reg = getattr(self, "_obs_reg", None)
+        if reg is not None:
+            collectives.publish_gauges(reg, report)
+        return report
 
     def _sharded_flush(self, force: bool) -> None:
         fn = _build_session_flush(self.mesh, self.gap_ms, self.lateness,

@@ -27,6 +27,10 @@ SMOKE_ENV = {
     "STREAMBENCH_BENCH_PACED_SECS": "5",
     "STREAMBENCH_BENCH_PACED_RATE": "2000",
     "STREAMBENCH_BENCH_CONFIGS": "0",  # skip the sketch/config suite
+    # skip the sliding A/B phase: ~6 engine warmups + reps would
+    # triple this smoke's wall time; the A/B keys' parse contract is
+    # pinned by the CI bench-smoke step instead
+    "STREAMBENCH_BENCH_SLIDING": "0",
     # the artifact side file must not clobber the repo's committed one
     "STREAMBENCH_BENCH_TRACE": "0",
 }

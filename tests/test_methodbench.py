@@ -71,7 +71,8 @@ def test_cache_tolerates_garbage_file(cache):
 
 def test_cli_smoke_records_measured_winner(cache):
     """CI's measured-path exercise: the module CLI at --smoke sizes
-    writes a winner the next default_method call can consult."""
+    writes BOTH family tables — the count winner default_method
+    consults AND the sliding table jax.sliding.sliced=auto consults."""
     p = subprocess.run(
         [sys.executable, "-m", "streambench_tpu.ops.methodbench",
          "--smoke"],
@@ -81,5 +82,38 @@ def test_cli_smoke_records_measured_winner(cache):
              "JAX_PLATFORMS": "cpu"})
     assert p.returncode == 0, p.stderr[-500:]
     res = json.loads(p.stdout)
-    assert res["winner"] in methodbench.METHODS
-    assert methodbench.cached_winner(res["backend"], 8) == res["winner"]
+    count = res["count"]
+    assert count["winner"] in methodbench.METHODS
+    assert methodbench.cached_winner(count["backend"], 8) == \
+        count["winner"]
+    # the sliding table exists (ISSUE 12 CI contract)
+    sl = res["sliding"]
+    assert sl["winner"] in methodbench.SLIDING_METHODS
+    assert set(sl["methods"]) == set(methodbench.SLIDING_METHODS)
+    assert methodbench.sliding_winner(
+        sl["backend"], sl["memberships"]) == sl["winner"]
+
+
+def test_measure_sliding_smoke_and_winner_roundtrip(cache):
+    res = methodbench.measure_and_record_sliding(
+        num_campaigns=8, window_slots=128, batch_size=64, iters=1)
+    assert set(res["methods"]) == set(methodbench.SLIDING_METHODS)
+    assert res["winner"] in methodbench.SLIDING_METHODS
+    assert res["memberships"] == 10
+    assert methodbench.sliding_winner(res["backend"], 10) == res["winner"]
+    # a different S-bucket is NOT trusted
+    assert methodbench.sliding_winner(res["backend"], 5) is None
+    # the auto resolution consults the measurement
+    from streambench_tpu.engine.sketches import _sliced_auto
+
+    methodbench.record(methodbench.sliding_key(res["backend"], 10),
+                       {"winner": "scatter"})
+    assert _sliced_auto(res["backend"], 10, 8, 128) is False
+    methodbench.record(methodbench.sliding_key(res["backend"], 10),
+                       {"winner": "sliced"})
+    assert _sliced_auto(res["backend"], 10, 8, 128) is True
+    # unmeasured geometry: sliced by default where the plane fits...
+    assert _sliced_auto(res["backend"], 5, 8, 128) is True
+    # ...never where it cannot (S > W or plane too large)
+    assert _sliced_auto(res["backend"], 10, 8, 8) is False
+    assert _sliced_auto(res["backend"], 10, 1 << 22, 128) is False

@@ -62,6 +62,27 @@ def test_normalize_bench_reach_segments_and_contention():
     assert rows["reach_segment_queue_ms"] == "REGRESSED"
 
 
+def test_normalize_bench_sliding_ab_keys():
+    """ISSUE 12 regress keys: both sliding A/B arms' ev/s out of the
+    bench sliding_ab block, direction 'higher is better'."""
+    from streambench_tpu.obs.regress import DEFAULT_TOLERANCES
+
+    doc = {"sliding_ab": {"sliding_evps": 230_000.0,
+                          "sliding_sliced_evps": 510_000.0,
+                          "oracle": "exact"}}
+    n = normalize_bench(doc, path="s.json")
+    assert n["sliding_evps"] == 230_000.0
+    assert n["sliding_sliced_evps"] == 510_000.0
+    for key in ("sliding_evps", "sliding_sliced_evps"):
+        assert DEFAULT_TOLERANCES[key][0] == "higher", key
+    b = dict(n)
+    b["sliding_sliced_evps"] = 510_000.0 * 0.2   # collapse past 50% tol
+    res = compare(n, b)
+    rows = {r["metric"]: r["verdict"] for r in res["rows"]}
+    assert rows["sliding_sliced_evps"] == "REGRESSED"
+    assert rows["sliding_evps"] == "OK"
+
+
 def test_compare_directions_and_tolerances():
     a = normalize_bench(_bench_doc())
     # within every (generous) default tolerance

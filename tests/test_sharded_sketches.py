@@ -203,13 +203,18 @@ def test_sharded_session_cms_matches_single_device(dshape):
     assert np.array_equal(want_hist, np.asarray(hist))
 
 
-def test_sharded_session_scan_matches_step_sequence():
+@pytest.mark.parametrize("hoist", [False, True])
+def test_sharded_session_scan_matches_step_sequence(hoist):
+    """Both session scan arms — collectives-in-loop and the ISSUE 12
+    hoisted arm (collective-free body, stacked post-scan merges +
+    candidate-ring replay) — equal the per-batch step sequence bit for
+    bit, CMS table and ring included."""
     mesh, batches = _session_mesh_setup((4, 2), seed=9)
     U, M = 64, 256
     gap, late = 15_000, 20_000
 
     step_fn = _build_session_step(mesh, gap, late, U)
-    scan_fn = _build_session_scan(mesh, gap, late, U)
+    scan_fn = _build_session_scan(mesh, gap, late, U, hoist)
 
     from streambench_tpu.engine.sketches import LAT_BINS
 
@@ -497,9 +502,11 @@ def test_sharded_sliding_scan_matches_step_sequence():
                                rtol=0.12, atol=1.0)
 
 
-def test_sharded_sliding_engine_end_to_end(tmp_path):
-    """ShardedSlidingTDigestEngine through the real runner: window rows
-    and quantiles equal the single-device engine's on the same journal."""
+@pytest.mark.parametrize("sliced", ["off", "on"])
+def test_sharded_sliding_engine_end_to_end(tmp_path, sliced):
+    """ShardedSlidingTDigestEngine through the real runner, both folds:
+    window rows and quantiles equal the single-device engine's on the
+    same journal."""
     from streambench_tpu.engine.sketches import SlidingTDigestEngine
     from streambench_tpu.parallel import ShardedSlidingTDigestEngine
 
@@ -512,7 +519,9 @@ def test_sharded_sliding_engine_end_to_end(tmp_path):
         str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
 
     mesh = build_mesh(data=4, campaign=2)
-    eng = ShardedSlidingTDigestEngine(cfg, mapping, mesh, redis=r1)
+    eng = ShardedSlidingTDigestEngine(cfg, mapping, mesh, redis=r1,
+                                      sliced=sliced)
+    assert eng.sliced == (sliced == "on")
     stats = StreamRunner(eng, broker.reader(cfg.kafka_topic)).run_catchup()
     q1 = eng.quantiles()
     eng.close()
@@ -521,7 +530,7 @@ def test_sharded_sliding_engine_end_to_end(tmp_path):
     r2 = as_redis(FakeRedisStore())
     from streambench_tpu.io.redis_schema import seed_campaigns
     seed_campaigns(r2, gen.load_ids(str(tmp_path))[0])
-    ref = SlidingTDigestEngine(cfg, mapping, redis=r2)
+    ref = SlidingTDigestEngine(cfg, mapping, redis=r2, sliced=sliced)
     StreamRunner(ref, broker.reader(cfg.kafka_topic)).run_catchup()
     q2 = ref.quantiles()
     ref.close()
@@ -533,3 +542,112 @@ def test_sharded_sliding_engine_end_to_end(tmp_path):
     # plausibility are comparable here; bit-level equivalence is pinned
     # by the kernel tests above with a fixed now_rel
     assert q1.shape == q2.shape
+
+
+@pytest.mark.parametrize("hoist", [False, True])
+@pytest.mark.parametrize("sliced", [False, True])
+def test_sharded_sliding_scan_arms_match_single_device(hoist, sliced):
+    """ISSUE 12 sweep: every sharded sliding scan arm — legacy/sliced x
+    per-batch/hoisted collectives — reproduces the single-device fold's
+    counts plane, ring ids, watermark, and membership-granular dropped
+    bit for bit."""
+    from streambench_tpu.ops import sliding
+    from streambench_tpu.ops.windowcount import init_state
+    from streambench_tpu.parallel.sketches import _build_sliding_scan
+
+    mesh = build_mesh(data=4, campaign=2)
+    rng = np.random.default_rng(31)
+    C, W, B, Kb, S, TD = 96, 128, 64, 4, 10, 16
+    n_ads = C * 3
+    join = np.concatenate(
+        [rng.integers(0, C, n_ads).astype(np.int32), [-1]])
+    jt = jnp.asarray(join)
+    batches = rand_batches(rng, Kb, B, n_ads, 500, span_ms=60_000)
+
+    if sliced:
+        ref = sliding.init_sliced(C, W, S)
+        for ad, user, et, tm, valid in batches:
+            ref = sliding.step_sliced(ref, jt, ad, et, tm, valid,
+                                      size_ms=10_000, slide_ms=1_000,
+                                      lateness_ms=60_000)
+    else:
+        ref = init_state(C, W)
+        for ad, user, et, tm, valid in batches:
+            ref = sliding.step(ref, jt, ad, et, tm, valid,
+                               size_ms=10_000, slide_ms=1_000,
+                               lateness_ms=60_000)
+
+    counts0 = (jnp.zeros((C, S, W), jnp.int32) if sliced
+               else jnp.zeros((C, W), jnp.int32))
+    state0 = (counts0, jnp.full((W,), -1, jnp.int32), jnp.int32(0),
+              jnp.int32(0), jnp.zeros((C, TD), jnp.float32),
+              jnp.zeros((C, TD), jnp.float32))
+    fn = _build_sliding_scan(mesh, 10_000, 1_000, 60_000, 0, hoist,
+                             sliced)
+    cols = [np.stack([b[i] for b in batches]) for i in (0, 2, 3, 4)]
+    got = fn(*state0, jt, jnp.int32(400_000),
+             *(jnp.asarray(c) for c in cols))
+    np.testing.assert_array_equal(np.asarray(ref.counts),
+                                  np.asarray(got[0]))
+    np.testing.assert_array_equal(np.asarray(ref.window_ids),
+                                  np.asarray(got[1]))
+    assert int(ref.watermark) == int(got[2])
+    assert int(ref.dropped) == int(got[3])
+
+
+def test_sliding_and_session_collective_reports(tmp_path):
+    """The ISSUE 12 acceptance number from the compiled HLO: hoisted
+    sliding/session scans carry ZERO loop-body collectives and a small
+    per-dispatch count, where the per-batch arms pay K x per-batch."""
+    from streambench_tpu.parallel import (
+        ShardedSessionCMSEngine,
+        ShardedSlidingTDigestEngine,
+    )
+    from streambench_tpu.parallel.sketches import (
+        _build_session_scan,
+        _build_sliding_scan,
+    )
+    from streambench_tpu.parallel import collectives
+
+    cfg = default_config(jax_batch_size=64, jax_window_slots=128,
+                         jax_scan_batches=4)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(as_redis(FakeRedisStore()), cfg, broker=broker,
+                 events_num=500, rng=random.Random(3),
+                 workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    mesh = build_mesh(data=2, campaign=2)
+
+    eng = ShardedSlidingTDigestEngine(cfg, mapping, mesh,
+                                      redis=as_redis(FakeRedisStore()),
+                                      sliced="on")
+    rep = eng.collective_report(k=4)
+    assert rep["sliced"] is True
+    assert rep["scan"]["per_loop_iteration"]["ops"] == 0
+    # 4 gathered columns + 1 deferred drop psum
+    assert rep["scan"]["per_dispatch"]["ops"] == 5
+    # the per-batch arm pays K x (cols + 1)
+    perbatch = _build_sliding_scan(mesh, eng.size_ms, eng.slide_ms,
+                                   eng.base_lateness, 0, False, True)
+    B = cfg.jax_batch_size + eng._data_pad
+    zi = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+    rep_pb = collectives.report_for(
+        perbatch, *eng._carry(), eng.join_table, jnp.int32(0),
+        zi(4, B), zi(4, B), zi(4, B), jnp.zeros((4, B), bool),
+        scan_len=4)
+    assert rep_pb["per_dispatch"]["ops"] == 4 * 5
+
+    sess = ShardedSessionCMSEngine(cfg, mapping, mesh,
+                                   redis=as_redis(FakeRedisStore()),
+                                   user_capacity=1 << 10)
+    srep = sess.collective_report(k=4)
+    assert srep["scan"]["per_loop_iteration"]["ops"] == 0
+    assert srep["scan"]["per_dispatch"]["ops"] < 10
+    spb = _build_session_scan(mesh, sess.gap_ms, sess.lateness,
+                              sess.user_capacity, False)
+    rep_spb = collectives.report_for(
+        spb, *sess._carry(), jnp.int32(0), zi(4, 64), zi(4, 64),
+        zi(4, 64), jnp.zeros((4, 64), bool), scan_len=4)
+    assert (rep_spb["per_dispatch"]["ops"]
+            > 4 * srep["scan"]["per_dispatch"]["ops"])

@@ -1,6 +1,7 @@
 """Sliding + session window ops vs pure-Python reference models."""
 
 import numpy as np
+import pytest
 
 from streambench_tpu.ops import session, sliding
 from streambench_tpu.ops import windowcount as wc
@@ -97,6 +98,124 @@ def test_sliding_rejects_ring_smaller_than_memberships():
     with pytest.raises(ValueError, match="ring too small"):
         sliding.step(st, join, z, z, z, np.ones(4, bool),
                      size_ms=10_000, slide_ms=1_000)
+
+
+# ------------------------------------------------------- sliced fold
+def _flush_rows(deltas, wids, into):
+    deltas = np.asarray(deltas)
+    wids = np.asarray(wids)
+    for c, s in zip(*np.nonzero(deltas)):
+        if wids[s] >= 0:
+            key = (int(c), int(wids[s]))
+            into[key] = into.get(key, 0) + int(deltas[c, s])
+
+
+@pytest.mark.parametrize("seed,size_ms,slide_ms,lateness_ms",
+                         [(0, 10_000, 1_000, 20_000),
+                          (1, 8_000, 2_000, 9_000),
+                          (2, 16_000, 1_000, 31_000)])
+def test_sliced_vs_unrolled_flushed_rows(seed, size_ms, slide_ms,
+                                         lateness_ms):
+    """ISSUE 12 bit-identity sweep: the sliced fold's FLUSHED window
+    rows, membership-granular ``dropped``, and watermark equal the
+    unrolled per-k fold's across adversarial batches — late events
+    (within and beyond allowed lateness, so partially-late membership
+    drops fire), duplicate rows, invalid rows, non-view types, join
+    misses, and pre-origin (wid < 0) events — under a realistic flush
+    cadence.  Ring sized for the span-guard regime (the documented
+    equivalence domain — the engine's span guard enforces it live)."""
+    rng = np.random.default_rng(seed)
+    S = size_ms // slide_ms
+    late_eff = sliding.effective_lateness(size_ms, slide_ms, lateness_ms)
+    C, B = 5, 192
+    W = late_eff // slide_ms + 3 * S + 8
+    n_ads = 15
+    join = np.concatenate(
+        [rng.integers(0, C, n_ads).astype(np.int32), [-1]])
+    st_l = wc.init_state(C, W)
+    st_s = sliding.init_sliced(C, W, S)
+    rows_l: dict = {}
+    rows_s: dict = {}
+    t0 = 4 * size_ms
+
+    def drain():
+        nonlocal st_l, st_s
+        dl, wl, st_l = wc.flush_deltas(st_l, divisor_ms=slide_ms,
+                                       lateness_ms=late_eff)
+        _flush_rows(dl, wl, rows_l)
+        ds, ws, st_s = sliding.flush_sliced(st_s, size_ms=size_ms,
+                                            slide_ms=slide_ms,
+                                            lateness_ms=lateness_ms)
+        _flush_rows(ds, ws, rows_s)
+
+    for it in range(12):
+        ad = rng.integers(0, n_ads + 1, B).astype(np.int32)
+        et = rng.integers(0, 3, B).astype(np.int32)
+        # spread: on-time, late-but-allowed, beyond-lateness, and a few
+        # pre-origin stragglers
+        tm = (t0 + rng.integers(-(lateness_ms + 2 * size_ms),
+                                size_ms, B)).astype(np.int32)
+        tm[rng.random(B) < 0.02] = rng.integers(0, slide_ms)
+        tm = np.maximum(tm, 0)
+        # duplicates: repeat a slice of the batch verbatim
+        tm[B // 2:B // 2 + 8] = tm[:8]
+        ad[B // 2:B // 2 + 8] = ad[:8]
+        et[B // 2:B // 2 + 8] = et[:8]
+        valid = rng.random(B) < 0.9
+        st_l = sliding.step(st_l, join, ad, et, tm, valid,
+                            size_ms=size_ms, slide_ms=slide_ms,
+                            lateness_ms=lateness_ms)
+        st_s = sliding.step_sliced(st_s, join, ad, et, tm, valid,
+                                   size_ms=size_ms, slide_ms=slide_ms,
+                                   lateness_ms=lateness_ms)
+        t0 += size_ms // 2
+        if it % 3 == 2:
+            drain()
+    drain()
+    assert int(st_l.dropped) > 0, "sweep never exercised membership drops"
+    assert int(st_l.watermark) == int(st_s.watermark)
+    assert int(st_l.dropped) == int(st_s.dropped)
+    assert rows_l == rows_s
+
+
+def test_sliced_rejects_bad_geometry():
+    join = np.array([0, -1], np.int32)
+    z = np.zeros(4, np.int32)
+    st = sliding.init_sliced(2, 8, 10)   # 8 slots < 10 memberships
+    with pytest.raises(ValueError, match="ring too small"):
+        sliding.step_sliced(st, join, z, z, z, np.ones(4, bool),
+                            size_ms=10_000, slide_ms=1_000)
+    st = sliding.init_sliced(2, 64, 5)   # plane carries wrong S
+    with pytest.raises(ValueError, match="lateness classes"):
+        sliding.step_sliced(st, join, z, z, z, np.ones(4, bool),
+                            size_ms=10_000, slide_ms=1_000)
+
+
+def test_sliced_flush_frees_closed_buckets():
+    """A bucket slot frees exactly when the LAST window containing it
+    closes (same ``_still_open`` rule as the legacy ring under the
+    effective lateness), and a freed window reconstructs to zero —
+    never re-emitted — on later drains."""
+    size, slide, late = 10_000, 1_000, 20_000
+    late_eff = sliding.effective_lateness(size, slide, late)
+    C, W, S = 2, 96, 10
+    join = np.array([0, 1, -1], np.int32)
+    st = sliding.init_sliced(C, W, S)
+    tm = np.array([70_000, 70_000 + late_eff + 1_500], np.int32)
+    st = sliding.step_sliced(st, join, np.array([0, 1], np.int32),
+                             np.zeros(2, np.int32), tm, np.ones(2, bool),
+                             size_ms=size, slide_ms=slide,
+                             lateness_ms=late)
+    deltas, wids, st2 = sliding.flush_sliced(st, size_ms=size,
+                                             slide_ms=slide,
+                                             lateness_ms=late)
+    # the first event's bucket (id 70) closed: its slot is freed
+    w2 = np.asarray(st2.window_ids)
+    assert (w2[np.asarray(st.window_ids) == 70] == -1).all()
+    # a second drain with nothing new emits nothing
+    d2, w2ids, _ = sliding.flush_sliced(st2, size_ms=size, slide_ms=slide,
+                                        lateness_ms=late)
+    assert int(np.asarray(d2).sum()) == 0
 
 
 def test_sliding_flush_uses_effective_lateness():
