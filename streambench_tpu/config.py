@@ -121,6 +121,26 @@ class BenchmarkConfig:
     # "auto" enables it only where the measured A/B says the device arm
     # wins (bench.py records it; accelerator backends default on).
     jax_decode_device: str = "off"
+    # --- production-cardinality sketch memory (ops.salsa / ops.cms;
+    # ISSUE 13) ---
+    # "fixed" (default) keeps the [D, Wd] int32 count-min plane
+    # byte-identical; "salsa" swaps in the SALSA merge-on-overflow
+    # sketch — uint8 cells + packed merge bitmaps, ~1.09 bytes/cell vs
+    # 4, counters widen to 16/32 bits only where traffic lands; "auto"
+    # follows the measured cms-family winner (ops.methodbench,
+    # backend/cms/W<Wd>) where one exists, else stays fixed.
+    jax_cms_mode: str = "fixed"
+    # SALSA starting counter width: 8 (default; pairs/quads form on
+    # overflow) or 16 (every pair pre-merged — fewer settles on
+    # heavy-uniform streams at 2x the bytes/cell).
+    jax_cms_cell_bits: int = 8
+    # 1 (default) = single-stage; 2 = SF-style two-stage: a small
+    # query-side sketch (width Wd/8) refreshed with post-update fat
+    # estimates — heavy-hitter queries gather from the small plane;
+    # the fat stage keeps update linearity for sharded psum merges
+    # (single-device engines only; the sharded session engine refuses
+    # stages=2 because small-stage maxima do not merge soundly).
+    jax_cms_stages: int = 1
     # --- sliced sliding windows (ops.sliding; ISSUE 12) ---
     # "off" keeps the unrolled per-k sliding fold (S ring-claim passes
     # per batch); "on" forces the sliced fold — one claim + one scatter
@@ -330,6 +350,21 @@ class BenchmarkConfig:
             raise ConfigError(
                 f"config key 'jax.sliding.sliced' must be one of "
                 f"off/on/auto: {sliced_mode!r}")
+        cms_mode = gets("jax.cms.mode", "fixed").strip().lower()
+        if cms_mode not in ("fixed", "salsa", "auto"):
+            raise ConfigError(
+                f"config key 'jax.cms.mode' must be one of "
+                f"fixed/salsa/auto: {cms_mode!r}")
+        cms_bits = geti("jax.cms.cell.bits", 8)
+        if cms_bits not in (8, 16):
+            raise ConfigError(
+                f"config key 'jax.cms.cell.bits' must be 8 or 16: "
+                f"{cms_bits!r}")
+        cms_stages = geti("jax.cms.stages", 1)
+        if cms_stages not in (1, 2):
+            raise ConfigError(
+                f"config key 'jax.cms.stages' must be 1 or 2: "
+                f"{cms_stages!r}")
         mesh_shape = conf.get("jax.mesh.shape", (1,))
         mesh_axes = conf.get("jax.mesh.axes", ("data",))
         try:
@@ -379,6 +414,9 @@ class BenchmarkConfig:
             jax_ingest_batch_queue=max(geti("jax.ingest.batch.queue", 4), 1),
             jax_use_native_encoder=getb("jax.use.native.encoder", True),
             jax_decode_device=decode_mode,
+            jax_cms_mode=cms_mode,
+            jax_cms_cell_bits=cms_bits,
+            jax_cms_stages=cms_stages,
             jax_sliding_sliced=sliced_mode,
             jax_sink_exactly_once=getb("jax.sink.exactly_once", False),
             jax_sink_retry_base_ms=geti("jax.sink.retry.base.ms", 100),

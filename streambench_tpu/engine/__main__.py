@@ -52,14 +52,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the mesh-sharded engine (jax.mesh.* config)")
     p.add_argument("--engine", default="exact",
                    choices=("exact", "hll", "sliding", "session",
-                            "reach"),
+                            "reach", "hllx"),
                    help="aggregation engine: exact window counts "
                         "(default), HLL distinct users, sliding-window + "
                         "t-digest quantiles, session windows + "
                         "count-min heavy hitters (BASELINE configs "
-                        "#1-#4), or cumulative MinHash∪HLL reach "
+                        "#1-#4), cumulative MinHash∪HLL reach "
                         "sketches served live over pub/sub (README "
-                        "\"Reach serving\")")
+                        "\"Reach serving\"), or the hyper-extended HLL "
+                        "ladder answering distinct-count AND "
+                        "frequency-moment queries from one register "
+                        "plane (README \"Sketch memory\")")
     p.add_argument("--checkpointDir", default=None,
                    help="enable (offset, state) snapshots here; on start, "
                         "resume from the newest one if present")
@@ -103,11 +106,11 @@ def main(argv: list[str] | None = None) -> int:
         redis = RespClient(cfg.redis_host, cfg.redis_port)
 
     if args.microbatch:
-        if args.engine in ("sliding", "session", "reach"):
+        if args.engine in ("sliding", "session", "reach", "hllx"):
             raise SystemExit(
                 f"--microbatch has no count-window form of --engine "
                 f"{args.engine} (sliding needs a time axis, session a gap "
-                f"axis, reach is cumulative); supported: exact, hll")
+                f"axis, reach/hllx are cumulative); supported: exact, hll")
         from streambench_tpu.engine.microbatch import run_microbatch
 
         broker = make_broker(cfg.kafka_bootstrap_servers,
@@ -148,6 +151,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.engine != "exact":
             from streambench_tpu.engine.sketches import (
                 HLLDistinctEngine,
+                HLLXEngine,
                 ReachSketchEngine,
                 SessionCMSEngine,
                 SlidingTDigestEngine,
@@ -155,7 +159,8 @@ def main(argv: list[str] | None = None) -> int:
             cls = {"hll": HLLDistinctEngine,
                    "sliding": SlidingTDigestEngine,
                    "session": SessionCMSEngine,
-                   "reach": ReachSketchEngine}[args.engine]
+                   "reach": ReachSketchEngine,
+                   "hllx": HLLXEngine}[args.engine]
             return cls(cfg, mapping, campaigns=campaigns, redis=r)
         return AdAnalyticsEngine(cfg, mapping, campaigns=campaigns, redis=r)
 

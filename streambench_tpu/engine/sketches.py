@@ -29,7 +29,8 @@ import numpy as np
 from streambench_tpu.config import BenchmarkConfig
 from streambench_tpu.engine.pipeline import AdAnalyticsEngine
 from streambench_tpu.io.redis_schema import RedisLike
-from streambench_tpu.ops import cms, hll, minhash, session, sliding, tdigest
+from streambench_tpu.ops import (cms, hll, hllx, minhash, salsa, session,
+                                 sliding, tdigest)
 from streambench_tpu.ops import windowcount as wc
 from streambench_tpu.utils.ids import now_ms
 
@@ -390,6 +391,158 @@ class ReachSketchEngine(_SketchEngineBase):
     @property
     def dropped(self) -> int:
         return int(self.state.dropped)
+
+
+class HLLXEngine(_SketchEngineBase):
+    """Distinct count AND frequency moments from one register plane:
+    the hyper-extended HLL ladder (``ops/hllx.py``, ``--engine hllx``,
+    ISSUE 13 / ROADMAP item 2).
+
+    Cumulative per campaign like the reach engine — no window ring,
+    nothing ever drops; ``flush()`` writes no canonical rows and
+    ``close()`` writes ``<redis.hashtable>_hllx`` fields per campaign:
+    ``<name>:distinct`` (rung-0 HLL), ``<name>:logm`` (the calibrated
+    log-count moment), ``<name>:views`` (exact F1), and
+    ``<name>:cap<T>`` soft-capped counts for each ladder rung.  All of
+    it from a single scatter-max per batch — zero ingest cost over the
+    plain distinct engine beyond the G-fold register axis.
+    """
+
+    ENGINE_FAMILY = "hllx"
+    # Identity is consumed through hashes only — same rationale as the
+    # HLL/reach engines: stateless crc32 ids, parallel encode pool
+    # sound, no intern tables in snapshots.
+    HASHED_IDS = True
+    NEEDS_INTERNED_IDS = False
+    PARALLEL_ENCODE_OK = True
+    SCAN_SUPPORTED = True
+    SCAN_COLUMNS = ("ad_idx", "user_idx", "event_type", "event_time",
+                    "valid")
+    PACKED_EXTRA_COLS = ("user_idx",)
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 groups: int = 8, registers: int = 128,
+                 input_format: str = "json"):
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, input_format=input_format)
+        self.groups = int(groups)
+        self.registers = int(registers)
+        self.state = hllx.init_state(self.encoder.num_campaigns,
+                                     self.groups, self.registers)
+        # cumulative state has no ring to overrun: disable the span
+        # guard (the session/reach rule) so catchup chunks never fall
+        # back to the per-batch fold for nothing
+        self._span_guard = 2**31 - 1
+
+    def _device_step(self, batch) -> None:
+        self.state = hllx.step(
+            self.state, self.join_table,
+            jnp.asarray(batch.ad_idx), jnp.asarray(batch.user_idx),
+            jnp.asarray(batch.event_type), jnp.asarray(batch.event_time),
+            jnp.asarray(batch.valid))
+
+    def _device_scan(self, ad_idx, user_idx, event_type, event_time,
+                     valid) -> None:
+        self.state = hllx.scan_steps(
+            self.state, self.join_table, ad_idx, user_idx, event_type,
+            event_time, valid)
+
+    def _device_scan_packed(self, packed, user_idx, event_time) -> None:
+        self.state = hllx.scan_steps_packed(
+            self.state, self.join_table, packed, user_idx, event_time)
+
+    def warmup(self) -> None:
+        """Base warmup + the close-time moments program (the reach-
+        engine rule: a read-only estimator compiling after
+        ``mark_steady`` reads as a mid-run stall)."""
+        super().warmup()
+        jax.block_until_ready(hllx.moments(self.state)["distinct"])
+
+    # -- harness hooks -------------------------------------------------
+    def _drain_device(self) -> None:
+        self._span_start = None   # cumulative: nothing to drain
+
+    def flush(self, time_updated: int | None = None, *,
+              final: bool = False) -> int:
+        return 0   # no canonical window rows
+
+    def moments(self) -> dict:
+        """Host copies of every ladder answer ([C] / [C, G] arrays)."""
+        return {k: np.asarray(v)
+                for k, v in hllx.moments(self.state).items()}
+
+    def snapshot(self, offset: int):
+        from streambench_tpu.checkpoint import Snapshot
+
+        self._snapshot_sync()
+        meta = self._snapshot_meta()
+        meta.update(hllx_groups=self.groups,
+                    num_registers=self.registers)
+        return self._xo_decorate(Snapshot(
+            offset=offset, meta=meta,
+            counts=np.zeros((0, 0), np.int32),
+            window_ids=np.zeros((0,), np.int32),   # no window ring
+            watermark=int(self.state.watermark),
+            dropped=int(self.state.dropped),
+            extra={"hllx_registers": np.asarray(self.state.registers),
+                   "hllx_totals": np.asarray(self.state.totals),
+                   **self._intern_extra()},
+        ))
+
+    def restore(self, snap) -> None:
+        self._check_geometry(snap, extra=dict(
+            hllx_groups=self.groups, num_registers=self.registers))
+        self.state = hllx.HLLXState(
+            registers=jnp.asarray(snap.extra["hllx_registers"]),
+            totals=jnp.asarray(snap.extra["hllx_totals"]),
+            watermark=jnp.int32(snap.watermark),
+            dropped=jnp.int32(snap.dropped))
+        self._restore_interns(snap)
+        self._restore_host(snap)
+
+    def close(self) -> None:
+        if self.redis is None or not self.cfg.redis_hashtable:
+            return
+        m = self.moments()
+        table = f"{self.cfg.redis_hashtable}_hllx"
+        caps = [1 << g for g in range(self.groups)]
+        cmds = []
+        for c, name in enumerate(self.encoder.campaigns):
+            if m["totals"][c] <= 0:
+                continue
+            cmds.append(("HSET", table, f"{name}:distinct",
+                         str(int(round(float(m["distinct"][c]))))))
+            cmds.append(("HSET", table, f"{name}:logm",
+                         f"{float(m['log_moment'][c]):.1f}"))
+            cmds.append(("HSET", table, f"{name}:views",
+                         str(int(m["totals"][c]))))
+            for g, t in enumerate(caps):
+                cmds.append(("HSET", table, f"{name}:cap{t}",
+                             f"{float(m['softcap'][c, g]):.1f}"))
+        if cmds:
+            self.redis.pipeline_execute(cmds)
+
+    @property
+    def dropped(self) -> int:
+        return int(self.state.dropped)
+
+
+def _cms_auto(backend: str, width: int) -> str:
+    """Resolve ``jax.cms.mode=auto``: the SALSA plane where the
+    measured cms-family winner (``ops.methodbench``, keyed
+    backend/cms/W<Wd>) says its update is the fastest arm; fixed
+    otherwise — auto picks by SPEED, memory-motivated deployments set
+    mode=salsa explicitly (the memory win is unconditional, the update
+    cost is the backend-dependent part)."""
+    try:
+        from streambench_tpu.ops import methodbench
+
+        winner = methodbench.cms_winner(backend, width)
+    except Exception:
+        winner = None
+    return "salsa" if winner == "salsa" else "fixed"
 
 
 def _sliced_auto(backend: str, S: int, C: int, W: int) -> bool:
@@ -815,7 +968,10 @@ def _session_cms_scan(sess_state, cms_state, topk_state, closed_n,
     """
 
     def absorb(cm, ck_acc, closed):
-        cm = cms.update(cm, closed.user, closed.clicks, closed.valid)
+        # family-dispatching update/query (ISSUE 13): the fixed path
+        # lowers to the exact pre-existing program; salsa/two-stage
+        # trace their own variants off the state's pytree type
+        cm = cms.sk_update(cm, closed.user, closed.clicks, closed.valid)
         cn = jnp.sum(closed.valid.astype(jnp.int32))
         ck = jnp.sum(jnp.where(closed.valid, closed.clicks, 0))
         return cm, (ck_acc[0] + cn, ck_acc[1] + ck)
@@ -834,7 +990,7 @@ def _session_cms_scan(sess_state, cms_state, topk_state, closed_n,
             hist = _hist_scalar(hist, det_lat, closed.valid)
             ckeys, cests = cms.fold_candidates(
                 ckeys, cests, closed.user,
-                cms.query(cm, closed.user), closed.valid, salt)
+                cms.point_query(cm, closed.user), closed.valid, salt)
         return (st, cm, ck_acc, hist, ckeys, cests), None
 
     M2 = 1 << (4 * topk_state.keys.shape[0] - 1).bit_length()
@@ -863,6 +1019,9 @@ class SessionCMSEngine(_SketchEngineBase):
                  gap_ms: int = 30_000, user_capacity: int = 1 << 16,
                  cms_depth: int = 4, cms_width: int = 2048,
                  top_k: int = 16, candidate_capacity: int | None = None,
+                 cms_mode: str | None = None,
+                 cms_stages: int | None = None,
+                 cms_cell_bits: int | None = None,
                  input_format: str = "json"):
         # The heavy-hitter report needs user-id NAMES: the native
         # encoder serves them through its intern-table dump
@@ -874,7 +1033,38 @@ class SessionCMSEngine(_SketchEngineBase):
         self.user_capacity = user_capacity
         self.top_k = top_k
         self.state = session.init_state(user_capacity)
-        self.cms = cms.init_state(depth=cms_depth, width=cms_width)
+        # Sketch family (ISSUE 13; jax.cms.{mode,cell.bits,stages}):
+        # "fixed" keeps the int32 plane byte-identical; "salsa" swaps
+        # in the merge-on-overflow uint8 plane; stages=2 adds the
+        # SF-style small query stage.  "auto" follows the measured
+        # cms-family methodbench winner where one exists.
+        mode = str(cms_mode if cms_mode is not None
+                   else getattr(cfg, "jax_cms_mode", "fixed")
+                   ).strip().lower()
+        if mode not in ("fixed", "salsa", "auto"):
+            raise ValueError(f"cms_mode must be fixed/salsa/auto: {mode!r}")
+        stages = int(cms_stages if cms_stages is not None
+                     else getattr(cfg, "jax_cms_stages", 1))
+        bits = int(cms_cell_bits if cms_cell_bits is not None
+                   else getattr(cfg, "jax_cms_cell_bits", 8))
+        if mode == "auto":
+            mode = _cms_auto(jax.default_backend(), cms_width)
+        if mode == "salsa" and stages == 2:
+            raise ValueError(
+                "jax.cms.mode=salsa does not compose with "
+                "jax.cms.stages=2: the SF small stage refreshes from "
+                "fat-stage estimates, pick one counter design")
+        self.cms_mode = mode
+        self.cms_stages = stages
+        self.cms_cell_bits = bits
+        if mode == "salsa":
+            self.cms = salsa.init_state(depth=cms_depth, width=cms_width,
+                                        cell_bits=bits)
+        elif stages == 2:
+            self.cms = cms.init_two_stage(depth=cms_depth,
+                                          width=cms_width)
+        else:
+            self.cms = cms.init_state(depth=cms_depth, width=cms_width)
         # Device-side heavy-hitter candidate ring: report cost is O(ring),
         # NOT O(interned users) — at config #4 scale (1e5+ users) a
         # full-universe query per report defeats the sketch's
@@ -929,17 +1119,36 @@ class SessionCMSEngine(_SketchEngineBase):
             user_idx, event_type, event_time, valid,
             gap_ms=self.gap_ms, lateness_ms=self.lateness)
 
+    def _cms_shape(self) -> tuple[int, int]:
+        """[D, Wd] of the primary counter plane, any family."""
+        t = (self.cms.fat.table if isinstance(self.cms, cms.CMS2State)
+             else self.cms.table)
+        return int(t.shape[0]), int(t.shape[1])
+
     def snapshot(self, offset: int):
         from streambench_tpu.checkpoint import Snapshot
 
         self._snapshot_sync()
         meta = self._snapshot_meta()
+        depth, width = self._cms_shape()
         meta.update(gap_ms=self.gap_ms, user_capacity=self.user_capacity,
-                    cms_depth=int(self.cms.table.shape[0]),
-                    cms_width=int(self.cms.table.shape[1]),
-                    cms_total=int(self.cms.total),
+                    cms_depth=depth, cms_width=width,
+                    cms_total=int(cms.sk_total(self.cms)),
+                    cms_mode=self.cms_mode,
+                    cms_stages=self.cms_stages,
                     sessions_closed=self.sessions_closed,
                     session_clicks=self.session_clicks)
+        # family state rides extras: the fixed int32 table, the SALSA
+        # uint8 plane + its merge bitmaps, or fat + small stages
+        if self.cms_mode == "salsa":
+            sketch = {"cms_table": np.asarray(self.cms.table),
+                      "cms_m1": np.asarray(self.cms.m1),
+                      "cms_m2": np.asarray(self.cms.m2)}
+        elif self.cms_stages == 2:
+            sketch = {"cms_table": np.asarray(self.cms.fat.table),
+                      "cms_small": np.asarray(self.cms.small)}
+        else:
+            sketch = {"cms_table": np.asarray(self.cms.table)}
         return self._xo_decorate(Snapshot(
             offset=offset, meta=meta,
             counts=np.zeros((0, 0), np.int32),
@@ -949,7 +1158,7 @@ class SessionCMSEngine(_SketchEngineBase):
             extra={"sess_last": np.asarray(self.state.last_time),
                    "sess_start": np.asarray(self.state.sess_start),
                    "sess_clicks": np.asarray(self.state.clicks),
-                   "cms_table": np.asarray(self.cms.table),
+                   **sketch,
                    "hh_keys": np.asarray(self.topk.keys),
                    "hh_ests": np.asarray(self.topk.ests),
                    "lat_hist": np.asarray(self.lat_hist),
@@ -957,19 +1166,42 @@ class SessionCMSEngine(_SketchEngineBase):
         ))
 
     def restore(self, snap) -> None:
+        depth, width = self._cms_shape()
         self._check_geometry(snap, extra=dict(
             gap_ms=self.gap_ms, user_capacity=self.user_capacity,
-            cms_depth=int(self.cms.table.shape[0]),
-            cms_width=int(self.cms.table.shape[1])))
+            cms_depth=depth, cms_width=width,
+            cms_stages=self.cms_stages))
+        # mode is a string — checked here, not via the int-comparing
+        # _check_geometry extra dict (legacy snapshots predate the key
+        # and are implicitly "fixed")
+        snap_mode = str(snap.meta.get("cms_mode", "fixed"))
+        if snap_mode != self.cms_mode:
+            raise ValueError(
+                f"checkpoint cms_mode={snap_mode!r} != engine "
+                f"{self.cms_mode!r}; restart with the original "
+                "jax.cms.mode or discard the checkpoint")
         self.state = session.SessionState(
             last_time=jnp.asarray(snap.extra["sess_last"]),
             sess_start=jnp.asarray(snap.extra["sess_start"]),
             clicks=jnp.asarray(snap.extra["sess_clicks"]),
             watermark=jnp.int32(snap.watermark),
             dropped=jnp.int32(snap.dropped))
-        self.cms = cms.CMSState(
-            table=jnp.asarray(snap.extra["cms_table"]),
-            total=jnp.int32(snap.meta["cms_total"]))
+        if self.cms_mode == "salsa":
+            self.cms = salsa.SalsaState(
+                table=jnp.asarray(snap.extra["cms_table"]),
+                m1=jnp.asarray(snap.extra["cms_m1"]),
+                m2=jnp.asarray(snap.extra["cms_m2"]),
+                total=jnp.int32(snap.meta["cms_total"]))
+        elif self.cms_stages == 2:
+            self.cms = cms.CMS2State(
+                fat=cms.CMSState(
+                    table=jnp.asarray(snap.extra["cms_table"]),
+                    total=jnp.int32(snap.meta["cms_total"])),
+                small=jnp.asarray(snap.extra["cms_small"]))
+        else:
+            self.cms = cms.CMSState(
+                table=jnp.asarray(snap.extra["cms_table"]),
+                total=jnp.int32(snap.meta["cms_total"]))
         self.sessions_closed = int(snap.meta["sessions_closed"])
         self.session_clicks = int(snap.meta["session_clicks"])
         self.lat_hist = (jnp.asarray(snap.extra["lat_hist"])
@@ -1001,8 +1233,8 @@ class SessionCMSEngine(_SketchEngineBase):
                                         jnp.asarray(mask))
 
     def _absorb(self, closed: session.ClosedSessions) -> None:
-        self.cms = cms.update(self.cms, closed.user, closed.clicks,
-                              closed.valid)
+        self.cms = cms.sk_update(self.cms, closed.user, closed.clicks,
+                                 closed.valid)
         self.topk = cms.update_topk(self.cms, self.topk, closed.user,
                                     closed.valid)
         # device-scalar counters: no host sync on the hot path
@@ -1103,6 +1335,22 @@ class SessionCMSEngine(_SketchEngineBase):
             force=True)
         self._absorb(final)
         self._write_heavy_hitters()
+
+    def sketch_summary(self, merges: bool = False) -> dict:
+        """Sketch-memory census for the stats line / obs report rows
+        (ISSUE 13): family + measured state bytes (host-side ``nbytes``
+        reads — no device sync, safe at sampler cadence).  With
+        ``merges=True`` (close-time/bench callers only) the SALSA
+        bitmap planes are pulled and the widened-counter counts added —
+        that read blocks on in-flight dispatches, keep it off the
+        per-tick path."""
+        from streambench_tpu.obs.devmem import state_nbytes
+
+        out = {"mode": self.cms_mode, "stages": self.cms_stages,
+               "state_bytes": state_nbytes(self.cms)}
+        if merges and self.cms_mode == "salsa":
+            out.update(salsa.stats(self.cms))
+        return out
 
     @property
     def dropped(self) -> int:

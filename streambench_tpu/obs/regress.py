@@ -78,6 +78,16 @@ DEFAULT_TOLERANCES: dict = {
     # DOWN; generous like every timing row on the 1-core host
     "sliding_evps": ("higher", 0.5),
     "sliding_sliced_evps": ("higher", 0.5),
+    # sketch memory (ISSUE 13): bytes of device state per distinct key
+    # at the top cardinality rung regress UP (the whole point of the
+    # SALSA plane is fewer of them), as does the p99 point-query count
+    # error at matched device-memory budget.  bytes/key is
+    # near-deterministic for a fixed geometry (tight tolerance); the
+    # error row is statistical across the rung's hash draw (looser).
+    "sketch_bytes_per_key": ("lower", 0.1),
+    "sketch_p99_err": ("lower", 0.5),
+    "sketch_salsa_evps": ("higher", 0.5),
+    "sketch_fixed_evps": ("higher", 0.5),
 }
 
 
@@ -139,6 +149,14 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
     if isinstance(sab, dict):
         out["sliding_evps"] = _num(sab.get("sliding_evps"))
         out["sliding_sliced_evps"] = _num(sab.get("sliding_sliced_evps"))
+    # sketch-memory block (bench_sketch.py artifact, ISSUE 13): the
+    # headline rung's bytes/key + p99 error + per-arm fold throughput
+    sketch = doc.get("sketch")
+    if isinstance(sketch, dict):
+        out["sketch_bytes_per_key"] = _num(sketch.get("bytes_per_key"))
+        out["sketch_p99_err"] = _num(sketch.get("p99_err"))
+        out["sketch_salsa_evps"] = _num(sketch.get("salsa_evps"))
+        out["sketch_fixed_evps"] = _num(sketch.get("fixed_evps"))
     # reach serving block (bench_reach.py artifact / engine stats line)
     reach = doc.get("reach")
     if isinstance(reach, dict):

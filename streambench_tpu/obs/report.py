@@ -101,6 +101,9 @@ def summarize(records: list[dict], path: str = "") -> dict:
         "xfer": last_block("xfer"),
         "devmem": last_block("devmem"),
         "shard_skew": last_block("shard_skew"),
+        # sketch-memory census (ISSUE 13): counter-plane family + state
+        # bytes, journaled by engines exposing sketch_summary()
+        "sketch": last_block("sketch"),
         # serving-tier obs (layer 5, jax.obs.query): newest per-query
         # attribution block the reach collector journals
         "reach_query": last_block("reach_query"),
@@ -195,6 +198,16 @@ def render_report(s: dict) -> str:
         lines.append(f"    rows {sk.get('rows')}  dropped "
                      f"{sk.get('dropped')}  imbalance "
                      f"{_fmt(sk.get('imbalance_ratio'))}")
+    sm = s.get("sketch")
+    if sm:
+        lines.append("  sketch memory (counter plane, measured):")
+        lines.append(f"    mode {sm.get('mode')}  stages "
+                     f"{sm.get('stages')}  state bytes "
+                     f"{_fmt(sm.get('state_bytes'))}")
+        if sm.get("merged_pairs") is not None:
+            lines.append(f"    merged pairs {_fmt(sm.get('merged_pairs'))}"
+                         f"  quads {_fmt(sm.get('merged_quads'))} of "
+                         f"{_fmt(sm.get('cells'))} cells")
     rqo = (s.get("reach_query") or {}).get("query_obs")
     if rqo:
         lines.append("  reach query attribution (submit -> reply):")
@@ -473,6 +486,13 @@ def render_diff(a: dict, b: dict) -> str:
     if da or db:
         emit("devmem peak bytes", da.get("peak_footprint_bytes"),
              db.get("peak_footprint_bytes"))
+    ska = a.get("sketch") or {}
+    skb = b.get("sketch") or {}
+    if ska or skb:
+        emit("sketch state bytes", ska.get("state_bytes"),
+             skb.get("state_bytes"))
+        emit("sketch merged pairs", ska.get("merged_pairs"),
+             skb.get("merged_pairs"))
     qa = (a.get("reach_query") or {}).get("query_obs") or {}
     qb = (b.get("reach_query") or {}).get("query_obs") or {}
     if qa or qb:

@@ -67,6 +67,24 @@ def update(state: CMSState, keys: jax.Array, weights: jax.Array,
 
 
 @jax.jit
+def update_rowloop(state: CMSState, keys: jax.Array, weights: jax.Array,
+                   mask: jax.Array) -> CMSState:
+    """``update`` as D per-row scatter-adds instead of one flat scatter
+    over the [D*Wd] plane — bit-identical; exists so the cms-family
+    methodbench can measure which landing the backend prefers (XLA's
+    flat scatter wins where row-concatenated indices fuse, the row loop
+    where narrower scatters schedule better)."""
+    D, Wd = state.table.shape
+    cols = _row_cols(keys, D, Wd)
+    w = jnp.where(mask, weights, 0).astype(jnp.int32)
+    table = state.table
+    for d in range(D):
+        c = jnp.where(mask, cols[d], Wd)
+        table = table.at[d].set(table[d].at[c].add(w, mode="drop"))
+    return CMSState(table, state.total + jnp.sum(w))
+
+
+@jax.jit
 def query(state: CMSState, keys: jax.Array) -> jax.Array:
     """Point estimates (upper bounds) for ``keys``: min over rows."""
     D, Wd = state.table.shape
@@ -76,20 +94,142 @@ def query(state: CMSState, keys: jax.Array) -> jax.Array:
 
 
 def merge(a: CMSState, b: CMSState) -> CMSState:
-    """Sketch union: elementwise add (dimensions must match)."""
+    """Sketch union: elementwise add.  Geometry is validated up front —
+    a [D, Wd] mismatch used to broadcast into garbage (or die with a
+    cryptic XLA shape error deep in the add); now it names both
+    shapes."""
+    if a.table.shape != b.table.shape or a.table.dtype != b.table.dtype:
+        raise ValueError(
+            f"cms.merge: geometry mismatch — a.table "
+            f"{a.table.shape}/{a.table.dtype} vs b.table "
+            f"{b.table.shape}/{b.table.dtype}")
     return CMSState(a.table + b.table, a.total + b.total)
 
 
+# ----------------------------------------------------------------------
+# SF-style two-stage sketch (ISSUE 13 / arXiv:1701.04148): a small
+# query-side stage next to the fat update-side stage.
+# ----------------------------------------------------------------------
+
+class CMS2State(NamedTuple):
+    """Two-stage count-min: ``fat`` is the ordinary update-linear
+    [D, Wd] sketch (sharded merges psum IT — counter add stays linear);
+    ``small [D, Ws]`` is the query-side stage, updated only when the
+    fat stage's estimate for the touched key increases (a scatter-max
+    of the post-update fat estimate).  Queries gather from the small
+    plane — ~Wd/Ws fewer bytes per gather for the heavy-hitter paths
+    (``fold_candidates``/``update_topk``) — and stay upper bounds: a
+    key's true count is frozen at its last update, and the small cell
+    only grows from estimates taken at update time.
+
+    The small stage does NOT merge across shards (max of two shards'
+    estimates can undercut the summed true count): ``merge`` on this
+    state raises, and the sharded session engine refuses stages=2 —
+    the fat stage is the distributed-merge surface, per the SF-sketch
+    split."""
+
+    fat: CMSState
+    small: jax.Array   # [D, Ws] int32
+
+
+def init_two_stage(depth: int = 4, width: int = 2048,
+                   small_width: int | None = None) -> CMS2State:
+    sw = small_width if small_width is not None else max(width // 8, 64)
+    if sw & (sw - 1):
+        raise ValueError("small_width must be a power of two")
+    return CMS2State(fat=init_state(depth, width),
+                     small=jnp.zeros((depth, sw), jnp.int32))
+
+
+@jax.jit
+def update2(state: CMS2State, keys: jax.Array, weights: jax.Array,
+            mask: jax.Array) -> CMS2State:
+    """Fat scatter-add, then refresh the small stage with the keys' NEW
+    fat estimates (scatter-max, masked rows dropped)."""
+    fat = update(state.fat, keys, weights, mask)
+    est = query(fat, keys)                               # [B] upper bounds
+    D, Ws = state.small.shape
+    scols = _row_cols(keys, D, Ws)
+    flat = jnp.arange(D, dtype=jnp.int32)[:, None] * Ws + scols
+    flat = jnp.where(mask[None, :], flat, D * Ws)
+    small = (state.small.reshape(-1)
+             .at[flat.reshape(-1)]
+             .max(jnp.broadcast_to(est, (D, est.shape[0])).reshape(-1),
+                  mode="drop")
+             .reshape(D, Ws))
+    return CMS2State(fat, small)
+
+
+@jax.jit
+def query_small(state: CMS2State, keys: jax.Array) -> jax.Array:
+    """Point estimates from the small stage: min over rows of the
+    [D, Ws] plane (the SF-sketch read path)."""
+    D, Ws = state.small.shape
+    scols = _row_cols(keys, D, Ws)
+    rows = jnp.arange(D, dtype=jnp.int32)[:, None]
+    return jnp.min(state.small[rows, scols], axis=0)
+
+
+def merge2(a: CMS2State, b: CMS2State) -> CMS2State:
+    raise ValueError(
+        "cms.CMS2State does not merge: max over small-stage estimates "
+        "undercuts the summed true count (no longer an upper bound) — "
+        "merge the fat stages (psum/cms.merge) and rebuild, or run "
+        "two-stage single-device only")
+
+
+# ----------------------------------------------------------------------
+# family dispatch: the session engine's kernels run unchanged over the
+# fixed, SALSA, and two-stage families through these two entry points
+# (trace-time isinstance branches; the fixed path lowers to exactly the
+# pre-existing programs, keeping the legacy arm byte-identical).
+# ----------------------------------------------------------------------
+
+def sk_update(state, keys: jax.Array, weights: jax.Array,
+              mask: jax.Array):
+    """Family-dispatching update (fixed / salsa / two-stage)."""
+    if isinstance(state, CMSState):
+        return update(state, keys, weights, mask)
+    if isinstance(state, CMS2State):
+        return update2(state, keys, weights, mask)
+    from streambench_tpu.ops import salsa
+
+    if isinstance(state, salsa.SalsaState):
+        return salsa.update(state, keys, weights, mask)
+    raise TypeError(f"not a sketch state: {type(state).__name__}")
+
+
+def point_query(state, keys: jax.Array) -> jax.Array:
+    """Family-dispatching point query.  Two-stage reads the SMALL
+    stage (that is its point); SALSA reads the widest merged counter."""
+    if isinstance(state, CMSState):
+        return query(state, keys)
+    if isinstance(state, CMS2State):
+        return query_small(state, keys)
+    from streambench_tpu.ops import salsa
+
+    if isinstance(state, salsa.SalsaState):
+        return salsa.query(state, keys)
+    raise TypeError(f"not a sketch state: {type(state).__name__}")
+
+
+def sk_total(state) -> jax.Array:
+    """Total folded weight for any family."""
+    return state.fat.total if isinstance(state, CMS2State) else state.total
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
-def heavy_hitters(state: CMSState, candidate_keys: jax.Array, *,
+def heavy_hitters(state, candidate_keys: jax.Array, *,
                   k: int = 16):
-    """Top-k candidates by CMS estimate: (values, indices into candidates).
+    """Top-k candidates by sketch estimate: (values, indices into
+    candidates).  Works over any sketch family (``point_query``); the
+    two-stage family reports from its small stage.
 
     Query cost is linear in the CANDIDATE set — callers must keep that
     bounded (see ``TopKState``); enumerating the whole interned key
     universe here defeats the sketch's sublinearity.
     """
-    est = query(state, candidate_keys)
+    est = point_query(state, candidate_keys)
     return jax.lax.top_k(est, k)
 
 
@@ -158,17 +298,19 @@ def fold_candidates(cand_keys: jax.Array, cand_ests: jax.Array,
 
 
 @jax.jit
-def update_topk(state: CMSState, topk: TopKState, keys: jax.Array,
+def update_topk(state, topk: TopKState, keys: jax.Array,
                 mask: jax.Array) -> TopKState:
     """Fold one batch of (masked) keys into the candidate ring.
 
     Concatenate ring + batch, dedupe by key keeping the max estimate
     (sort by a combined (key, -est) int64 rank; duplicates collapse to
     their first = largest entry), then keep the top-M by estimate.  All
-    shapes static; one sort + one top_k on device.
+    shapes static; one sort + one top_k on device.  ``state`` is any
+    sketch family (``point_query`` — the two-stage ring reads the
+    small stage, the SALSA ring the widest merged counter).
     """
     M = topk.keys.shape[0]
-    est = jnp.where(mask, query(state, keys), -1).astype(jnp.int32)
+    est = jnp.where(mask, point_query(state, keys), -1).astype(jnp.int32)
     k_new = jnp.where(mask, keys.astype(jnp.int32), -1)
     allk = jnp.concatenate([topk.keys, k_new])
     alle = jnp.concatenate([topk.ests, est])

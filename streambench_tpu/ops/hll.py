@@ -130,6 +130,35 @@ def estimate(registers: jax.Array) -> jax.Array:
     return jnp.where((raw <= 2.5 * R) & (zeros > 0), linear, raw)
 
 
+def merge(a: HLLState, b: HLLState) -> HLLState:
+    """Partial-state union: elementwise register max (associative,
+    commutative, idempotent — the pmax the sharded engines rely on).
+
+    Geometry is validated up front and a mismatch names both shapes —
+    a [C, W, R] drift used to broadcast into garbage registers or die
+    in XLA with an unhelpful shape error.  PRECONDITION: both partials
+    share one window-ring assignment (shard splits of a single stream,
+    where every shard ran the same ``assign_windows`` sequence) — slot
+    ids are positional, so merging rings with different assignments is
+    meaningless and the ids are taken from ``a``.
+    """
+    if (a.registers.shape != b.registers.shape
+            or a.registers.dtype != b.registers.dtype):
+        raise ValueError(
+            f"hll.merge: geometry mismatch — a.registers "
+            f"{a.registers.shape}/{a.registers.dtype} vs b.registers "
+            f"{b.registers.shape}/{b.registers.dtype}")
+    if a.window_ids.shape != b.window_ids.shape:
+        raise ValueError(
+            f"hll.merge: window-ring mismatch — a.window_ids "
+            f"{a.window_ids.shape} vs b.window_ids {b.window_ids.shape}")
+    return HLLState(
+        registers=jnp.maximum(a.registers, b.registers),
+        window_ids=a.window_ids,
+        watermark=jnp.maximum(a.watermark, b.watermark),
+        dropped=a.dropped + b.dropped)
+
+
 @functools.partial(jax.jit, static_argnames=("divisor_ms", "lateness_ms"))
 def flush(state: HLLState, *, divisor_ms: int = 10_000,
           lateness_ms: int = 60_000):

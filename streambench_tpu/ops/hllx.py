@@ -1,0 +1,222 @@
+"""HLL "hyper extended": frequency statistics mined from register planes.
+
+ROADMAP open item 2 / PAPERS.md arXiv:1607.06517 (Cohen, "HyperLogLog
+Hyper Extended: Sketches for Concave Sublinear Frequency Statistics"):
+a plain distinct-count HLL plane answers exactly one question.  The
+hyper-extended construction answers a LADDER of concave sublinear
+frequency statistics from the same register structure by hashing, per
+arrival, the pair ``(key, token mod T)`` instead of the bare key: the
+distinct count of that derived stream is
+
+    D_T  =  sum over keys x of  T * (1 - (1 - 1/T)^c_x)
+
+— a smooth cap of the key's count ``c_x`` at scale ``T`` (≈ c_x for
+c_x << T, -> T for c_x >> T).  One register plane per rung of a
+geometric cap ladder ``T_g = 2^g`` turns a single scatter-max per
+batch into distinct count (g=0: the token is constant, so the plane IS
+the plain user HLL, bit-identical to ``ops/hll.py``'s hash), the
+soft-capped counts at every ``T_g``, and a log-count moment
+
+    sum_x log2(1 + c_x)  ≈  sum_g D_g / T_g
+
+(each term ``D_g/T_g ≈ sum_x (1 - e^{-c_x/T_g})`` contributes ~1 for
+rungs below ``c_x`` and ~0 above — the telescoped octave count; the
+estimator is validated against exact numpy counts in
+tests/test_hllx.py and its bias for counts outside [1, 2^(G-1)] is
+stated, not hidden).  F1 (total views) rides along exactly in an int32
+counter.
+
+State is cumulative per campaign — ``[C, G, R]`` registers, no window
+ring (the windowed variant is the existing HLL engine; hllx trades the
+ring axis for the cap ladder at the same bytes-per-campaign budget).
+The per-arrival token must differ between arrivals of the same key:
+it is mixed from the event timestamp, so an exact duplicate (same
+user, same ms) contributes no new token — which makes at-least-once
+REPLAY idempotent for free, and undercounts only keys emitting several
+events in one millisecond (the generator spaces events 10 ms apart).
+
+Merge = elementwise register max + counter add: associative,
+commutative, register-idempotent — the same shard-order-invariant
+algebra as ``ops/minhash.py``, swept in tests/test_hllx.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from streambench_tpu.ops.hll import _rank, splitmix32
+from streambench_tpu.ops.windowcount import NEG
+
+#: salt-stream constant for the per-rung hash functions (golden-ratio
+#: schedule, the minhash.salts convention)
+_SALT_GAMMA = 0x9E3779B9
+
+#: per-key additive bias of the octave telescope sum_g (1-(1-1/T_g)^c)
+#: over log2(1+c), averaged over c in [1, 2^(G-1)] (exact arithmetic;
+#: see ``moments``) — subtracted as BIAS * distinct
+LOG_MOMENT_BIAS = 1.07
+
+
+class HLLXState(NamedTuple):
+    """registers: [C, G, R] int32 (rung g caps at T_g = 2^g); totals:
+    [C] int32 exact wanted-event counts (F1); watermark/dropped as in
+    ReachState (cumulative: nothing ever drops)."""
+
+    registers: jax.Array
+    totals: jax.Array
+    watermark: jax.Array
+    dropped: jax.Array
+
+
+def caps(groups: int) -> jnp.ndarray:
+    """The cap ladder [G]: T_g = 2^g."""
+    return jnp.asarray([1 << g for g in range(groups)], jnp.int32)
+
+
+def salts(groups: int) -> jax.Array:
+    """Per-rung hash salts (rung 0's is unused — its hash is the bare
+    user mix so the distinct plane matches ops/hll.py bit-for-bit)."""
+    return splitmix32(jnp.arange(1, groups + 1, dtype=jnp.uint32)
+                      * jnp.uint32(_SALT_GAMMA))
+
+
+def init_state(num_campaigns: int, groups: int = 8,
+               num_registers: int = 128) -> HLLXState:
+    if groups < 1 or groups > 24:
+        raise ValueError("groups must be in [1, 24]")
+    if num_registers & (num_registers - 1) or num_registers < 16:
+        raise ValueError("num_registers must be a power of two >= 16")
+    if num_campaigns * groups * num_registers >= 2**31:
+        raise ValueError("C*G*R must fit int32 flat indices")
+    return HLLXState(
+        registers=jnp.zeros((num_campaigns, groups, num_registers),
+                            jnp.int32),
+        totals=jnp.zeros((num_campaigns,), jnp.int32),
+        watermark=jnp.int32(NEG),
+        dropped=jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("view_type",))
+def step(state: HLLXState, join_table: jax.Array,
+         ad_idx: jax.Array, user_idx: jax.Array, event_type: jax.Array,
+         event_time: jax.Array, valid: jax.Array,
+         *, view_type: int = 0) -> HLLXState:
+    """Fold one micro-batch into every rung: one [B, G] hash block, one
+    flat scatter-max — the same dispatch shape as a plain HLL step, so
+    the frequency ladder costs no extra ingest dispatches."""
+    C, G, R = state.registers.shape
+    p = R.bit_length() - 1
+
+    campaign = join_table[ad_idx]
+    wanted = valid & (event_type == view_type) & (campaign >= 0)
+
+    hu = splitmix32(user_idx)                         # [B] key mix
+    he = splitmix32(hu ^ splitmix32(event_time))      # [B] arrival mix
+    tg = he[:, None] & (caps(G).astype(jnp.uint32) - 1)[None, :]  # [B, G]
+    hg = splitmix32(hu[:, None] ^ salts(G)[None, :] ^ tg)
+    # rung 0 is the bare key: bit-identical to the ops/hll.py hash
+    h = jnp.concatenate([hu[:, None], hg[:, 1:]], axis=1) if G > 1 \
+        else hu[:, None]
+
+    j = (h & jnp.uint32(R - 1)).astype(jnp.int32)
+    rank = _rank(h, p)
+    g = jnp.arange(G, dtype=jnp.int32)[None, :]
+    flat = jnp.where(wanted[:, None],
+                     (campaign[:, None] * G + g) * R + j, C * G * R)
+    registers = (state.registers.reshape(-1)
+                 .at[flat.reshape(-1)].max(rank.reshape(-1), mode="drop")
+                 .reshape(C, G, R))
+
+    totals = state.totals.at[jnp.where(wanted, campaign, C)].add(
+        1, mode="drop")
+    watermark = jnp.maximum(
+        state.watermark, jnp.max(jnp.where(valid, event_time, NEG)))
+    return HLLXState(registers, totals, watermark, state.dropped)
+
+
+@functools.partial(jax.jit, static_argnames=("view_type",))
+def scan_steps(state: HLLXState, join_table: jax.Array,
+               ad_idx: jax.Array, user_idx: jax.Array,
+               event_type: jax.Array, event_time: jax.Array,
+               valid: jax.Array, *, view_type: int = 0) -> HLLXState:
+    """Fold ``[N, B]`` stacked micro-batches via ``lax.scan`` — one
+    dispatch per chunk, same amortization as ``hll.scan_steps``."""
+
+    def body(carry, xs):
+        a, u, e, t, v = xs
+        return step(carry, join_table, a, u, e, t, v,
+                    view_type=view_type), None
+
+    final, _ = jax.lax.scan(
+        body, state, (ad_idx, user_idx, event_type, event_time, valid))
+    return final
+
+
+@functools.partial(jax.jit, static_argnames=("view_type",))
+def scan_steps_packed(state: HLLXState, join_table: jax.Array,
+                      packed: jax.Array, user_idx: jax.Array,
+                      event_time: jax.Array,
+                      *, view_type: int = 0) -> HLLXState:
+    """``scan_steps`` over the packed wire word + user ids — the same
+    12 B/event wire as the HLL/reach packed scans."""
+    from streambench_tpu.ops.windowcount import unpack_columns
+
+    def body(carry, xs):
+        pk, u, t = xs
+        a, e, v = unpack_columns(pk)
+        return step(carry, join_table, a, u, e, t, v,
+                    view_type=view_type), None
+
+    final, _ = jax.lax.scan(body, state, (packed, user_idx, event_time))
+    return final
+
+
+def merge(a: HLLXState, b: HLLXState) -> HLLXState:
+    """Shard/partial union: register max + exact counter add.
+    Geometry validated up front, mismatches name both shapes."""
+    if (a.registers.shape != b.registers.shape
+            or a.registers.dtype != b.registers.dtype):
+        raise ValueError(
+            f"hllx.merge: geometry mismatch — a.registers "
+            f"{a.registers.shape}/{a.registers.dtype} vs b.registers "
+            f"{b.registers.shape}/{b.registers.dtype}")
+    return HLLXState(
+        registers=jnp.maximum(a.registers, b.registers),
+        totals=a.totals + b.totals,
+        watermark=jnp.maximum(a.watermark, b.watermark),
+        dropped=a.dropped + b.dropped)
+
+
+@jax.jit
+def moments(state: HLLXState):
+    """Every answer the ladder holds, one device program:
+
+    - ``distinct [C]`` — rung-0 estimate (the plain HLL number);
+    - ``softcap [C, G]`` — the concave sublinear capped counts
+      ``sum_x T_g(1-(1-1/T_g)^c_x)`` per rung;
+    - ``log_moment [C]`` — ``sum_x log2(1+c_x)`` via the octave
+      telescope ``sum_g D_g/T_g - LOG_MOMENT_BIAS * D_0`` (each rung
+      contributes ~1 per key whose count exceeds it; the telescope
+      carries a per-key additive bias of 1.07 +- 0.12 for counts in
+      [1, 2^(G-1)], computed exactly from the soft-cap form and
+      subtracted here; counts ABOVE the ladder truncate toward the
+      G*distinct ceiling — size G to the workload's count range);
+    - ``totals [C]`` — exact F1 (wanted events).
+    """
+    from streambench_tpu.ops import hll
+
+    G = state.registers.shape[1]
+    d = hll.estimate(state.registers)                  # [C, G]
+    inv_t = 1.0 / caps(G).astype(jnp.float32)
+    log_raw = jnp.sum(d * inv_t[None, :], axis=1)
+    return {
+        "distinct": d[:, 0],
+        "softcap": d,
+        "log_moment": jnp.maximum(
+            log_raw - LOG_MOMENT_BIAS * d[:, 0], 0.0),
+        "totals": state.totals,
+    }

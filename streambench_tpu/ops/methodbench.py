@@ -33,6 +33,12 @@ METHODS = ("scatter", "matmul", "pallas")
 # (one claim + one scatter into the [C, S, W] bucket plane).  Keyed per
 # (backend, S-bucket) — S = size/slide drives the unrolled forms' cost.
 SLIDING_METHODS = ("scatter", "matmul", "sliced")
+# CMS-family arms (ISSUE 13): the fixed plane's flat scatter vs its
+# per-row loop landing, the SF two-stage update (fat add + small
+# refresh), and the SALSA merge-on-overflow update (decode + scatter +
+# settle + encode).  Keyed per (backend, width) — the settle pass is
+# O(Wd) per batch, so the crossover moves with width.
+CMS_METHODS = ("flat", "rowloop", "twostage", "salsa")
 _DEFAULT_CACHE = os.path.join(
     os.path.expanduser("~"), ".cache", "streambench_tpu",
     "method_bench.json")
@@ -319,6 +325,108 @@ def measure_and_record_sliding(num_campaigns: int = 100,
     return res
 
 
+# ----------------------------------------------------------------------
+# CMS family (ISSUE 13): the real compiled sketch update per arm.
+# ----------------------------------------------------------------------
+
+def cms_key(backend: str, width: int) -> str:
+    return f"{backend}/cms/W{int(width)}"
+
+
+def cms_winner(backend: str, width: int) -> str | None:
+    """Measured cms-family winner for this backend + width, or None
+    when nothing was measured (``jax.cms.mode=auto`` then resolves
+    fixed)."""
+    entry = cached_value(cms_key(backend, width))
+    if entry is None:
+        return None
+    winner = entry.get("winner")
+    return winner if winner in CMS_METHODS else None
+
+
+def measure_cms(width: int = 2048, depth: int = 4,
+                batch_size: int = 8192, iters: int = 20,
+                methods: tuple = CMS_METHODS,
+                time_budget_s: float = 5.0, seed: int = 0) -> dict:
+    """Time the compiled sketch update per arm at a given geometry.
+
+    Synthetic Zipf-skewed keys with unit-ish weights (the heavy-hitter
+    shape the session engine feeds the sketch), same sampling
+    discipline as ``measure_methods``: warm once, budget-bounded timed
+    iterations, one trailing block.
+    """
+    import jax
+
+    from streambench_tpu.ops import cms as cms_ops
+    from streambench_tpu.ops import salsa as salsa_ops
+
+    rng = np.random.default_rng(seed)
+    B = int(batch_size)
+    keys = np.minimum(rng.zipf(1.1, B), 2**28).astype(np.int32)
+    weights = rng.integers(1, 8, B).astype(np.int32)
+    mask = np.ones(B, bool)
+    cols = [jax.numpy.asarray(c) for c in (keys, weights, mask)]
+
+    def make(method):
+        if method == "salsa":
+            return (salsa_ops.init_state(depth, width),
+                    salsa_ops.update)
+        if method == "twostage":
+            return (cms_ops.init_two_stage(depth, width),
+                    cms_ops.update2)
+        if method == "rowloop":
+            return (cms_ops.init_state(depth, width),
+                    cms_ops.update_rowloop)
+        return (cms_ops.init_state(depth, width), cms_ops.update)
+
+    out: dict = {
+        "backend": jax.default_backend(),
+        "depth": int(depth), "width": int(width), "batch_size": B,
+        "iters": int(iters), "methods": {},
+    }
+    per_budget = time_budget_s / max(len(methods), 1)
+    for method in methods:
+        try:
+            state, fn = make(method)
+            st = fn(state, *cols)
+            jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+            t0 = time.perf_counter()
+            st = fn(state, *cols)
+            jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+            warm_s = time.perf_counter() - t0
+            n = (1 if warm_s > per_budget
+                 else max(1, min(iters, int(per_budget / max(warm_s,
+                                                             1e-7)))))
+            t0 = time.perf_counter()
+            for _ in range(n):
+                st = fn(st, *cols)
+            jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+            per_call = (time.perf_counter() - t0) / n
+            out["methods"][method] = {
+                "ns_per_event": round(per_call * 1e9 / B, 2),
+                "ms_per_step": round(per_call * 1e3, 4),
+                "timed_iters": n,
+            }
+        except Exception as e:  # a broken arm must not kill the table
+            out["methods"][method] = {"error": repr(e)}
+    ranked = sorted(
+        (m for m, v in out["methods"].items() if "ns_per_event" in v),
+        key=lambda m: out["methods"][m]["ns_per_event"])
+    out["winner"] = ranked[0] if ranked else None
+    return out
+
+
+def measure_and_record_cms(width: int = 2048, depth: int = 4,
+                           batch_size: int = 8192, **kw) -> dict:
+    """Measure + persist under the backend/cms/W key the
+    ``jax.cms.mode=auto`` resolution consults."""
+    res = measure_cms(width=width, depth=depth, batch_size=batch_size,
+                      **kw)
+    if res.get("winner"):
+        record(cms_key(res["backend"], width), res)
+    return res
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -335,7 +443,7 @@ def main(argv=None) -> int:
     ap.add_argument("--no-record", action="store_true",
                     help="print the table without touching the cache")
     ap.add_argument("--family", default="all",
-                    choices=("count", "sliding", "all"),
+                    choices=("count", "sliding", "cms", "all"),
                     help="which kernel family to measure")
     args = ap.parse_args(argv)
     if args.smoke:
@@ -357,6 +465,12 @@ def main(argv=None) -> int:
             num_campaigns=args.campaigns,
             window_slots=max(args.window_slots, 128),
             batch_size=args.batch, iters=args.iters)
+    if args.family in ("cms", "all"):
+        # ISSUE 13: the sketch-update arms (flat/rowloop/twostage/
+        # salsa); smoke uses a narrow plane (the settle pass is O(Wd))
+        fn = measure_cms if args.no_record else measure_and_record_cms
+        res["cms"] = fn(width=(256 if args.smoke else 2048),
+                        batch_size=args.batch, iters=args.iters)
     print(json.dumps(res, indent=1, sort_keys=True))
     return 0 if all(v.get("winner") for v in res.values()) else 1
 

@@ -168,11 +168,29 @@ def scan_steps_packed(state: ReachState, join_table: jax.Array,
     return final
 
 
-@jax.jit
 def merge(a: ReachState, b: ReachState) -> ReachState:
     """Shard/partial-state merge: elementwise min over signatures, max
     over registers.  Commutative, associative, idempotent — the algebra
-    tests/test_minhash.py sweeps over random shard splits."""
+    tests/test_minhash.py sweeps over random shard splits.  Geometry is
+    validated up front (a [C, k]/[C, R] mismatch used to broadcast into
+    garbage or die with a cryptic XLA error); the merge itself stays
+    jitted."""
+    if a.mins.shape != b.mins.shape or a.mins.dtype != b.mins.dtype:
+        raise ValueError(
+            f"minhash.merge: signature mismatch — a.mins "
+            f"{a.mins.shape}/{a.mins.dtype} vs b.mins "
+            f"{b.mins.shape}/{b.mins.dtype}")
+    if (a.registers.shape != b.registers.shape
+            or a.registers.dtype != b.registers.dtype):
+        raise ValueError(
+            f"minhash.merge: register mismatch — a.registers "
+            f"{a.registers.shape}/{a.registers.dtype} vs b.registers "
+            f"{b.registers.shape}/{b.registers.dtype}")
+    return _merge_jit(a, b)
+
+
+@jax.jit
+def _merge_jit(a: ReachState, b: ReachState) -> ReachState:
     return ReachState(
         mins=jnp.minimum(a.mins, b.mins),
         registers=jnp.maximum(a.registers, b.registers),

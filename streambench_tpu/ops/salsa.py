@@ -1,0 +1,376 @@
+"""SALSA-style count-min sketch: 8-bit cells that merge on overflow.
+
+ROADMAP open item 2 / PAPERS.md arXiv:2102.12531 (SALSA: self-adjusting
+lean streaming analytics): at production cardinality the fixed-width
+``ops/cms.py`` plane is exactly wrong — a [D, Wd] int32 table spends 4
+bytes on every counter when the overwhelming majority of cells hold
+tiny values, so at a fixed device-memory budget the sketch is 4x
+narrower than it could be and its collision error 4x higher.  SALSA
+starts every counter at 8 bits and **widens only where traffic lands**:
+a cell that overflows merges with its sibling into a 16-bit pair, an
+overflowing pair merges into a 32-bit quad.  Width goes where the heavy
+keys are; everywhere else a counter costs one byte.
+
+State is three planes plus a scalar, all static-shaped:
+
+- ``table [D, Wd] uint8`` — the cell bytes.  A merged group stores its
+  value little-endian across its member bytes.
+- ``m1 [D, Wd//16] uint8`` — packed bitmap, one bit per PAIR: bit ``p``
+  set means cells ``(2p, 2p+1)`` form one 16-bit counter.
+- ``m2 [D, Wd//32] uint8`` — packed bitmap, one bit per QUAD: bit ``q``
+  set means cells ``4q..4q+3`` form one 32-bit counter (implies both
+  pair bits).
+- ``total [] int32`` — total folded weight (same contract as CMSState).
+
+Bitmap overhead is 3/32 byte per cell, so the plane costs ~1.094
+bytes/cell vs the fixed sketch's 4 — 3.66x the counters in the same
+device bytes (``obs.devmem.state_nbytes`` measures it; bench_sketch.py
+commits the numbers).
+
+**The transition is a multiset homomorphism.**  Three deliberate
+choices make the whole state a pure function of the exact per-cell
+totals, independent of batching, event order, and shard split:
+
+1. overflow is detected on the EXACT int32 accumulated value (the
+   update decodes, adds, then settles — increments are never lost to a
+   saturating 8-bit add);
+2. merging SUMS the sibling counters (SALSA's max-on-merge is slightly
+   tighter but max does not distribute over the cross-shard sum, which
+   would break merge-order invariance; sum keeps every estimate an
+   upper bound and keeps the algebra linear);
+3. merge bits only ever turn on, and they turn on exactly when a
+   group's running total first exceeds its width (totals are monotone,
+   so the final bitmap depends only on the final totals).
+
+Consequences, all pinned by tests/test_salsa.py: per-batch fold, scan
+fold, and any sharded split + arbitrary merge order produce
+bit-identical planes, and the numpy oracle can compute the expected
+state in closed form from exact totals (``oracle_encode_np``) without
+replaying the transition at all.
+
+``merge(a, b)`` = OR the bitmaps, sum the decoded value planes, settle
+(a union group can itself overflow), re-encode — associative,
+commutative, and idempotence-free like any counter sum.  No psum: the
+sharded session engine all_gathers closed rows (already gathered for
+the candidate ring) and updates the replicated plane, so the SALSA
+mode costs zero extra collectives (parallel/sketches.py).
+
+Query semantics match ``ops/cms.py`` exactly while every touched group
+is still solo (same ``_row_cols`` hash, same min-over-rows), so at
+equal width a run without overflows reports bit-identical estimates —
+the A/B oracle the CI session leg uses.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from streambench_tpu.ops.cms import _SALTS, _row_cols
+
+#: width caps per merge level: solo byte, 16-bit pair, 32-bit quad
+#: (the quad cap is int31 — this repo runs x64-off, decoded values and
+#: totals live in int32; quads saturate there, order-invariantly,
+#: instead of wrapping)
+CAP0 = 255
+CAP1 = 65_535
+CAP2 = 2**31 - 1
+
+
+class SalsaState(NamedTuple):
+    table: jax.Array   # [D, Wd] uint8 cell bytes
+    m1: jax.Array      # [D, Wd//16] uint8 packed pair-merge bits
+    m2: jax.Array      # [D, Wd//32] uint8 packed quad-merge bits
+    total: jax.Array   # [] int32 total folded weight
+
+
+def init_state(depth: int = 4, width: int = 2048,
+               cell_bits: int = 8) -> SalsaState:
+    """Fresh plane.  ``cell_bits=16`` starts with every pair pre-merged
+    (16-bit counters everywhere, quads still form on overflow) — the
+    ``jax.cms.cell.bits`` knob."""
+    if width & (width - 1) or width < 32:
+        raise ValueError("width must be a power of two >= 32")
+    if depth > len(_SALTS):
+        raise ValueError(f"depth <= {len(_SALTS)}")
+    if cell_bits not in (8, 16):
+        raise ValueError(f"cell_bits must be 8 or 16, got {cell_bits}")
+    m1_fill = 0xFF if cell_bits == 16 else 0
+    return SalsaState(
+        table=jnp.zeros((depth, width), jnp.uint8),
+        m1=jnp.full((depth, width // 16), m1_fill, jnp.uint8),
+        m2=jnp.zeros((depth, width // 32), jnp.uint8),
+        total=jnp.int32(0))
+
+
+# ----------------------------------------------------------------------
+# bitmap + value-plane plumbing (shared by update / query / merge)
+# ----------------------------------------------------------------------
+
+def _expand_bits(packed: jax.Array, n: int) -> jax.Array:
+    """[D, n//8] packed uint8 -> [D, n] int32 in {0, 1} (bit k of byte
+    i is group 8i+k)."""
+    D = packed.shape[0]
+    bits = (packed[:, :, None].astype(jnp.int32)
+            >> jnp.arange(8, dtype=jnp.int32)[None, None, :]) & 1
+    return bits.reshape(D, n)
+
+
+def _pack_bits(bits: jax.Array) -> jax.Array:
+    """[D, n] {0,1} -> [D, n//8] packed uint8 (inverse of _expand_bits)."""
+    D, n = bits.shape
+    b = bits.reshape(D, n // 8, 8).astype(jnp.int32)
+    w = (1 << jnp.arange(8, dtype=jnp.int32))[None, None, :]
+    return jnp.sum(b * w, axis=-1).astype(jnp.uint8)
+
+
+def _decode(state: SalsaState):
+    """Base-placed value plane: ``v [D, Wd] int32`` holds each group's
+    value at the group's FIRST cell, zero at the other member cells
+    (so any coarser group-sum is a plain strided reshape-sum).  Also
+    returns the expanded pair/quad bit planes."""
+    D, Wd = state.table.shape
+    b = state.table.astype(jnp.int32)
+    pair = b[:, 0::2] + (b[:, 1::2] << 8)            # [D, Wd/2] raw LE16
+    quad = pair[:, 0::2] + (pair[:, 1::2] << 16)     # [D, Wd/4] raw LE32
+    m1b = _expand_bits(state.m1, Wd // 2)            # [D, Wd/2]
+    m2b = _expand_bits(state.m2, Wd // 4)            # [D, Wd/4]
+    idx = jnp.arange(Wd, dtype=jnp.int32)
+    pair_base = (idx % 2 == 0)[None, :]
+    quad_base = (idx % 4 == 0)[None, :]
+    m1_cell = jnp.repeat(m1b, 2, axis=1)
+    m2_cell = jnp.repeat(m2b, 4, axis=1)
+    pair_exp = jnp.repeat(pair, 2, axis=1)
+    quad_exp = jnp.repeat(quad, 4, axis=1)
+    v = jnp.where(
+        m2_cell == 1,
+        jnp.where(quad_base, quad_exp, 0),
+        jnp.where(m1_cell == 1,
+                  jnp.where(pair_base, pair_exp, 0),
+                  b))
+    return v, m1b, m2b
+
+
+def _settle(v: jax.Array, m1b: jax.Array, m2b: jax.Array) -> SalsaState:
+    """Overflow pass + re-encode.  ``v`` is a base-placed int32 value
+    plane whose groups may exceed their width; merge bits turn on where
+    they do (solo > 255 -> pair, pair > 65535 -> quad, quad saturates
+    at CAP2), values re-base at the new geometry, bytes re-encode."""
+    D, Wd = v.shape
+    # group-sums at each granularity (non-base member cells hold 0, so
+    # the strided sums ARE the group totals regardless of current level)
+    pair_tot = v[:, 0::2] + v[:, 1::2]               # [D, Wd/2]
+    quad_tot = pair_tot[:, 0::2] + pair_tot[:, 1::2]  # [D, Wd/4]
+    # a pair merges when any member SOLO value outgrew a byte (merged
+    # pairs/quads are already excluded: their bit is set)
+    cell_hi = jnp.maximum(v[:, 0::2], v[:, 1::2])
+    m1b = jnp.maximum(m1b, (cell_hi > CAP0).astype(jnp.int32))
+    # a quad merges when a MERGED pair's value outgrew 16 bits (an
+    # unmerged pair is <= 510, so the m1b guard is belt only)
+    pair_over = (m1b == 1) & (pair_tot > CAP1)
+    quad_over = pair_over[:, 0::2] | pair_over[:, 1::2]
+    m2b = jnp.maximum(m2b, quad_over.astype(jnp.int32))
+    # quad merge implies both pair bits
+    m1b = jnp.maximum(m1b, jnp.repeat(m2b, 2, axis=1))
+    quad_tot = jnp.minimum(quad_tot, CAP2)
+    # re-encode at the (possibly widened) final geometry
+    idx = jnp.arange(Wd, dtype=jnp.int32)
+    m1_cell = jnp.repeat(m1b, 2, axis=1)
+    m2_cell = jnp.repeat(m2b, 4, axis=1)
+    # bytes: each cell extracts its lane of the owning group's value
+    # (solo: byte 0 of its own value; pair: byte idx%2; quad: idx%4)
+    group_val = jnp.where(
+        m2_cell == 1, jnp.repeat(quad_tot, 4, axis=1),
+        jnp.where(m1_cell == 1, jnp.repeat(pair_tot, 2, axis=1), v))
+    lane = jnp.where(m2_cell == 1, idx[None, :] % 4,
+                     jnp.where(m1_cell == 1, idx[None, :] % 2, 0))
+    table = ((group_val >> (lane * 8)) & 0xFF).astype(jnp.uint8)
+    return table, _pack_bits(m1b), _pack_bits(m2b)
+
+
+def _bit_at(packed: jax.Array, group: jax.Array) -> jax.Array:
+    """Gather bit ``group`` of each row's packed bitmap: packed is
+    [D, G//8], group is [D, B] int32 -> [D, B] int32 in {0, 1}."""
+    byte = jnp.take_along_axis(packed, (group >> 3).astype(jnp.int32),
+                               axis=1).astype(jnp.int32)
+    return (byte >> (group & 7)) & 1
+
+
+# ----------------------------------------------------------------------
+# the three transitions
+# ----------------------------------------------------------------------
+
+@jax.jit
+def update(state: SalsaState, keys: jax.Array, weights: jax.Array,
+           mask: jax.Array) -> SalsaState:
+    """Add ``weights`` for ``keys`` (masked rows dropped): decode to the
+    exact value plane, scatter each key's weight at its CURRENT group
+    base, settle overflow, re-encode.  Same ``_row_cols`` hash as the
+    fixed-width sketch, so both arms touch the same cells."""
+    D, Wd = state.table.shape
+    cols = _row_cols(keys, D, Wd)                        # [D, B]
+    w = jnp.where(mask, weights, 0).astype(jnp.int32)    # [B]
+    v, m1b, m2b = _decode(state)
+    m1_at = _bit_at(state.m1, cols >> 1)
+    m2_at = _bit_at(state.m2, cols >> 2)
+    base = jnp.where(m2_at == 1, (cols >> 2) << 2,
+                     jnp.where(m1_at == 1, (cols >> 1) << 1, cols))
+    flat = jnp.arange(D, dtype=jnp.int32)[:, None] * Wd + base
+    flat = jnp.where(mask[None, :], flat, D * Wd)
+    v = (v.reshape(-1)
+         .at[flat.reshape(-1)]
+         .add(jnp.broadcast_to(w, (D, w.shape[0])).reshape(-1),
+              mode="drop")
+         .reshape(D, Wd))
+    table, m1, m2 = _settle(v, m1b, m2b)
+    return SalsaState(table, m1, m2, state.total + jnp.sum(w))
+
+
+@jax.jit
+def query(state: SalsaState, keys: jax.Array) -> jax.Array:
+    """Point estimates (upper bounds): the widest merged counter
+    covering each key's cell, min over the D rows."""
+    D, Wd = state.table.shape
+    cols = _row_cols(keys, D, Wd)
+    m1_at = _bit_at(state.m1, cols >> 1)
+    m2_at = _bit_at(state.m2, cols >> 2)
+    t = state.table.astype(jnp.int32)
+
+    def at(off_base, k):
+        return jnp.take_along_axis(t, off_base + k, axis=1)
+
+    solo = jnp.take_along_axis(t, cols, axis=1)
+    p0 = (cols >> 1) << 1
+    pairv = at(p0, 0) + (at(p0, 1) << 8)
+    q0 = (cols >> 2) << 2
+    quadv = (at(q0, 0) + (at(q0, 1) << 8)
+             + (at(q0, 2) << 16) + (at(q0, 3) << 24))
+    val = jnp.where(m2_at == 1, quadv,
+                    jnp.where(m1_at == 1, pairv, solo))
+    return jnp.min(val, axis=0)
+
+
+def merge(a: SalsaState, b: SalsaState) -> SalsaState:
+    """Shard union: OR bitmaps, sum the decoded value planes, settle
+    (a union group can itself overflow), re-encode.  Commutative and
+    associative bit-for-bit — tests/test_salsa.py sweeps random shard
+    splits and merge orders."""
+    if (a.table.shape != b.table.shape
+            or a.table.dtype != b.table.dtype):
+        raise ValueError(
+            f"salsa.merge: geometry mismatch — a.table "
+            f"{a.table.shape}/{a.table.dtype} vs b.table "
+            f"{b.table.shape}/{b.table.dtype}")
+    va, m1a, m2a = _decode(a)
+    vb, m1b, m2b = _decode(b)
+    table, m1, m2 = _settle(va + vb, jnp.maximum(m1a, m1b),
+                            jnp.maximum(m2a, m2b))
+    return SalsaState(table, m1, m2, a.total + b.total)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def heavy_hitters(state: SalsaState, candidate_keys: jax.Array, *,
+                  k: int = 16):
+    """Top-k candidates by SALSA estimate (peer of cms.heavy_hitters)."""
+    est = query(state, candidate_keys)
+    return jax.lax.top_k(est, k)
+
+
+def stats(state: SalsaState) -> dict:
+    """Host-side merge census (bench/report honesty: a SALSA rung that
+    never merged proves nothing about overflow handling)."""
+    Wd = state.table.shape[1]
+    m1 = np.unpackbits(np.asarray(state.m1), axis=1,
+                       count=Wd // 2, bitorder="little")
+    m2 = np.unpackbits(np.asarray(state.m2), axis=1,
+                       count=Wd // 4, bitorder="little")
+    return {"cells": int(state.table.size),
+            "merged_pairs": int(m1.sum()),
+            "merged_quads": int(m2.sum()),
+            "total": int(state.total)}
+
+
+# ----------------------------------------------------------------------
+# numpy differential oracle
+# ----------------------------------------------------------------------
+# The homomorphism property (module docstring) means the expected state
+# is a CLOSED FORM of the exact per-cell totals — the oracle never
+# replays the batched transition, so it cannot share a bug with it.
+
+def oracle_cols_np(keys: np.ndarray, depth: int, width: int) -> np.ndarray:
+    """numpy mirror of cms._row_cols ([D, B] column per row)."""
+    from streambench_tpu.reach.oracle import splitmix32_np
+
+    cols = []
+    for d in range(depth):
+        h = splitmix32_np(
+            (keys.astype(np.uint32) ^ np.uint32(_SALTS[d])).astype(np.int64)
+            .astype(np.int32))
+        cols.append((h & np.uint32(width - 1)).astype(np.int32))
+    return np.stack(cols)
+
+
+def oracle_totals_np(batches, depth: int, width: int) -> np.ndarray:
+    """Exact per-cell totals [D, Wd] int64 from (keys, weights, mask)
+    batch triples — the ground truth every transition must encode."""
+    tot = np.zeros((depth, width), np.int64)
+    for keys, weights, mask in batches:
+        cols = oracle_cols_np(np.asarray(keys), depth, width)
+        w = np.where(mask, weights, 0).astype(np.int64)
+        for d in range(depth):
+            np.add.at(tot[d], cols[d], w)
+    return tot
+
+
+def oracle_encode_np(totals: np.ndarray, cell_bits: int = 8):
+    """Closed-form expected state from exact per-cell totals: a pair is
+    merged iff a member's total ever exceeded 255 (totals are monotone,
+    so "ever" = "finally"); a quad iff a pair total exceeded 65535;
+    values are group-sums clipped at CAP2; bytes little-endian per
+    group.  Returns (table uint8, m1 packed, m2 packed)."""
+    D, Wd = totals.shape
+    t = totals
+    pair_tot = t[:, 0::2] + t[:, 1::2]
+    m1 = np.maximum(t[:, 0::2], t[:, 1::2]) > CAP0
+    if cell_bits == 16:
+        m1 = np.ones_like(m1)
+    m2 = ((m1 & (pair_tot > CAP1))[:, 0::2]
+          | (m1 & (pair_tot > CAP1))[:, 1::2])
+    m1 = m1 | np.repeat(m2, 2, axis=1)
+    quad_tot = np.minimum(pair_tot[:, 0::2] + pair_tot[:, 1::2], CAP2)
+    m1c = np.repeat(m1, 2, axis=1)
+    m2c = np.repeat(m2, 4, axis=1)
+    group = np.where(m2c, np.repeat(quad_tot, 4, axis=1),
+                     np.where(m1c, np.repeat(pair_tot, 2, axis=1), t))
+    idx = np.arange(Wd)
+    lane = np.where(m2c, idx % 4, np.where(m1c, idx % 2, 0))
+    table = ((group >> (lane * 8)) & 0xFF).astype(np.uint8)
+    pm1 = np.packbits(m1.astype(np.uint8), axis=1, bitorder="little")
+    pm2 = np.packbits(m2.astype(np.uint8), axis=1, bitorder="little")
+    return table, pm1, pm2
+
+
+def oracle_query_np(totals: np.ndarray, keys: np.ndarray,
+                    cell_bits: int = 8) -> np.ndarray:
+    """Expected point estimates from exact totals at the final merge
+    geometry (what ``query`` must return bit-for-bit)."""
+    D, Wd = totals.shape
+    table, pm1, pm2 = oracle_encode_np(totals, cell_bits)
+    m1 = np.unpackbits(pm1, axis=1, count=Wd // 2, bitorder="little")
+    m2 = np.unpackbits(pm2, axis=1, count=Wd // 4, bitorder="little")
+    pair_tot = totals[:, 0::2] + totals[:, 1::2]
+    quad_tot = np.minimum(pair_tot[:, 0::2] + pair_tot[:, 1::2], CAP2)
+    cols = oracle_cols_np(np.asarray(keys), D, Wd)
+    out = np.empty((D, cols.shape[1]), np.int64)
+    for d in range(D):
+        c = cols[d]
+        solo = totals[d, c]
+        pv = pair_tot[d, c >> 1]
+        qv = quad_tot[d, c >> 2]
+        out[d] = np.where(m2[d, c >> 2] == 1, qv,
+                          np.where(m1[d, c >> 1] == 1, pv, solo))
+    return out.min(axis=0)

@@ -53,7 +53,7 @@ from streambench_tpu.engine.sketches import (
     _hist_rows,
 )
 from streambench_tpu.io.redis_schema import RedisLike
-from streambench_tpu.ops import cms, hll, session, sliding, tdigest
+from streambench_tpu.ops import cms, hll, salsa, session, sliding, tdigest
 from streambench_tpu.ops import windowcount as wc
 from streambench_tpu.ops.windowcount import NEG, WindowState, assign_windows
 from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
@@ -1283,6 +1283,233 @@ def _build_session_flush(mesh: Mesh, gap_ms: int, lateness_ms: int,
     return jax.jit(mapped)
 
 
+# ----------------------------------------------------------------------
+# SALSA-mode session kernels (ISSUE 13): the merge-on-overflow plane is
+# NOT psum-linear (merge bits + byte re-encode), so the fixed path's
+# per-shard-delta + psum allreduce does not apply.  It does not need
+# to: the closed-session ROWS are already all_gathered for the
+# replicated candidate ring, and the SALSA transition is a multiset
+# homomorphism (ops/salsa.py), so every shard folds the SAME gathered
+# closure rows into its replicated plane and lands on a bit-identical
+# state — a psum-FREE merge, 3 gathers per closure group and zero
+# extra collectives.  Scalar counters/histogram fall out of the same
+# gathered rows (replicated sums), dropping the fixed path's counter
+# psums too.
+# ----------------------------------------------------------------------
+
+_SESS_SALSA_STATE_SPECS = (P(MESH_AXES), P(MESH_AXES), P(MESH_AXES),
+                           P(), P(),
+                           P(), P(), P(), P(),      # salsa table/m1/m2/total
+                           P(), P(), P(), P(), P())  # ring + counters + hist
+
+
+def _gather_closed3(closed: session.ClosedSessions):
+    """all_gather just the columns the SALSA absorb needs (user,
+    clicks, valid) — 3 collectives vs _gather_closed's 5."""
+    g = functools.partial(jax.lax.all_gather, axis_name=MESH_AXES,
+                          tiled=True)
+    return g(closed.user), g(closed.clicks), g(closed.valid)
+
+
+def _session_fold_salsa(last_time, sess_start, clicks, watermark, dropped,
+                        s_table, s_m1, s_m2, s_total, tk_keys, tk_ests,
+                        closed_n, clicks_n, lat_hist, now_rel,
+                        user_idx, event_type, event_time, valid,
+                        *, gap_ms: int, lateness_ms: int,
+                        user_capacity: int):
+    """One batch folded into a user shard + the replicated SALSA plane.
+
+    Mirrors ``_session_fold``'s absorb order (in-batch closures, then
+    carried) so the plane equals the single-device engine's bit for
+    bit — the homomorphism means batch boundaries and row order inside
+    the gathered closure sets cannot matter."""
+    Ul = last_time.shape[0]
+    u0 = _shard_index() * Ul
+    lu = user_idx - u0
+    in_shard = (lu >= 0) & (lu < Ul)
+    v = valid & in_shard
+
+    local = session.SessionState(last_time, sess_start, clicks,
+                                 watermark, jnp.int32(0))
+    st, closed_in, closed_carry = session.step(
+        local, jnp.where(v, lu, -1), event_type, event_time, v,
+        gap_ms=gap_ms, lateness_ms=lateness_ms)
+
+    batch_max = jnp.max(jnp.where(valid, event_time, NEG))
+    new_wm = jnp.maximum(watermark, batch_max)
+    min_t = watermark - lateness_ms
+    ok = (valid & (event_time >= min_t) & (user_idx >= 0)
+          & (user_idx < user_capacity))
+    new_dropped = dropped + jnp.sum(valid.astype(jnp.int32)) \
+        - jnp.sum(ok.astype(jnp.int32))
+
+    cms_state = salsa.SalsaState(s_table, s_m1, s_m2, s_total)
+    topk = cms.TopKState(tk_keys, tk_ests)
+    det_lat = jnp.maximum(
+        now_rel - jnp.max(jnp.where(valid, event_time, NEG)), 0)
+    det_bin = jnp.clip(det_lat // LAT_BIN_MS, 0, LAT_BINS - 1)
+    for closed in (_globalize(closed_in, u0), _globalize(closed_carry, u0)):
+        gu, gc, gv = _gather_closed3(closed)
+        cms_state = salsa.update(cms_state, gu, gc, gv)
+        topk = cms.update_topk(cms_state, topk, gu, gv)
+        n_closed = jnp.sum(gv.astype(jnp.int32))
+        closed_n = closed_n + n_closed
+        lat_hist = lat_hist.at[det_bin].add(n_closed)
+        clicks_n = clicks_n + jnp.sum(jnp.where(gv, gc, 0))
+
+    return (st.last_time, st.sess_start, st.clicks, new_wm, new_dropped,
+            cms_state.table, cms_state.m1, cms_state.m2, cms_state.total,
+            topk.keys, topk.ests, closed_n, clicks_n, lat_hist)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_session_step_salsa(mesh: Mesh, gap_ms: int, lateness_ms: int,
+                              user_capacity: int):
+    def body(*args):
+        return _session_fold_salsa(*args, gap_ms=gap_ms,
+                                   lateness_ms=lateness_ms,
+                                   user_capacity=user_capacity)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=_SESS_SALSA_STATE_SPECS + (P(), P(), P(), P(), P()),
+        out_specs=_SESS_SALSA_STATE_SPECS,
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_session_scan_salsa(mesh: Mesh, gap_ms: int, lateness_ms: int,
+                              user_capacity: int):
+    """Hoisted scanned SALSA session fold: the scan body is
+    collective-FREE (per-batch per-closure shard-local closed rows ride
+    the scan ys), then ONE all_gather per closed-row column merges them
+    post-scan and a collective-free replay folds the 2K closure groups
+    into the replicated plane + ring against the same evolving prefix
+    states the per-batch arm saw — 3 collectives per dispatch,
+    bit-identical output (the PR 12 session treatment, minus the CMS
+    delta psum that SALSA does not need)."""
+
+    def body(lt, ss, ck, wm, dr, s_table, s_m1, s_m2, s_total, tkk, tke,
+             cn, cl, hist, now_rel, user_idx, event_type, event_time,
+             valid):
+        Ul = lt.shape[0]
+        u0 = _shard_index() * Ul
+
+        def one(carry, xs):
+            lt, ss, ck, wm, dr = carry
+            u, e, t, v = xs
+            lu = u - u0
+            in_shard = (lu >= 0) & (lu < Ul)
+            vv = v & in_shard
+            local = session.SessionState(lt, ss, ck, wm, jnp.int32(0))
+            st, c_in, c_carry = session.step(
+                local, jnp.where(vv, lu, -1), e, t, vv,
+                gap_ms=gap_ms, lateness_ms=lateness_ms)
+            batch_max = jnp.max(jnp.where(v, t, NEG))
+            new_wm = jnp.maximum(wm, batch_max)
+            min_t = wm - lateness_ms
+            ok = (v & (t >= min_t) & (u >= 0) & (u < user_capacity))
+            new_dr = dr + jnp.sum(v.astype(jnp.int32)) \
+                - jnp.sum(ok.astype(jnp.int32))
+            det_bin = jnp.clip(
+                jnp.maximum(now_rel - jnp.max(jnp.where(v, t, NEG)), 0)
+                // LAT_BIN_MS, 0, LAT_BINS - 1)
+            ys = []
+            for closed in (_globalize(c_in, u0),
+                           _globalize(c_carry, u0)):
+                ys.append((closed.user, closed.clicks, closed.valid))
+            stack = tuple(jnp.stack(parts) for parts in zip(*ys))
+            return (st.last_time, st.sess_start, st.clicks, new_wm,
+                    new_dr), stack + (det_bin,)
+
+        (lt, ss, ck, wm, dr), ys = jax.lax.scan(
+            one, (lt, ss, ck, wm, dr),
+            (user_idx, event_type, event_time, valid))
+        c_user, c_clicks, c_valid, det_bins = ys
+
+        # the deferred merge: ONE all_gather per closed-row column —
+        # no CMS-delta psum (homomorphic replicated fold), no counter
+        # psum (counters recompute from the gathered rows)
+        g = functools.partial(jax.lax.all_gather,
+                              axis_name=MESH_AXES, axis=2, tiled=True)
+        c_user = g(c_user)                           # [K, 2, B*n]
+        c_clicks = g(c_clicks)
+        c_valid = g(c_valid)
+
+        K2 = c_user.shape[0] * 2
+
+        def absorb(carry, xs):
+            table, m1, m2, total, tkk, tke, cn, cl, hist = carry
+            gu, gc, gv, db = xs
+            cm = salsa.update(salsa.SalsaState(table, m1, m2, total),
+                              gu, gc, gv)
+            tk = cms.update_topk(cm, cms.TopKState(tkk, tke), gu, gv)
+            nc = jnp.sum(gv.astype(jnp.int32))
+            return (cm.table, cm.m1, cm.m2, cm.total, tk.keys, tk.ests,
+                    cn + nc, cl + jnp.sum(jnp.where(gv, gc, 0)),
+                    hist.at[db].add(nc)), None
+
+        (s_table, s_m1, s_m2, s_total, tkk, tke, cn, cl, hist), _ = \
+            jax.lax.scan(
+                absorb,
+                (s_table, s_m1, s_m2, s_total, tkk, tke, cn, cl, hist),
+                (c_user.reshape(K2, -1),
+                 c_clicks.reshape(K2, -1),
+                 c_valid.reshape(K2, -1),
+                 jnp.repeat(det_bins, 2)))
+        return (lt, ss, ck, wm, dr, s_table, s_m1, s_m2, s_total, tkk,
+                tke, cn, cl, hist)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=_SESS_SALSA_STATE_SPECS + (P(), P(None, None),
+                                            P(None, None), P(None, None),
+                                            P(None, None)),
+        out_specs=_SESS_SALSA_STATE_SPECS,
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_session_flush_salsa(mesh: Mesh, gap_ms: int, lateness_ms: int,
+                               force: bool):
+    def body(last_time, sess_start, clicks, watermark, dropped,
+             s_table, s_m1, s_m2, s_total, tk_keys, tk_ests, closed_n,
+             clicks_n, lat_hist, now_rel):
+        Ul = last_time.shape[0]
+        u0 = _shard_index() * Ul
+        local = session.SessionState(last_time, sess_start, clicks,
+                                     watermark, dropped)
+        st, expired = session.flush(local, gap_ms=gap_ms,
+                                    lateness_ms=lateness_ms, force=force)
+        closed = _globalize(expired, u0)
+        gu, gc, gv = _gather_closed3(closed)
+        cms_state = salsa.update(
+            salsa.SalsaState(s_table, s_m1, s_m2, s_total), gu, gc, gv)
+        topk = cms.update_topk(cms_state, cms.TopKState(tk_keys, tk_ests),
+                               gu, gv)
+        closed_n = closed_n + jnp.sum(gv.astype(jnp.int32))
+        clicks_n = clicks_n + jnp.sum(jnp.where(gv, gc, 0))
+        if not force:
+            # per-row due latency needs the expired rows' END times —
+            # gather them only on this (flush-cadence) path
+            gend = jax.lax.all_gather(expired.end, axis_name=MESH_AXES,
+                                      tiled=True)
+            due = gend + (gap_ms + lateness_ms)
+            lat_hist = _hist_rows(lat_hist,
+                                  jnp.maximum(now_rel - due, 0), gv)
+        return (st.last_time, st.sess_start, st.clicks, st.watermark,
+                st.dropped, cms_state.table, cms_state.m1, cms_state.m2,
+                cms_state.total, topk.keys, topk.ests, closed_n,
+                clicks_n, lat_hist)
+
+    mapped = shard_map(body, mesh=mesh,
+                       in_specs=_SESS_SALSA_STATE_SPECS + (P(),),
+                       out_specs=_SESS_SALSA_STATE_SPECS)
+    return jax.jit(mapped)
+
+
 class ShardedSessionCMSEngine(SessionCMSEngine):
     """Session + CMS engine with per-user state sharded over the whole
     mesh (user axis = flattened ``data x campaign``).
@@ -1318,6 +1545,14 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
                          cms_width=cms_width, top_k=top_k,
                          candidate_capacity=candidate_capacity,
                          input_format=input_format)
+        if self.cms_stages == 2:
+            # The SF small stage refreshes from fat-stage estimates at
+            # update time; shard maxima over it undercut summed true
+            # counts (cms.merge2) — there is no sound distributed merge.
+            raise ValueError(
+                "the sharded session engine does not support "
+                "jax.cms.stages=2 (small-stage maxima do not merge "
+                "soundly); use stages=1 with mode=fixed or salsa")
         self.mesh = mesh
         self._place()
 
@@ -1332,9 +1567,16 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
             clicks=jax.device_put(self.state.clicks, user),
             watermark=jax.device_put(self.state.watermark, rep),
             dropped=jax.device_put(self.state.dropped, rep))
-        self.cms = cms.CMSState(
-            table=jax.device_put(self.cms.table, rep),
-            total=jax.device_put(self.cms.total, rep))
+        if self.cms_mode == "salsa":
+            self.cms = salsa.SalsaState(
+                table=jax.device_put(self.cms.table, rep),
+                m1=jax.device_put(self.cms.m1, rep),
+                m2=jax.device_put(self.cms.m2, rep),
+                total=jax.device_put(self.cms.total, rep))
+        else:
+            self.cms = cms.CMSState(
+                table=jax.device_put(self.cms.table, rep),
+                total=jax.device_put(self.cms.total, rep))
         self.topk = cms.TopKState(
             keys=jax.device_put(self.topk.keys, rep),
             ests=jax.device_put(self.topk.ests, rep))
@@ -1343,22 +1585,31 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
         self.lat_hist = jax.device_put(self.lat_hist, rep)
 
     def _carry(self):
-        return (self.state.last_time, self.state.sess_start,
-                self.state.clicks, self.state.watermark,
-                self.state.dropped, self.cms.table, self.cms.total,
-                self.topk.keys, self.topk.ests, self._closed_dev,
-                self._clicks_dev, self.lat_hist)
+        cms_parts = (tuple(self.cms) if self.cms_mode == "salsa"
+                     else (self.cms.table, self.cms.total))
+        return ((self.state.last_time, self.state.sess_start,
+                 self.state.clicks, self.state.watermark,
+                 self.state.dropped) + cms_parts
+                + (self.topk.keys, self.topk.ests, self._closed_dev,
+                   self._clicks_dev, self.lat_hist))
 
     def _uncarry(self, out) -> None:
-        (lt, ss, ck, wm, dr, table, total, tkk, tke,
-         self._closed_dev, self._clicks_dev, self.lat_hist) = out
+        if self.cms_mode == "salsa":
+            (lt, ss, ck, wm, dr, table, m1, m2, total, tkk, tke,
+             self._closed_dev, self._clicks_dev, self.lat_hist) = out
+            self.cms = salsa.SalsaState(table, m1, m2, total)
+        else:
+            (lt, ss, ck, wm, dr, table, total, tkk, tke,
+             self._closed_dev, self._clicks_dev, self.lat_hist) = out
+            self.cms = cms.CMSState(table, total)
         self.state = session.SessionState(lt, ss, ck, wm, dr)
-        self.cms = cms.CMSState(table, total)
         self.topk = cms.TopKState(tkk, tke)
 
     def _device_step(self, batch) -> None:
-        fn = _build_session_step(self.mesh, self.gap_ms, self.lateness,
-                                 self.user_capacity)
+        build = (_build_session_step_salsa if self.cms_mode == "salsa"
+                 else _build_session_step)
+        fn = build(self.mesh, self.gap_ms, self.lateness,
+                   self.user_capacity)
         self._uncarry(fn(*self._carry(), self._now_rel(),
                          jnp.asarray(batch.user_idx),
                          jnp.asarray(batch.event_type),
@@ -1366,8 +1617,14 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
                          jnp.asarray(batch.valid)))
 
     def _device_scan(self, user_idx, event_type, event_time, valid) -> None:
-        fn = _build_session_scan(self.mesh, self.gap_ms, self.lateness,
-                                 self.user_capacity, True)
+        if self.cms_mode == "salsa":
+            fn = _build_session_scan_salsa(self.mesh, self.gap_ms,
+                                           self.lateness,
+                                           self.user_capacity)
+        else:
+            fn = _build_session_scan(self.mesh, self.gap_ms,
+                                     self.lateness, self.user_capacity,
+                                     True)
         self._uncarry(fn(*self._carry(), self._now_rel(), user_idx,
                          event_type, event_time, valid))
 
@@ -1389,11 +1646,17 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
         zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
         carry = self._carry()
         now = jnp.int32(0)
-        step_fn = _build_session_step(self.mesh, self.gap_ms,
-                                      self.lateness, self.user_capacity)
-        scan_fn = _build_session_scan(self.mesh, self.gap_ms,
-                                      self.lateness, self.user_capacity,
-                                      True)
+        if self.cms_mode == "salsa":
+            step_fn = _build_session_step_salsa(
+                self.mesh, self.gap_ms, self.lateness, self.user_capacity)
+            scan_fn = _build_session_scan_salsa(
+                self.mesh, self.gap_ms, self.lateness, self.user_capacity)
+        else:
+            step_fn = _build_session_step(
+                self.mesh, self.gap_ms, self.lateness, self.user_capacity)
+            scan_fn = _build_session_scan(
+                self.mesh, self.gap_ms, self.lateness, self.user_capacity,
+                True)
         report = {
             "batch_events": B,
             "scan_batches": k,
@@ -1410,8 +1673,9 @@ class ShardedSessionCMSEngine(SessionCMSEngine):
         return report
 
     def _sharded_flush(self, force: bool) -> None:
-        fn = _build_session_flush(self.mesh, self.gap_ms, self.lateness,
-                                  force)
+        build = (_build_session_flush_salsa if self.cms_mode == "salsa"
+                 else _build_session_flush)
+        fn = build(self.mesh, self.gap_ms, self.lateness, force)
         self._uncarry(fn(*self._carry(), self._now_rel()))
 
     def _drain_device(self) -> None:

@@ -1,0 +1,270 @@
+"""Hyper-extended HLL ladder (ops/hllx.py, ISSUE 13): the fold vs a
+numpy hash-mirror register oracle, rung-0 bit-identity with the plain
+user HLL, scan/packed-scan bit-identity, the shard-order-invariant
+merge algebra, calibrated estimator accuracy vs exact numpy counts, and
+the engine's close-row + checkpoint round-trip."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.ops import hllx
+from streambench_tpu.reach import oracle as ro
+
+C, G, R = 5, 8, 128
+JOIN = np.array([0, 0, 1, 2, 3, 4, -1], np.int32)
+
+
+def rand_batch(rng, B=256, ads=6, users=300):
+    t0 = int(rng.integers(0, 10**6))
+    return dict(
+        ad_idx=rng.integers(0, ads, B).astype(np.int32),
+        user_idx=rng.integers(0, users, B).astype(np.int32),
+        event_type=rng.integers(0, 3, B).astype(np.int32),
+        event_time=(t0 + 10 * np.arange(B)).astype(np.int32),
+        valid=rng.random(B) > 0.15,
+    )
+
+
+def fold(state, batches):
+    join = jnp.asarray(JOIN)
+    for b in batches:
+        state = hllx.step(state, join, jnp.asarray(b["ad_idx"]),
+                          jnp.asarray(b["user_idx"]),
+                          jnp.asarray(b["event_type"]),
+                          jnp.asarray(b["event_time"]),
+                          jnp.asarray(b["valid"]))
+    return state
+
+
+def oracle_registers(batches):
+    """Independent numpy mirror of the ladder fold (reach.oracle hash
+    mirrors; scalar loop, no vectorized sharing with the op)."""
+    regs = np.zeros((C, G, R), np.int32)
+    totals = np.zeros(C, np.int64)
+    salts = ro.salts_np(G)
+    p = R.bit_length() - 1
+    for b in batches:
+        for a, u, e, t, v in zip(b["ad_idx"], b["user_idx"],
+                                 b["event_type"], b["event_time"],
+                                 b["valid"]):
+            camp = JOIN[a]
+            if not (v and e == 0 and camp >= 0):
+                continue
+            totals[camp] += 1
+            hu = ro.splitmix32_np(np.array([u], np.int32))[0]
+            ht = ro.splitmix32_np(np.array([t], np.int32))[0]
+            he = ro.splitmix32_np(
+                np.array([hu ^ ht], np.uint32).astype(np.int64)
+                .astype(np.int32))[0]
+            for g in range(G):
+                tok = np.uint32(he) & np.uint32((1 << g) - 1)
+                if g == 0:
+                    h = np.uint32(hu)
+                else:
+                    h = ro.splitmix32_np(
+                        np.array([np.uint32(hu) ^ salts[g] ^ tok],
+                                 np.uint32).astype(np.int64)
+                        .astype(np.int32))[0]
+                j = int(np.uint32(h) & np.uint32(R - 1))
+                rank = int(ro.rank_np(np.array([h], np.uint32), p)[0])
+                regs[camp, g, j] = max(regs[camp, g, j], rank)
+    return regs, totals
+
+
+# --------------------------------------------------------------- fold
+def test_step_matches_numpy_register_oracle():
+    rng = np.random.default_rng(3)
+    batches = [rand_batch(rng, B=64) for _ in range(3)]
+    st = fold(hllx.init_state(C, G, R), batches)
+    regs, totals = oracle_registers(batches)
+    np.testing.assert_array_equal(np.asarray(st.registers), regs)
+    np.testing.assert_array_equal(np.asarray(st.totals), totals)
+    assert int(st.dropped) == 0
+
+
+def test_rung0_bit_identical_to_plain_user_hll():
+    """The distinct rung hashes the bare user mix — its registers must
+    equal a windowless fold of ops/hll.py's hash over the same users
+    (the hllx engine's distinct answer IS the plain HLL answer)."""
+    from streambench_tpu.ops.hll import splitmix32, _rank
+
+    rng = np.random.default_rng(5)
+    batches = [rand_batch(rng) for _ in range(4)]
+    st = fold(hllx.init_state(C, G, R), batches)
+    want = np.zeros((C, R), np.int32)
+    p = R.bit_length() - 1
+    for b in batches:
+        h = np.asarray(splitmix32(jnp.asarray(b["user_idx"])))
+        j = (h & np.uint32(R - 1)).astype(np.int64)
+        rank = np.asarray(_rank(jnp.asarray(h), p))
+        camp = JOIN[b["ad_idx"]]
+        ok = b["valid"] & (b["event_type"] == 0) & (camp >= 0)
+        for c, jj, r, o in zip(camp, j, rank, ok):
+            if o:
+                want[c, jj] = max(want[c, jj], r)
+    np.testing.assert_array_equal(np.asarray(st.registers[:, 0, :]),
+                                  want)
+
+
+def test_scan_and_packed_scan_bit_identical():
+    from streambench_tpu.ops import windowcount as wc
+
+    rng = np.random.default_rng(6)
+    batches = [rand_batch(rng, B=128) for _ in range(4)]
+    seq = fold(hllx.init_state(C, G, R), batches)
+    stacked = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+    scanned = hllx.scan_steps(
+        hllx.init_state(C, G, R), jnp.asarray(JOIN),
+        jnp.asarray(stacked["ad_idx"]), jnp.asarray(stacked["user_idx"]),
+        jnp.asarray(stacked["event_type"]),
+        jnp.asarray(stacked["event_time"]), jnp.asarray(stacked["valid"]))
+    for a, b in zip(seq, scanned):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    packed = np.stack([np.asarray(wc.pack_columns(
+        b["ad_idx"], b["event_type"], b["valid"])) for b in batches])
+    pscan = hllx.scan_steps_packed(
+        hllx.init_state(C, G, R), jnp.asarray(JOIN), jnp.asarray(packed),
+        jnp.asarray(stacked["user_idx"]),
+        jnp.asarray(stacked["event_time"]))
+    np.testing.assert_array_equal(np.asarray(seq.registers),
+                                  np.asarray(pscan.registers))
+    np.testing.assert_array_equal(np.asarray(seq.totals),
+                                  np.asarray(pscan.totals))
+
+
+def test_replay_is_idempotent():
+    """Folding the same batches twice changes no registers (the
+    at-least-once replay property the time-derived token buys) — only
+    the exact F1 counter double-counts, as documented."""
+    rng = np.random.default_rng(7)
+    batches = [rand_batch(rng) for _ in range(3)]
+    once = fold(hllx.init_state(C, G, R), batches)
+    twice = fold(once, batches)
+    np.testing.assert_array_equal(np.asarray(once.registers),
+                                  np.asarray(twice.registers))
+
+
+# ------------------------------------------------------- merge algebra
+@pytest.mark.parametrize("seed", [11, 12])
+def test_merge_shard_order_invariance(seed):
+    rng = np.random.default_rng(seed)
+    pyrng = random.Random(seed)
+    batches = [rand_batch(rng, B=128) for _ in range(8)]
+    reference = fold(hllx.init_state(C, G, R), batches)
+    S = pyrng.choice([2, 3])
+    shards = [[] for _ in range(S)]
+    for b in batches:
+        shards[pyrng.randrange(S)].append(b)
+    partials = [fold(hllx.init_state(C, G, R), sh) for sh in shards]
+    pyrng.shuffle(partials)
+    merged = partials[0]
+    for p in partials[1:]:
+        merged = hllx.merge(merged, p)
+    np.testing.assert_array_equal(np.asarray(merged.registers),
+                                  np.asarray(reference.registers))
+    np.testing.assert_array_equal(np.asarray(merged.totals),
+                                  np.asarray(reference.totals))
+
+
+def test_merge_geometry_mismatch_raises():
+    a = hllx.init_state(C, G, R)
+    b = hllx.init_state(C, G, 64)
+    with pytest.raises(ValueError, match=r"hllx\.merge.*128.*64"):
+        hllx.merge(a, b)
+
+
+# ----------------------------------------------------------- estimators
+def test_moments_track_exact_statistics():
+    """Seeded Zipf workload: distinct within HLL error, calibrated
+    log-moment within 15%, soft caps within 4 sigma of their exact
+    soft-cap values, F1 exact."""
+    rng = np.random.default_rng(21)
+    st = hllx.init_state(C, G, R)
+    events = []
+    for c in range(C):
+        counts = np.minimum(rng.zipf(1.3, 400), 128)
+        for k, n in enumerate(counts):
+            events.extend((c, c * 100_000 + k) for _ in range(n))
+    rng.shuffle(events)
+    ev = np.array(events, np.int64)
+    times = (10 * np.arange(len(ev))).astype(np.int32)
+    B = 512
+    ad_of_c = np.array([0, 2, 3, 4, 5], np.int32)  # one ad per campaign
+    for i in range(0, len(ev), B):
+        n = min(B, len(ev) - i)
+        pad = B - n
+        st = hllx.step(
+            st, jnp.asarray(JOIN),
+            jnp.asarray(np.concatenate(
+                [ad_of_c[ev[i:i + n, 0]], np.zeros(pad)]).astype(np.int32)),
+            jnp.asarray(np.concatenate(
+                [ev[i:i + n, 1], np.zeros(pad)]).astype(np.int32)),
+            jnp.zeros((B,), jnp.int32),
+            jnp.asarray(np.concatenate(
+                [times[i:i + n], np.zeros(pad)]).astype(np.int32)),
+            jnp.asarray(np.concatenate(
+                [np.ones(n, bool), np.zeros(pad, bool)])))
+    m = {k: np.asarray(v) for k, v in hllx.moments(st).items()}
+    from collections import Counter
+    cnt = Counter((int(c), int(u)) for c, u in ev)
+    for c in range(C):
+        cs = np.array([n for (cc, _), n in cnt.items() if cc == c])
+        assert abs(m["distinct"][c] - len(cs)) / len(cs) < 0.2
+        logm = np.log2(1 + cs).sum()
+        assert abs(m["log_moment"][c] - logm) / logm < 0.15, (
+            c, logm, m["log_moment"][c])
+        assert int(m["totals"][c]) == int(cs.sum())
+        for g in (2, 4, 6):
+            t = 1 << g
+            exact_sc = (t * (1 - (1 - 1 / t) ** cs)).sum()
+            rel = abs(m["softcap"][c, g] - exact_sc) / max(exact_sc, 1)
+            assert rel < 4 * 1.04 / np.sqrt(R), (c, g, rel)
+
+
+# --------------------------------------------------------------- engine
+def test_hllx_engine_end_to_end_and_checkpoint(tmp_path):
+    from streambench_tpu.config import default_config
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.engine import StreamRunner
+    from streambench_tpu.engine.sketches import HLLXEngine
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.journal import FileBroker
+    from streambench_tpu.io.redis_schema import as_redis
+
+    cfg = default_config(jax_batch_size=512)
+    r = as_redis(FakeRedisStore())
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(r, cfg, broker=broker, events_num=6000,
+                 rng=random.Random(77), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    eng = HLLXEngine(cfg, mapping, redis=r)
+    stats = StreamRunner(eng, broker.reader(cfg.kafka_topic)).run_catchup()
+    assert stats.events == 6000 and eng.dropped == 0
+    m = eng.moments()
+    assert int(m["totals"].sum()) > 0
+    # F1 == exact wanted views (the engine's own counter is exact)
+    import json as _json
+    views = sum(1 for line in broker.read_all(cfg.kafka_topic)
+                if _json.loads(line)["event_type"] == "view")
+    assert int(m["totals"].sum()) == views
+
+    snap = eng.snapshot(offset=7)
+    eng2 = HLLXEngine(cfg, mapping, redis=None)
+    eng2.restore(snap)
+    np.testing.assert_array_equal(np.asarray(eng.state.registers),
+                                  np.asarray(eng2.state.registers))
+    np.testing.assert_array_equal(np.asarray(eng.state.totals),
+                                  np.asarray(eng2.state.totals))
+
+    eng.close()
+    rows = r.hgetall(f"{cfg.redis_hashtable}_hllx")
+    assert rows and any(str(k).endswith(":distinct") for k in rows), \
+        list(rows)[:4]
+    # close rows agree with the device estimates
+    names = list(eng.encoder.campaigns)
+    c0 = next(c for c in range(len(names)) if m["totals"][c] > 0)
+    assert int(rows[f"{names[c0]}:views"]) == int(m["totals"][c0])

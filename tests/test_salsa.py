@@ -1,0 +1,386 @@
+"""SALSA merge-on-overflow sketch (ops/salsa.py, ISSUE 13): the
+transition vs its closed-form numpy oracle (the homomorphism property
+means the expected state is a pure function of exact per-cell totals),
+hand-pinned overflow/merge promotions, the shard-order-invariant merge
+algebra (mirroring tests/test_minhash.py), the SF two-stage mode, the
+geometry-validated merges across every sketch family, and the session
+engine in salsa mode — fixed-mode A/B, kill/resume with merged bitmaps
+live."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.ops import cms, hll, minhash, salsa
+
+D, W = 4, 64
+
+
+def rand_batch(rng, B=128, keyspace=48, wmax=120):
+    return (rng.integers(0, keyspace, B).astype(np.int32),
+            rng.integers(0, wmax, B).astype(np.int32),
+            rng.random(B) > 0.2)
+
+
+def fold(state, batches):
+    for k, w, m in batches:
+        state = salsa.update(state, jnp.asarray(k), jnp.asarray(w),
+                             jnp.asarray(m))
+    return state
+
+
+def assert_state_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.table), np.asarray(b.table))
+    np.testing.assert_array_equal(np.asarray(a.m1), np.asarray(b.m1))
+    np.testing.assert_array_equal(np.asarray(a.m2), np.asarray(b.m2))
+    assert int(a.total) == int(b.total)
+
+
+# ----------------------------------------------------- oracle differential
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_update_matches_closed_form_oracle(seed):
+    """Arbitrary batch sequence -> state == oracle_encode(exact totals)
+    bit for bit, and query == the oracle's final-geometry read."""
+    rng = np.random.default_rng(seed)
+    batches = [rand_batch(rng) for _ in range(6)]
+    st = fold(salsa.init_state(D, W), batches)
+    tot = salsa.oracle_totals_np(batches, D, W)
+    table, m1, m2 = salsa.oracle_encode_np(tot)
+    np.testing.assert_array_equal(np.asarray(st.table), table)
+    np.testing.assert_array_equal(np.asarray(st.m1), m1)
+    np.testing.assert_array_equal(np.asarray(st.m2), m2)
+    keys = np.arange(48, dtype=np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(salsa.query(st, jnp.asarray(keys))),
+        salsa.oracle_query_np(tot, keys))
+
+
+def test_estimates_upper_bound_exact_counts():
+    rng = np.random.default_rng(3)
+    batches = [rand_batch(rng) for _ in range(8)]
+    st = fold(salsa.init_state(D, W), batches)
+    exact = np.zeros(48, np.int64)
+    for k, w, m in batches:
+        np.add.at(exact, k, np.where(m, w, 0))
+    got = np.asarray(salsa.query(
+        st, jnp.asarray(np.arange(48, dtype=np.int32))))
+    assert (got >= exact).all()
+
+
+def test_cell_bits_16_starts_pair_merged():
+    rng = np.random.default_rng(4)
+    batches = [rand_batch(rng) for _ in range(4)]
+    st = fold(salsa.init_state(D, W, cell_bits=16), batches)
+    tot = salsa.oracle_totals_np(batches, D, W)
+    table, m1, m2 = salsa.oracle_encode_np(tot, cell_bits=16)
+    np.testing.assert_array_equal(np.asarray(st.table), table)
+    np.testing.assert_array_equal(np.asarray(st.m1), m1)
+    np.testing.assert_array_equal(np.asarray(st.m2), m2)
+    assert salsa.stats(st)["merged_pairs"] == D * W // 2
+
+
+# --------------------------------------------------- overflow transitions
+def test_overflow_promotes_pair_then_quad():
+    """Hand-pinned promotion ladder for one key: solo byte until 255,
+    16-bit pair past it, 32-bit quad past 65535 — merge bits and the
+    decoded value checked at each stage."""
+    key = jnp.asarray(np.zeros(1, np.int32))
+    one = jnp.asarray(np.ones(1, np.int32))
+    valid = jnp.asarray(np.ones(1, bool))
+
+    st = salsa.init_state(D, W)
+    st = salsa.update(st, key, jnp.asarray(np.array([200], np.int32)),
+                      valid)
+    s = salsa.stats(st)
+    assert s["merged_pairs"] == 0 and s["merged_quads"] == 0
+    assert int(salsa.query(st, key)[0]) == 200
+
+    # cross 255: every row's cell overflows its byte -> D pair merges
+    st = salsa.update(st, key, jnp.asarray(np.array([100], np.int32)),
+                      valid)
+    s = salsa.stats(st)
+    assert s["merged_pairs"] == D and s["merged_quads"] == 0
+    assert int(salsa.query(st, key)[0]) == 300
+
+    # cross 65535: the merged pairs overflow 16 bits -> D quad merges
+    st = salsa.update(st, key, jnp.asarray(np.array([70_000], np.int32)),
+                      valid)
+    s = salsa.stats(st)
+    assert s["merged_quads"] == D and s["merged_pairs"] == 2 * D
+    assert int(salsa.query(st, key)[0]) == 70_300
+    # a single update may promote solo -> quad directly
+    st2 = salsa.update(salsa.init_state(D, W), key,
+                       jnp.asarray(np.array([100_000], np.int32)), valid)
+    assert salsa.stats(st2)["merged_quads"] == D
+    assert int(salsa.query(st2, key)[0]) == 100_000
+    assert int(st.total) == 70_300 and int(st2.total) == 100_000
+    _ = one  # noqa: F841
+
+
+def test_colliding_keys_merge_and_stay_upper_bounds():
+    """Sum-on-merge (the deviation from SALSA's max, module docstring):
+    two keys sharing row 0's CELL push its total past a byte; the pair
+    widens and both keys report the summed (upper-bound) value."""
+    st = salsa.init_state(D, W)
+    cols = salsa.oracle_cols_np(np.arange(4096, dtype=np.int32), D, W)
+    k0 = 0
+    sib = np.nonzero((cols[0] == cols[0][k0])
+                     & (np.arange(4096) != k0))[0]
+    assert sib.size, "no row-0 cell collision in 4096 keys"
+    k1 = int(sib[0])
+    keys = jnp.asarray(np.array([k0, k1], np.int32))
+    st = salsa.update(st, keys,
+                      jnp.asarray(np.array([200, 200], np.int32)),
+                      jnp.asarray(np.ones(2, bool)))
+    got = np.asarray(salsa.query(st, keys))
+    assert (got >= 200).all()
+    # row 0's cell totals 400 > 255 -> its pair merged
+    assert salsa.stats(st)["merged_pairs"] >= 1
+
+
+# ------------------------------------------------------- merge algebra
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_merge_shard_order_invariance(seed):
+    """Random shard split + arbitrary merge order -> bit-identical
+    plane, equal to the single-engine fold (the homomorphism)."""
+    rng = np.random.default_rng(seed)
+    pyrng = random.Random(seed)
+    batches = [rand_batch(rng, wmax=300) for _ in range(10)]
+    reference = fold(salsa.init_state(D, W), batches)
+    S = pyrng.choice([2, 3, 4])
+    shards = [[] for _ in range(S)]
+    for b in batches:
+        shards[pyrng.randrange(S)].append(b)
+    partials = [fold(salsa.init_state(D, W), sh) for sh in shards]
+    pyrng.shuffle(partials)
+    merged = partials[0]
+    for p in partials[1:]:
+        merged = salsa.merge(merged, p)
+    assert_state_equal(merged, reference)
+
+
+def test_merge_commutative_associative():
+    rng = np.random.default_rng(7)
+    sts = [fold(salsa.init_state(D, W), [rand_batch(rng, wmax=200)
+                                         for _ in range(2)])
+           for _ in range(3)]
+    a, b, c = sts
+    assert_state_equal(salsa.merge(a, b), salsa.merge(b, a))
+    assert_state_equal(salsa.merge(salsa.merge(a, b), c),
+                       salsa.merge(a, salsa.merge(b, c)))
+
+
+# --------------------------------------------------------- two-stage CMS
+def test_two_stage_upper_bound_and_small_reads():
+    rng = np.random.default_rng(9)
+    st = cms.init_two_stage(depth=4, width=512, small_width=64)
+    exact = np.zeros(64, np.int64)
+    for _ in range(6):
+        k, w, m = rand_batch(rng, keyspace=64)
+        exact_w = np.where(m, w, 0)
+        np.add.at(exact, k, exact_w)
+        st = cms.update2(st, jnp.asarray(k), jnp.asarray(w),
+                         jnp.asarray(m))
+    keys = jnp.asarray(np.arange(64, dtype=np.int32))
+    small = np.asarray(cms.query_small(st, keys))
+    fat = np.asarray(cms.query(st.fat, keys))
+    seen = exact > 0
+    assert (small[seen] >= exact[seen]).all()
+    assert (fat[seen] >= exact[seen]).all()
+    # point_query dispatch reads the small stage for CMS2State
+    np.testing.assert_array_equal(
+        np.asarray(cms.point_query(st, keys)), small)
+    assert int(cms.sk_total(st)) == int(exact.sum())
+
+
+def test_two_stage_merge_refuses():
+    a = cms.init_two_stage(depth=4, width=256)
+    with pytest.raises(ValueError, match="does not merge"):
+        cms.merge2(a, a)
+
+
+# --------------------------------------- geometry-validated merges (all)
+def test_salsa_merge_geometry_mismatch_raises():
+    a = salsa.init_state(4, 64)
+    b = salsa.init_state(4, 128)
+    with pytest.raises(ValueError, match=r"salsa\.merge.*64.*128"):
+        salsa.merge(a, b)
+
+
+def test_cms_merge_geometry_mismatch_raises():
+    a = cms.init_state(depth=4, width=64)
+    b = cms.init_state(depth=2, width=64)
+    with pytest.raises(ValueError, match=r"cms\.merge.*\(4, 64\).*\(2, 64\)"):
+        cms.merge(a, b)
+
+
+def test_hll_merge_geometry_mismatch_raises():
+    a = hll.init_state(4, 8, num_registers=32)
+    b = hll.init_state(4, 8, num_registers=64)
+    with pytest.raises(ValueError, match=r"hll\.merge.*32.*64"):
+        hll.merge(a, b)
+    # a differing window axis is caught by the register check too
+    c = hll.init_state(4, 16, num_registers=32)
+    with pytest.raises(ValueError, match=r"hll\.merge"):
+        hll.merge(a, c)
+    # hand-built ring drift (registers equal, ring not): named error
+    d = hll.HLLState(registers=a.registers,
+                     window_ids=jnp.zeros((5,), jnp.int32),
+                     watermark=a.watermark, dropped=a.dropped)
+    with pytest.raises(ValueError, match="window-ring"):
+        hll.merge(a, d)
+
+
+def test_hll_merge_valid_states():
+    """Merging same-ring partials: registers max, dropped summed."""
+    a = hll.init_state(3, 4, num_registers=32)
+    b = hll.init_state(3, 4, num_registers=32)
+    ra = a.registers.at[0, 0, 0].set(5)
+    rb = b.registers.at[0, 0, 0].set(3)
+    m = hll.merge(a._replace(registers=ra, dropped=jnp.int32(2)),
+                  b._replace(registers=rb, dropped=jnp.int32(1)))
+    assert int(m.registers[0, 0, 0]) == 5 and int(m.dropped) == 3
+
+
+def test_minhash_merge_geometry_mismatch_raises():
+    a = minhash.init_state(4, k=32, num_registers=32)
+    b = minhash.init_state(4, k=64, num_registers=32)
+    with pytest.raises(ValueError, match=r"minhash\.merge.*32.*64"):
+        minhash.merge(a, b)
+    c = minhash.init_state(4, k=32, num_registers=64)
+    with pytest.raises(ValueError, match="register mismatch"):
+        minhash.merge(a, c)
+
+
+# ------------------------------------------------- session engine, salsa
+def _session_world(tmp_path, events=8000, seed=77):
+    from streambench_tpu.config import default_config
+    from streambench_tpu.datagen import gen
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.journal import FileBroker
+    from streambench_tpu.io.redis_schema import as_redis
+
+    cfg = default_config(jax_batch_size=512)
+    broker = FileBroker(str(tmp_path / "broker"))
+    gen.do_setup(as_redis(FakeRedisStore()), cfg, broker=broker,
+                 events_num=events, rng=random.Random(seed),
+                 workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    return cfg, broker, mapping
+
+
+def test_session_engine_salsa_matches_fixed_rows(tmp_path):
+    """At no-overflow scale the SALSA plane shares the fixed sketch's
+    hash and min-read, so the heavy-hitter report is IDENTICAL — the
+    A/B oracle the CI session leg runs at engine-CLI level."""
+    from streambench_tpu.engine import StreamRunner
+    from streambench_tpu.engine.sketches import SessionCMSEngine
+    from streambench_tpu.io.fakeredis import FakeRedisStore
+    from streambench_tpu.io.redis_schema import as_redis
+
+    cfg, broker, mapping = _session_world(tmp_path)
+
+    def run(mode):
+        eng = SessionCMSEngine(cfg, mapping,
+                               redis=as_redis(FakeRedisStore()),
+                               top_k=8, cms_mode=mode)
+        StreamRunner(eng, broker.reader(cfg.kafka_topic)).run_catchup()
+        eng.close()     # force-closes the open sessions into the sketch
+        return eng, eng.heavy_hitters()
+
+    e_fix, hh_fix = run("fixed")
+    e_sal, hh_sal = run("salsa")
+    assert hh_fix, "no heavy hitters closed — workload drifted"
+    assert hh_fix == hh_sal
+    assert e_sal.sessions_closed == e_fix.sessions_closed
+    assert e_sal.session_clicks == e_fix.session_clicks
+    # the memory claim, ledger-measured: >3.5x smaller state
+    fix_b = e_fix.sketch_summary()["state_bytes"]
+    sal_b = e_sal.sketch_summary()["state_bytes"]
+    assert sal_b * 3.5 < fix_b, (sal_b, fix_b)
+
+
+def test_session_engine_salsa_checkpoint_roundtrip_with_merges(tmp_path):
+    """Kill/resume with merged bitmaps LIVE: fold enough weight through
+    one user to force pair merges, snapshot, restore into a fresh
+    engine, and continue — plane, bitmaps, ring, and counters must
+    round-trip exactly and the continued fold must equal the
+    uninterrupted one."""
+    import jax.numpy as jnp  # noqa: F811
+    from streambench_tpu.config import default_config
+    from streambench_tpu.engine.sketches import SessionCMSEngine
+
+    cfg = default_config(jax_batch_size=256)
+    mapping = {"a": "c"}
+
+    def feed(eng, lo, hi, seed):
+        # heavy per-user click streams with 2s gaps -> closures whose
+        # weights push cells past 255 (gap_ms=1000 below)
+        rng = np.random.default_rng(seed)
+        t = lo
+        while t < hi:
+            B = 256
+            user = rng.integers(0, 50, B).astype(np.int32)
+            et = np.ones(B, np.int32)            # all clicks
+            tm = (t + np.sort(rng.integers(0, 1_000, B))).astype(np.int32)
+            valid = np.ones(B, bool)
+            eng._device_step(type("B", (), dict(
+                user_idx=user, event_type=et, event_time=tm,
+                valid=valid))())
+            t += 3_000
+        eng._drain_device()
+
+    def mk():
+        return SessionCMSEngine(cfg, mapping, campaigns=["c"],
+                                gap_ms=1_000, cms_mode="salsa",
+                                cms_width=64)
+
+    a = mk()
+    feed(a, 0, 60_000, seed=1)
+    assert salsa.stats(a.cms)["merged_pairs"] > 0, \
+        "no merges — the round-trip would not cover live bitmaps"
+    snap = a.snapshot(offset=123)
+
+    b = mk()
+    b.restore(snap)
+    assert_state_equal(a.cms, b.cms)
+    assert b.sessions_closed == a.sessions_closed
+    assert b.session_clicks == a.session_clicks
+    np.testing.assert_array_equal(np.asarray(a.topk.keys),
+                                  np.asarray(b.topk.keys))
+
+    # continue both: uninterrupted vs resumed must stay bit-identical
+    # (ring compared directly — this test feeds raw indices past the
+    # encoder, so there are no interned names to reverse-look-up)
+    feed(a, 60_000, 120_000, seed=2)
+    feed(b, 60_000, 120_000, seed=2)
+    assert_state_equal(a.cms, b.cms)
+    np.testing.assert_array_equal(np.asarray(a.topk.keys),
+                                  np.asarray(b.topk.keys))
+    np.testing.assert_array_equal(np.asarray(a.topk.ests),
+                                  np.asarray(b.topk.ests))
+
+
+def test_session_engine_mode_mismatch_restore_raises(tmp_path):
+    from streambench_tpu.config import default_config
+    from streambench_tpu.engine.sketches import SessionCMSEngine
+
+    cfg = default_config()
+    mapping = {"a": "c"}
+    a = SessionCMSEngine(cfg, mapping, campaigns=["c"], cms_mode="salsa")
+    snap = a.snapshot(offset=0)
+    b = SessionCMSEngine(cfg, mapping, campaigns=["c"], cms_mode="fixed")
+    with pytest.raises(ValueError, match="cms_mode"):
+        b.restore(snap)
+
+
+def test_session_engine_salsa_two_stage_refused():
+    from streambench_tpu.config import default_config
+    from streambench_tpu.engine.sketches import SessionCMSEngine
+
+    with pytest.raises(ValueError, match="does not compose"):
+        SessionCMSEngine(default_config(), {"a": "c"}, campaigns=["c"],
+                         cms_mode="salsa", cms_stages=2)

@@ -651,3 +651,80 @@ def test_sliding_and_session_collective_reports(tmp_path):
         zi(4, 64), jnp.zeros((4, 64), bool), scan_len=4)
     assert (rep_spb["per_dispatch"]["ops"]
             > 4 * srep["scan"]["per_dispatch"]["ops"])
+
+
+# ----------------------------------------------------------------------
+# SALSA-mode sharded session engine (ISSUE 13): the merge-on-overflow
+# plane is folded REPLICATED from the all_gathered closure rows (a
+# psum-free merge — the transition is a multiset homomorphism), so the
+# sharded per-batch arm, the hoisted scan arm, and the single-device
+# engine must all land on bit-identical planes/bitmaps.
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("dshape", [(4, 2), (2, 2)])
+def test_sharded_session_salsa_matches_single_device(dshape):
+    from streambench_tpu.engine.sketches import LAT_BINS
+    from streambench_tpu.ops import salsa
+    from streambench_tpu.parallel.sketches import (
+        _build_session_scan_salsa,
+        _build_session_step_salsa,
+    )
+
+    mesh, batches = _session_mesh_setup(dshape)
+    U, M = 64, 256
+    gap, late = 15_000, 20_000
+    now_rel = 600_000
+
+    ref = session.init_state(U)
+    ref_cms = salsa.init_state(depth=4, width=256)
+    ref_tk = cms.init_topk(M)
+    for user, et, tm, valid in batches:
+        ref, in_b, carry = session.step(ref, user, et, tm, valid,
+                                        gap_ms=gap, lateness_ms=late)
+        for closed in (in_b, carry):
+            ref_cms = salsa.update(ref_cms, closed.user, closed.clicks,
+                                   closed.valid)
+            ref_tk = cms.update_topk(ref_cms, ref_tk, closed.user,
+                                     closed.valid)
+
+    def init_carry():
+        return (jnp.full((U,), -1, jnp.int32), jnp.zeros((U,), jnp.int32),
+                jnp.zeros((U,), jnp.int32), jnp.int32(0), jnp.int32(0),
+                *salsa.init_state(depth=4, width=256),
+                jnp.full((M,), -1, jnp.int32),
+                jnp.full((M,), -1, jnp.int32),
+                jnp.int32(0), jnp.int32(0),
+                jnp.zeros((LAT_BINS,), jnp.int32))
+
+    fn = _build_session_step_salsa(mesh, gap, late, U)
+    carry_t = init_carry()
+    for user, et, tm, valid in batches:
+        carry_t = fn(*carry_t, jnp.int32(now_rel), user, et, tm, valid)
+    (lt, ss, ck, wm, dr, table, m1, m2, total, tkk, tke, cn, cl,
+     hist) = carry_t
+
+    np.testing.assert_array_equal(np.asarray(ref_cms.table),
+                                  np.asarray(table))
+    np.testing.assert_array_equal(np.asarray(ref_cms.m1), np.asarray(m1))
+    np.testing.assert_array_equal(np.asarray(ref_cms.m2), np.asarray(m2))
+    assert int(ref_cms.total) == int(total)
+    assert _ring_dict(ref_tk) == _ring_dict(cms.TopKState(tkk, tke))
+
+    # hoisted scan arm bit-identical to the per-batch arm
+    scan_fn = _build_session_scan_salsa(mesh, gap, late, U)
+    stack = [np.stack(x) for x in zip(*batches)]
+    carry_s = init_carry()
+    K = 3
+    for i in range(0, len(batches), K):
+        xs = [jnp.asarray(s[i:i + K]) for s in stack]
+        carry_s = scan_fn(*carry_s, jnp.int32(now_rel), *xs)
+    for a, b in zip(carry_t, carry_s):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_session_engine_refuses_two_stage():
+    mesh = build_mesh(data=2, campaign=1)
+    cfg = default_config(jax_cms_stages=2)
+    with pytest.raises(ValueError, match="stages=2"):
+        ShardedSessionCMSEngine(cfg, {"a": "c"}, mesh, campaigns=["c"],
+                                user_capacity=1 << 10)
