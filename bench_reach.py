@@ -121,16 +121,24 @@ def make_world(workdir: str, *, campaigns_n: int, users_n: int,
 
 
 def materialize(path: str, mapping: dict, campaigns: list, *,
-                k: int, registers: int, batch: int = 8192):
+                k: int, registers: int, batch: int = 8192, mesh=None):
     """Fold the journal through a ReachSketchEngine (block ingest where
-    the native encoder is built, line fallback otherwise)."""
+    the native encoder is built, line fallback otherwise), or through
+    the campaign-sharded ShardedReachEngine when ``mesh`` is given."""
     from streambench_tpu.config import default_config
     from streambench_tpu.engine.sketches import ReachSketchEngine
 
     cfg = default_config(jax_num_campaigns=len(campaigns),
                          jax_batch_size=batch)
-    eng = ReachSketchEngine(cfg, mapping, campaigns=campaigns,
-                            redis=None, k=k, registers=registers)
+    if mesh is not None:
+        from streambench_tpu.parallel.reach import ShardedReachEngine
+
+        eng = ShardedReachEngine(cfg, mapping, mesh,
+                                 campaigns=campaigns, redis=None,
+                                 k=k, registers=registers)
+    else:
+        eng = ReachSketchEngine(cfg, mapping, campaigns=campaigns,
+                                redis=None, k=k, registers=registers)
     eng.warmup()
     t0 = time.monotonic()
     with open(path, "rb") as f:
@@ -550,6 +558,496 @@ def run_attribution(eng, names, journal_path: str, workdir: str, *,
 
 
 # ----------------------------------------------------------------------
+# ISSUE 14 scale-out rungs
+# ----------------------------------------------------------------------
+
+def run_sharded_child(n: int) -> int:
+    """Child of ``--sharded-rung N`` (the parent pinned the virtual
+    device count in XLA_FLAGS before this process imported jax): fold
+    one journal through the single-device AND campaign-sharded reach
+    engines, assert plane + query bit-identity, and read the collective
+    table out of the compiled query program — the "exactly 2 cross-
+    shard collectives per query dispatch" acceptance."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from streambench_tpu.parallel import collectives
+    from streambench_tpu.parallel.mesh import build_mesh
+    from streambench_tpu.reach import query as rq
+
+    workdir = tempfile.mkdtemp(prefix=f"bench-reach-shard{n}-")
+    assert jax.device_count() >= n, (jax.device_count(), n)
+    k, registers = 128, 256
+    campaigns, mapping, path = make_world(
+        workdir, campaigns_n=40, users_n=3000, events_n=60_000, seed=29)
+    ref, ref_wall = materialize(path, mapping, campaigns,
+                                k=k, registers=registers)
+    names = list(ref.encoder.campaigns)
+    mesh = build_mesh(data=1, campaign=n)
+    eng, wall = materialize(path, mapping, campaigns,
+                            k=k, registers=registers, mesh=mesh)
+    host = eng.host_state()
+    assert (host.mins == np.asarray(ref.state.mins)).all(), \
+        "sharded mins != single-device"
+    assert (host.registers == np.asarray(ref.state.registers)).all(), \
+        "sharded registers != single-device"
+
+    masks, overlap = make_queries(names, 256, 31)
+    e0, u0, j0, a0 = rq.query_chunks(ref.state.mins, ref.state.registers,
+                                     masks, overlap)
+    e1, u1, j1, a1 = eng.batch_query(masks, overlap)
+    assert (a0 == a1).all(), "sharded agree counts != single-device"
+    assert (e0 == e1).all(), "sharded estimates != single-device"
+
+    report = eng.collective_report(query_batch=256)
+    q = report["query"]["per_dispatch"]
+    if n > 1:
+        assert q["ops"] == 2, q
+        assert q["by_kind"] == {"all-reduce": 2}, q
+
+    # timed query dispatch, both arms (virtual-mesh caveat applies)
+    def timed(fn, reps=5):
+        ts = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn())
+            ts.append((time.monotonic() - t0) * 1000)
+        return round(min(ts), 2)
+
+    mq = jnp.asarray(masks)
+    oq = jnp.asarray(overlap)
+    single_ms = timed(lambda: rq.batch_query(
+        ref.state.mins, ref.state.registers, mq, oq))
+    sharded_ms = timed(lambda: eng.batch_query(masks, overlap)[0])
+
+    out = {
+        "phase": f"sharded_n{n}", "devices": n,
+        "events": eng.events_processed,
+        "oracle": "bit-identical planes + queries vs single-device",
+        "bitexact": True,
+        "materialize_ev_s": int(eng.events_processed / max(wall, 1e-9)),
+        "single_ev_s": int(ref.events_processed / max(ref_wall, 1e-9)),
+        "query_collectives": {
+            "per_dispatch_ops": q["ops"],
+            "per_dispatch_bytes": q["bytes"],
+            "by_kind": q["by_kind"],
+        },
+        "scan_collectives": {
+            "per_dispatch_ops":
+                report["scan"]["per_dispatch"]["ops"],
+            "per_dispatch_bytes":
+                report["scan"]["per_dispatch"]["bytes"],
+        },
+        "query_ms_256": {"single": single_ms, "sharded": sharded_ms},
+        "ok": True,
+    }
+    print(compact_line(out), flush=True)
+    return 0
+
+
+def run_sharded_rungs(deadline: float) -> dict:
+    """Parent side: one subprocess per device count (XLA_FLAGS must be
+    pinned before jax import — the bench_multichip rule)."""
+    import re
+    import subprocess
+
+    out: dict = {}
+    for n in (1, 2, 8):
+        if time.monotonic() > deadline - 120:
+            out[f"n{n}"] = {"skipped": "budget"}
+            log(f"sharded n={n} skipped: budget")
+            continue
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--sharded-rung", str(n)],
+                env=env, capture_output=True, text=True,
+                timeout=max(deadline - time.monotonic(), 60))
+        except subprocess.TimeoutExpired:
+            out[f"n{n}"] = {"error": "timeout"}
+            continue
+        line = next((ln for ln in
+                     reversed(proc.stdout.strip().splitlines())
+                     if ln.startswith("{")), None)
+        if proc.returncode != 0 or line is None:
+            out[f"n{n}"] = {"error": "child failed", "rc": proc.returncode,
+                            "tail": proc.stderr[-500:]}
+            continue
+        out[f"n{n}"] = json.loads(line)
+        print(compact_line(out[f"n{n}"]), flush=True)
+        log(f"sharded n={n} ok: "
+            f"{out[f'n{n}']['query_collectives']['per_dispatch_ops']} "
+            f"collectives/query dispatch")
+    out["ok"] = all((out.get(f"n{n}") or {}).get("ok")
+                    for n in (1, 2, 8))
+    return out
+
+
+def run_cache_ab(eng, names, *, distinct: int = 48, repeats: int = 8,
+                 phase: str = "cache_ab") -> dict:
+    """Cache on/off A/B on a repeated-query mix, in-process against the
+    writer-attached server (the measured quantity is the server-side
+    submit -> reply latency — the layer the cache removes).  Fill phase
+    answers each distinct set once (all misses), then the repeated mix
+    storms the standing cache.  Acceptance: cache-hit p99 at least 10x
+    below the cache-miss p99."""
+    import threading
+
+    from streambench_tpu.obs import MetricsRegistry
+    from streambench_tpu.reach.cache import ReachQueryCache
+    from streambench_tpu.reach.serve import ReachQueryServer
+
+    rng = np.random.default_rng(77)
+    qsets = []
+    for _ in range(distinct):
+        sel = [names[j] for j in rng.choice(
+            len(names), size=int(rng.integers(1, 5)), replace=False)]
+        qsets.append((sel, "overlap" if rng.integers(0, 2) else "union"))
+    mix = [qsets[i % distinct] for i in range(distinct * repeats)]
+    rng.shuffle(mix)
+
+    arms: dict = {}
+    for arm in ("on", "off"):
+        reg = MetricsRegistry()
+        cache = (ReachQueryCache(4096, registry=reg)
+                 if arm == "on" else None)
+        srv = ReachQueryServer(names, depth=8192, batch=64,
+                               registry=reg, cache=cache)
+        eng.attach_reach(srv)
+        lock = threading.Lock()
+        lats: list = []
+
+        def submit_wave(wave):
+            pending = threading.Event()
+            want = len(wave)
+            for sel, op in wave:
+                t0 = time.perf_counter_ns()
+
+                def cb(d, t0=t0):
+                    with lock:
+                        lats.append(
+                            ((time.perf_counter_ns() - t0) / 1e6,
+                             bool(d.get("cached")), d))
+                        if len(lats) >= want0 + want:
+                            pending.set()
+                srv.submit(sel, op, cb)
+            pending.wait(timeout=120)
+
+        want0 = 0
+        t_fill = time.monotonic()
+        submit_wave(qsets)                       # fill: all misses
+        fill_s = time.monotonic() - t_fill
+        want0 = len(lats)
+        t_mix = time.monotonic()
+        submit_wave(mix)                         # repeated mix
+        mix_s = time.monotonic() - t_mix
+        srv.close()
+        assert len(lats) == distinct + len(mix), (len(lats), arm)
+        fill_lats = sorted(v for v, _, _ in lats[:distinct])
+        mix_rows = lats[distinct:]
+        hit_lats = sorted(v for v, c, _ in mix_rows if c)
+        miss_lats = sorted([v for v, c, _ in mix_rows if not c]
+                           or fill_lats)
+
+        def p(q, xs):
+            return round(xs[min(len(xs) - 1, int(len(xs) * q))], 3) \
+                if xs else None
+
+        arms[arm] = {
+            "queries": distinct + len(mix),
+            "fill_s": round(fill_s, 2), "mix_s": round(mix_s, 3),
+            "mix_qps": int(len(mix) / max(mix_s, 1e-9)),
+            "hits": len(hit_lats),
+            "hit_p50_ms": p(0.5, hit_lats), "hit_p99_ms": p(0.99, hit_lats),
+            "miss_p50_ms": p(0.5, miss_lats),
+            "miss_p99_ms": p(0.99, miss_lats),
+            "dispatches": srv.dispatches,
+        }
+        if cache is not None:
+            arms[arm]["cache"] = cache.summary()
+            # the repeated mix must be all hits: the fill answered every
+            # distinct set and nothing was evicted or invalidated
+            assert len(hit_lats) == len(mix), (len(hit_lats), len(mix))
+            assert all("estimate" in d for _, _, d in mix_rows)
+        else:
+            assert not hit_lats
+
+    on = arms["on"]
+    ratio = (on["miss_p99_ms"] / on["hit_p99_ms"]
+             if on["hit_p99_ms"] else None)
+    out = {"phase": phase, "distinct_sets": distinct,
+           "repeats": repeats, "arms": arms,
+           "hit_ratio": arms["on"]["cache"]["hit_ratio"],
+           "miss_over_hit_p99": round(ratio, 1) if ratio else None,
+           "speedup_qps": round(
+               on["mix_qps"] / max(arms["off"]["mix_qps"], 1), 2)}
+    assert ratio is not None and ratio >= 10.0, (
+        f"cache-hit p99 {on['hit_p99_ms']} not >= 10x below miss p99 "
+        f"{on['miss_p99_ms']}")
+    out["hit_p99_10x_below_miss"] = True
+    out["ok"] = True
+    return out
+
+
+def _merge_intervals(raw: list) -> list:
+    merged: list = []
+    for s, e in sorted(raw):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return merged
+
+
+def _overlap_ns(lo: int, hi: int, merged: list) -> int:
+    total = 0
+    for s, e in merged:
+        if e <= lo:
+            continue
+        if s >= hi:
+            break
+        total += min(hi, e) - max(lo, s)
+    return total
+
+
+def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
+                         replica_counts=(1, 2), queries_n: int = 240,
+                         gap_s: float = 0.004, ship_ms: int = 400,
+                         ingest_gap_s: float = 0.6,
+                         phase: str = "replica_scaleout") -> dict:
+    """The off-writer serving rung: the writer folds ingest and ships
+    snapshots; R replica PROCESSES tail the log and answer a storm.
+
+    Measured headlines (the 1-core-honest set): the off-writer
+    contention ratio (replica queue-waits intersected with the writer's
+    measured fold-sync windows over the shared CLOCK_MONOTONIC — the
+    REACH_r02 writer-attached baseline read 0.61 at ~30% ingest duty),
+    reply staleness vs the shipping cadence, cache behavior at the
+    replicas, and shed + served == sent with every reply epoch-stamped.
+    The throughput-vs-replicas table is recorded but the scaling CLAIM
+    is gated on cpu count: replica processes timeslice one core here.
+
+    The ingest pacing matches the baseline's ~30% duty cycle (the
+    comparison is only meaningful at matched duty): writer-attached,
+    queue waits CORRELATE with ingest busy (0.61 ≈ 2x the duty —
+    queries literally queue behind folds); off-writer they can only
+    overlap by timeslicing coincidence, so the ratio collapses toward
+    the duty floor.  Both the measured duty and the ratio/duty
+    correlation land in the artifact so the claim is auditable.
+    """
+    import signal
+    import subprocess
+    import threading
+
+    from streambench_tpu.dimensions.pubsub import PubSubClient
+    from streambench_tpu.dimensions.store import DurableDimensionStore
+    from streambench_tpu.reach.replica import SnapshotShipper
+
+    import jax
+
+    ship_dir = os.path.join(workdir, "ship")
+    store = DurableDimensionStore(ship_dir)
+    shipper = SnapshotShipper(store, names, interval_ms=ship_ms)
+    eng.attach_shipper(shipper)
+
+    ingest_stop = threading.Event()
+    busy: list = []
+    folded = {"events0": eng.events_processed, "events": 0, "wall": 0.0}
+
+    def ingest() -> None:
+        t_start = time.monotonic()
+        while not ingest_stop.is_set():
+            with open(journal_path, "rb") as f:
+                carry = b""
+                while not ingest_stop.is_set():
+                    data = f.read(128 << 10)
+                    if not data:
+                        break
+                    data = carry + data
+                    nl = data.rfind(b"\n") + 1
+                    carry = data[nl:]
+                    eng.process_block(data[:nl])
+                    t0 = time.monotonic_ns()
+                    jax.block_until_ready(eng.state.mins)
+                    busy.append((t0, time.monotonic_ns()))
+                    eng.flush()      # push -> ship at cadence
+                    folded["events"] = (eng.events_processed
+                                        - folded["events0"])
+                    folded["wall"] = time.monotonic() - t_start
+                    time.sleep(ingest_gap_s)
+
+    t_ing = threading.Thread(target=ingest, daemon=True)
+    t_ing.start()
+
+    ladder: dict = {}
+    all_waits: list = []
+    try:
+        for n_rep in replica_counts:
+            procs = []
+            addrs = []
+            for _ in range(n_rep):
+                p = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "streambench_tpu.reach.replica",
+                     "--ship", ship_dir, "--poll-ms", "150",
+                     "--batch", "64", "--dump-queue-waits"],
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                    cwd=REPO, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True)
+                procs.append(p)
+            for p in procs:
+                line = p.stdout.readline()
+                assert line.startswith("replica: pubsub="), line
+                hp = line.split("pubsub=")[1].split()[0]
+                host, port = hp.rsplit(":", 1)
+                addrs.append((host, int(port)))
+            log(f"{n_rep} replica(s) up: {addrs}")
+
+            answers: list = [[] for _ in addrs]
+
+            def storm(ci: int) -> None:
+                host, port = addrs[ci]
+                c = PubSubClient(host, port, timeout_s=120)
+                # wait until this replica actually serves (first poll
+                # must load a shipped record; shed-only replies mean
+                # not ready — retry a few times, they COUNT as sheds
+                # in the replica's ledger but not in this storm's)
+                for _ in range(100):
+                    c.request({"type": "reach", "campaigns": [names[0]],
+                               "op": "union", "id": "warm"})
+                    if "estimate" in c.recv()["data"]:
+                        break
+                    time.sleep(0.2)
+                rng = np.random.default_rng(1000 + ci)
+                pending = 0
+                for qi in range(queries_n):
+                    sel = [names[j] for j in rng.choice(
+                        len(names), size=int(rng.integers(1, 4)),
+                        replace=False)]
+                    c.request({"type": "reach", "campaigns": sel,
+                               "op": "overlap" if qi % 2 else "union",
+                               "id": qi})
+                    pending += 1
+                    while pending > 32:
+                        answers[ci].append(c.recv()["data"])
+                        pending -= 1
+                    time.sleep(gap_s)
+                for _ in range(pending):
+                    answers[ci].append(c.recv()["data"])
+                c.close()
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=storm, args=(ci,))
+                       for ci in range(n_rep)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            storm_s = time.monotonic() - t0
+
+            stats = []
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+                out_tail, _ = p.communicate(timeout=60)
+                line = next((ln for ln in
+                             reversed(out_tail.strip().splitlines())
+                             if ln.startswith("{")), "{}")
+                stats.append(json.loads(line))
+
+            flat = [d for got in answers for d in got]
+            served = [d for d in flat if "estimate" in d]
+            shed = [d for d in flat if d.get("shed")]
+            assert len(served) + len(shed) == n_rep * queries_n, (
+                len(served), len(shed))
+            assert served, "replica storm served nothing"
+            # every reply epoch-stamped; every served one staleness-
+            # stamped and inside the replica staleness bound
+            assert all("plane_epoch" in d for d in flat)
+            stales = [d["staleness_ms"] for d in served]
+            assert all(s <= 10_000 for s in stales), max(stales)
+            cache_hits = sum(
+                ((s.get("serve") or {}).get("cache") or {}).get(
+                    "hits", 0) for s in stats)
+            for s in stats:
+                waits = s.get("queue_waits_ns") or []
+                all_waits.extend(waits)
+            stales_sorted = sorted(stales)
+            ladder[f"r{n_rep}"] = {
+                "replicas": n_rep,
+                "sent": n_rep * queries_n,
+                "served": len(served), "shed": len(shed),
+                "qps": round(len(served) / max(storm_s, 1e-9), 1),
+                "storm_s": round(storm_s, 2),
+                "cache_hits": cache_hits,
+                "staleness_p50_ms": stales_sorted[len(stales) // 2],
+                "staleness_max_ms": stales_sorted[-1],
+                "epoch_stamped": True,
+                "ingest_events_folded": folded["events"],
+            }
+            log(f"replicas={n_rep}: qps {ladder[f'r{n_rep}']['qps']} "
+                f"staleness p50 "
+                f"{ladder[f'r{n_rep}']['staleness_p50_ms']} ms")
+    finally:
+        ingest_stop.set()
+        t_ing.join(timeout=60)
+        store.close()
+
+    # off-writer contention: replica queue waits (their processes'
+    # CLOCK_MONOTONIC) vs the writer's measured fold-sync windows
+    merged_busy = _merge_intervals([list(b) for b in busy])
+    wait_total = sum(max(b - a, 0) for a, b in all_waits)
+    overlap = sum(_overlap_ns(a, b, merged_busy)
+                  for a, b in all_waits if b > a)
+    ratio = round(overlap / wait_total, 4) if wait_total else 0.0
+    # writer busy duty over the measurement span: the coincidence
+    # floor — off-writer, a queue wait can only overlap ingest busy by
+    # timeslicing chance, so ratio ≈ duty; writer-attached the
+    # baseline read ~2x its duty (waits queued BEHIND folds)
+    busy_ns = sum(e - s for s, e in merged_busy)
+    span_ns = (merged_busy[-1][1] - merged_busy[0][0]) if merged_busy \
+        else 0
+    duty = round(busy_ns / span_ns, 4) if span_ns else 0.0
+    ingest_evps = int(folded["events"] / max(folded["wall"], 1e-9))
+    out = {
+        "phase": phase, "ladder": ladder,
+        "offwriter_contention_ratio": ratio,
+        "writer_attached_baseline": 0.61,   # REACH_r02 @ ~30% duty
+        "ingest_busy_duty": duty,
+        "contention_over_duty": round(ratio / duty, 2) if duty else None,
+        "queue_wait_ms": round(wait_total / 1e6, 1),
+        "ingest_overlap_ms": round(overlap / 1e6, 1),
+        "busy_windows": len(busy),
+        "ingest_sustained_ev_s": ingest_evps,
+        "ships": shipper.ships,
+        "ship_interval_ms": ship_ms,
+        "cpus": os.cpu_count(),
+        "scaling_claim_gated": os.cpu_count() == 1,
+        "note": ("replica processes timeslice 1 core: the qps ladder "
+                 "is recorded, the scaling claim waits for real "
+                 "silicon; the transferable wins are the off-writer "
+                 "contention ratio (≈ the duty coincidence floor, vs "
+                 "0.61 ≈ 2x duty writer-attached), bounded staleness, "
+                 "and cache hits"
+                 if os.cpu_count() == 1 else ""),
+    }
+    assert ratio < 0.61, (
+        f"off-writer contention {ratio} not below the writer-attached "
+        f"0.61 baseline (duty {duty})")
+    out["below_writer_attached_baseline"] = True
+    out["ok"] = True
+    return out
+
+
+# ----------------------------------------------------------------------
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
@@ -557,7 +1055,11 @@ def main() -> int:
                     help="CI: small rung + tiny storm only")
     ap.add_argument("--out", default="bench_reach.json")
     ap.add_argument("--workdir", default="")
+    ap.add_argument("--sharded-rung", type=int, default=0,
+                    help=argparse.SUPPRESS)  # child mode (ISSUE 14)
     args = ap.parse_args()
+    if args.sharded_rung:
+        return run_sharded_child(args.sharded_rung)
     budget_s = float(os.environ.get("STREAMBENCH_BENCH_BUDGET_S", "840"))
     deadline = _T0 + budget_s
 
@@ -603,6 +1105,12 @@ def main() -> int:
         print(compact_line(attr), flush=True)
         log(f"attribution ok: seg_sum_ratio {attr['seg_sum_ratio']} "
             f"contention {attr['contention_ratio']}")
+        # repeats matches the full run's mix shape so the hit-ratio
+        # regress row compares like against like (ratio = r/(r+1))
+        cab = run_cache_ab(eng_s, names_s, distinct=16, repeats=8)
+        doc["cache_ab"] = cab
+        print(compact_line(cab), flush=True)
+        log(f"cache A/B ok: miss/hit p99 {cab['miss_over_hit_p99']}x")
     elif time.monotonic() > deadline - 120:
         doc["large"] = {"skipped": "budget"}
         doc["storm"] = {"skipped": "budget"}
@@ -653,6 +1161,25 @@ def main() -> int:
         log(f"attribution ok: seg_sum_ratio {attr['seg_sum_ratio']} "
             f"contention {attr['contention_ratio']} "
             f"({attr['ingest_events_folded']} ev folded concurrently)")
+        # ---- ISSUE 14 scale-out rungs --------------------------------
+        cab = run_cache_ab(eng_l, names_l)
+        doc["cache_ab"] = cab
+        print(compact_line(cab), flush=True)
+        log(f"cache A/B ok: miss/hit p99 {cab['miss_over_hit_p99']}x, "
+            f"hit ratio {cab['hit_ratio']}")
+        doc["sharded"] = run_sharded_rungs(deadline)
+        if time.monotonic() > deadline - 150:
+            doc["replica_scaleout"] = {"skipped": "budget"}
+            ok = False
+            log("budget exhausted before the replica rung — recorded")
+        else:
+            rsc = run_replica_scaleout(eng_l, names_l, journal_l,
+                                       workdir)
+            doc["replica_scaleout"] = rsc
+            print(compact_line(rsc), flush=True)
+            log(f"replica rung ok: off-writer contention "
+                f"{rsc['offwriter_contention_ratio']} "
+                f"(writer-attached baseline 0.61)")
 
     # regress-gate keys (obs/regress.py normalize_bench reads doc.reach)
     storm_doc = doc.get("storm") or {}
@@ -665,10 +1192,23 @@ def main() -> int:
         doc["reach"]["segments"] = {
             seg: d["p50"] for seg, d in attr_doc["segments"].items()}
         doc["reach"]["contention_ratio"] = attr_doc["contention_ratio"]
+    # ISSUE 14 regress keys: cache hit ratio (repeated mix), replica
+    # staleness, off-writer contention
+    cab_doc = doc.get("cache_ab") or {}
+    if cab_doc.get("ok") and "reach" in doc:
+        doc["reach"]["cache_hit_ratio"] = cab_doc["hit_ratio"]
+    rsc_doc = doc.get("replica_scaleout") or {}
+    if rsc_doc.get("ok") and "reach" in doc:
+        ladder = rsc_doc.get("ladder") or {}
+        first = ladder.get("r1") or {}
+        doc["reach"]["staleness_ms"] = first.get("staleness_p50_ms")
+        doc["reach"]["offwriter_contention_ratio"] = \
+            rsc_doc["offwriter_contention_ratio"]
+    phases = ["small", "storm", "shed", "attribution", "cache_ab"]
+    if not args.smoke:
+        phases += ["large", "sharded", "replica_scaleout"]
     doc["ok"] = ok and all(
-        (doc.get(p) or {}).get("ok") for p in
-        (("small", "storm", "shed", "attribution") if args.smoke
-         else ("small", "large", "storm", "shed", "attribution")))
+        (doc.get(p) or {}).get("ok") for p in phases)
     doc["wall_s"] = round(time.monotonic() - _T0, 1)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)

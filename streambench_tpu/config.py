@@ -261,6 +261,18 @@ class BenchmarkConfig:
     #   objective — a served query slower than this (submit -> reply)
     #   is "bad"; judged by the same two-window burn-rate machinery as
     #   jax.slo.p99.ms, surfaced under objective="reach"
+    # --- reach scale-out (reach/{cache,replica}; ISSUE 14 — the
+    # (epoch, campaign-set) result cache + snapshot-shipped read
+    # replicas) ---
+    jax_reach_cache_capacity: int = 4096   # bounded LRU of query
+    #   answers keyed (epoch, canonical campaign-set, kind); epoch
+    #   bumps invalidate wholesale; 0 disables
+    jax_reach_ship_dir: str = ""           # non-empty: ship (epoch,
+    #   planes, watermark) records into <dir>/dimensions.log at the
+    #   interval below — the log replica processes tail
+    #   (python -m streambench_tpu.reach.replica --ship <dir>)
+    jax_reach_ship_interval_ms: int = 1000  # replica shipping cadence:
+    #   the replica staleness bound is cadence + poll when healthy
     # --- query-path observability (obs/queryattr; ISSUE 11 — the
     # serving-tier mirror of jax.obs.lifecycle; default-off: reply
     # payloads stay byte-identical) ---
@@ -461,6 +473,11 @@ class BenchmarkConfig:
             jax_reach_queue_depth=max(
                 geti("jax.reach.queue.depth", 512), 1),
             jax_reach_slo_p99_ms=max(geti("jax.reach.slo.p99.ms", 0), 0),
+            jax_reach_cache_capacity=max(
+                geti("jax.reach.cache.capacity", 4096), 0),
+            jax_reach_ship_dir=gets("jax.reach.ship.dir", ""),
+            jax_reach_ship_interval_ms=max(
+                geti("jax.reach.ship.interval.ms", 1000), 1),
             jax_obs_query=getb("jax.obs.query", False),
             jax_obs_query_slowlog=max(
                 geti("jax.obs.query.slowlog", 128), 1),

@@ -36,6 +36,7 @@ import socketserver
 import struct
 import threading
 import time
+from collections import deque
 
 _WS_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
@@ -156,6 +157,15 @@ def ws_read_frame(rfile) -> tuple[int, bytes] | None:
 # without limit).
 MAX_FRAME_BYTES = 4 << 20
 MAX_LINE_BYTES = 64 << 10
+
+#: per-connection reply-queue bounds.  A consumer that falls this far
+#: behind — by message count (small-payload storms) or by queued bytes
+#: (fat aggregate streams) — is dropped, same policy as the old
+#: synchronous send-timeout, but the DECISION no longer costs the
+#: producer anything: send() enqueues and the per-connection writer
+#: thread eats the socket stall.
+REPLY_QUEUE_MAX = 1024
+REPLY_QUEUE_MAX_BYTES = 2 << 20
 
 
 class _SockStream:
@@ -365,6 +375,17 @@ class _Handler(socketserver.StreamRequestHandler):
         self.connection.settimeout(self.timeout_s)
         self.ws = False
         self._wlock = threading.Lock()
+        # Per-connection reply queue (ISSUE 14): send() ENQUEUES and a
+        # lazily-started writer thread drains, so a slow client socket
+        # blocks only its own writer — never the reach worker's reply
+        # loop over a whole batch (the PR 10 shed-reply-under-cv-lock
+        # bug, one layer down: the old path serialized every reply
+        # through a bounded-but-BLOCKING sendall on the caller).
+        self._rq: deque = deque()
+        self._rq_bytes = 0
+        self._rq_cv = threading.Condition()
+        self._rq_dead = False
+        self._rq_thread: threading.Thread | None = None
         my_topics: set[str] = set()
         try:
             for msg in self._messages(_SockStream(self.connection)):
@@ -395,6 +416,9 @@ class _Handler(socketserver.StreamRequestHandler):
                     # gateway parity: clients may publish into a topic
                     server.publish(topic, msg.get("data"))
         finally:
+            with self._rq_cv:
+                self._rq_dead = True
+                self._rq_cv.notify()
             for t in my_topics:
                 server._unsubscribe(t, self)
 
@@ -420,9 +444,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 pass
 
     def send_raw(self, data: bytes) -> bool:
-        # serialize writers: publish() runs on engine threads while the
-        # handler thread answers pings — interleaved sendall calls would
-        # corrupt websocket framing mid-frame
+        # serialize writers: the reply-writer thread drains the queue
+        # while the handler thread answers pings — interleaved sendall
+        # calls would corrupt websocket framing mid-frame
         with self._wlock:
             try:
                 self.connection.sendall(data)
@@ -430,14 +454,58 @@ class _Handler(socketserver.StreamRequestHandler):
             except (TimeoutError, socket.timeout, OSError):
                 return False
 
+    def _drain_replies(self) -> None:
+        """Per-connection writer: drains the reply queue in order.  A
+        send that fails (timeout = the client's TCP window stayed full
+        past timeout_s, or a dead socket) marks the connection dead and
+        drops the backlog — exactly the old synchronous policy, minus
+        the producer-side stall."""
+        while True:
+            with self._rq_cv:
+                while not self._rq and not self._rq_dead:
+                    self._rq_cv.wait(timeout=1.0)
+                if self._rq_dead and not self._rq:
+                    return
+                data = self._rq.popleft()
+                self._rq_bytes -= len(data)
+            if not self.send_raw(data):
+                with self._rq_cv:
+                    self._rq_dead = True
+                    self._rq.clear()
+                    self._rq_bytes = 0
+                return
+
     def send(self, payload: bytes) -> bool:
-        """Bounded write of one pub/sub message: a consumer whose TCP
-        window stays full past the socket timeout is reported dead (and
-        dropped by publish()).  ``payload`` is the JSON line; websocket
-        subscribers get it as one text frame."""
-        if self.ws:
-            return self.send_raw(ws_encode(payload.rstrip(b"\n")))
-        return self.send_raw(payload)
+        """Enqueue one pub/sub message for this connection's writer
+        thread (started lazily at the first send).  NEVER blocks the
+        caller on the client's socket: a queue past REPLY_QUEUE_MAX
+        marks the consumer dead instead (publish() then drops it from
+        the topic).  ``payload`` is the JSON line; websocket subscribers
+        get it as one text frame.  Returns False once the connection is
+        known dead — an enqueued message may still be lost to a later
+        socket failure, the same at-most-once delivery the synchronous
+        path had."""
+        data = ws_encode(payload.rstrip(b"\n")) if self.ws else payload
+        with self._rq_cv:
+            if self._rq_dead:
+                return False
+            if (len(self._rq) >= REPLY_QUEUE_MAX
+                    or self._rq_bytes + len(data)
+                    > REPLY_QUEUE_MAX_BYTES):
+                self._rq_dead = True
+                self._rq.clear()
+                self._rq_bytes = 0
+                self._rq_cv.notify()
+                return False
+            self._rq.append(data)
+            self._rq_bytes += len(data)
+            if self._rq_thread is None:
+                self._rq_thread = threading.Thread(
+                    target=self._drain_replies, daemon=True,
+                    name="pubsub-reply-writer")
+                self._rq_thread.start()
+            self._rq_cv.notify()
+        return True
 
 
 class _Server(socketserver.ThreadingTCPServer):

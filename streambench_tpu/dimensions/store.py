@@ -74,11 +74,17 @@ class DurableDimensionStore:
 
     def put_reach_sketches(self, mins: np.ndarray, registers: np.ndarray,
                            campaigns: list[str], epoch: int,
-                           update_time_ms: int | None = None) -> None:
+                           update_time_ms: int | None = None,
+                           watermark: int | None = None) -> None:
         """Materialize the reach sketch planes (reach/; ISSUE 10) as one
         durable log record, so a reopened store can serve audience
         queries without re-folding the journal.  Latest record wins on
-        replay; ``compact`` keeps only it."""
+        replay; ``compact`` keeps only it.
+
+        This record is also the replica shipping format (ISSUE 14): the
+        snapshot shipper appends one per cadence tick and read-replica
+        processes tail the log for them; ``watermark`` rides along so a
+        replica can report how much event time its planes cover."""
         stamp = now_ms() if update_time_ms is None else update_time_ms
         mins = np.ascontiguousarray(mins, dtype=np.uint32)
         regs = np.ascontiguousarray(registers, dtype=np.int32)
@@ -87,6 +93,8 @@ class DurableDimensionStore:
                "k": int(mins.shape[1]), "r": int(regs.shape[1]),
                "mins": base64.b64encode(mins.tobytes()).decode(),
                "regs": base64.b64encode(regs.tobytes()).decode()}
+        if watermark is not None:
+            rec["wm"] = int(watermark)
         self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._f.flush()
         os.fsync(self._f.fileno())
@@ -104,6 +112,7 @@ class DurableDimensionStore:
             return   # torn/corrupt sketch record: keep the previous one
         self._reach = {"mins": mins, "registers": regs, "campaigns": c,
                        "epoch": int(rec.get("epoch", 0)),
+                       "watermark": int(rec.get("wm", 0)),
                        "_updated": int(rec.get("t", 0))}
 
     def reach_sketches(self) -> dict | None:
@@ -157,7 +166,8 @@ class DurableDimensionStore:
                 r = self._reach
                 f.write(json.dumps(
                     {"kind": "reach_sketch", "t": r["_updated"],
-                     "epoch": r["epoch"], "c": r["campaigns"],
+                     "epoch": r["epoch"], "wm": r.get("watermark", 0),
+                     "c": r["campaigns"],
                      "k": int(r["mins"].shape[1]),
                      "r": int(r["registers"].shape[1]),
                      "mins": base64.b64encode(

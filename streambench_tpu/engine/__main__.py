@@ -139,13 +139,16 @@ def main(argv: list[str] | None = None) -> int:
                 ShardedWindowEngine,
                 mesh_from_config,
             )
+            from streambench_tpu.parallel.reach import ShardedReachEngine
             cls = {"exact": ShardedWindowEngine,
                    "hll": ShardedHLLEngine,
                    "sliding": ShardedSlidingTDigestEngine,
-                   "session": ShardedSessionCMSEngine}.get(args.engine)
+                   "session": ShardedSessionCMSEngine,
+                   "reach": ShardedReachEngine}.get(args.engine)
             if cls is None:
                 raise SystemExit(f"--sharded supports exact/hll/sliding/"
-                                 f"session, not --engine {args.engine}")
+                                 f"session/reach, not --engine "
+                                 f"{args.engine}")
             return cls(cfg, mapping, mesh_from_config(cfg),
                        campaigns=campaigns, redis=r)
         if args.engine != "exact":
@@ -385,31 +388,58 @@ def main(argv: list[str] | None = None) -> int:
     # pub/sub endpoint (WebSocket + JSON-lines on one port) with the
     # "reach" query verb routed through the bounded load-shedding
     # query server; the engine pushes sketch state at flush cadence.
-    reach_ps = reach_srv = None
+    reach_ps = reach_srv = reach_store = reach_ship = None
     if args.engine == "reach":
         from streambench_tpu.dimensions.pubsub import PubSubServer
+        from streambench_tpu.reach.cache import ReachQueryCache
         from streambench_tpu.reach.serve import ReachQueryServer
 
+        reach_cache = (ReachQueryCache(cfg.jax_reach_cache_capacity,
+                                       registry=registry)
+                       if cfg.jax_reach_cache_capacity > 0 else None)
         reach_ps = PubSubServer(port=0).start()
         reach_srv = ReachQueryServer(
             list(engine.encoder.campaigns),
             depth=cfg.jax_reach_queue_depth, registry=registry,
-            queryattr=query_obs, spans=spans, flightrec=flightrec)
+            queryattr=query_obs, spans=spans, flightrec=flightrec,
+            cache=reach_cache)
         reach_ps.register_query("reach", reach_srv.handle)
         engine.attach_reach(reach_srv)
-        if sampler is not None and query_obs is not None:
+        # replica snapshot shipping (ISSUE 14): append (epoch, planes,
+        # watermark) records into <dir>/dimensions.log at the cadence;
+        # replica processes tail it (streambench_tpu.reach.replica)
+        if cfg.jax_reach_ship_dir:
+            from streambench_tpu.dimensions.store import (
+                DurableDimensionStore,
+            )
+            from streambench_tpu.reach.replica import SnapshotShipper
+
+            reach_store = DurableDimensionStore(cfg.jax_reach_ship_dir)
+            reach_ship = SnapshotShipper(
+                reach_store, list(engine.encoder.campaigns),
+                interval_ms=cfg.jax_reach_ship_interval_ms,
+                registry=registry)
+            engine.attach_shipper(reach_ship)
+        if sampler is not None:
             # every metrics.jsonl snapshot carries the live serving
-            # picture (segments, contention, slow-query log) under
-            # "reach_query" — the block `obs report/diff` renders
+            # picture (segments/contention with query obs on, and the
+            # ISSUE 14 cache/epoch/staleness block always) under
+            # "reach_query" — the block `obs report/diff` renders;
+            # summary() also refreshes the replica gauges each tick
             def _reach_query_collect(rec, dt_s, srv=reach_srv):
                 rec["reach_query"] = srv.summary()
 
             sampler.add_collector(_reach_query_collect)
         r_host, r_port = reach_ps.address
         qobs = " query_obs=on" if query_obs is not None else ""
+        extra = (f" cache={cfg.jax_reach_cache_capacity}"
+                 if reach_cache is not None else "")
+        if reach_ship is not None:
+            extra += (f" ship={cfg.jax_reach_ship_dir}"
+                      f"@{cfg.jax_reach_ship_interval_ms}ms")
         print(f"reach: pubsub={r_host}:{r_port} "
               f"queue_depth={cfg.jax_reach_queue_depth} k={engine.k} "
-              f"registers={engine.registers}{qobs}", flush=True)
+              f"registers={engine.registers}{qobs}{extra}", flush=True)
 
     # everything is compiled now — engine warmup AND the reach query
     # kernel (warmed at the first state push above); any compile from
@@ -492,6 +522,15 @@ def main(argv: list[str] | None = None) -> int:
         reach_srv.close()
         stats_line["reach"] = reach_srv.summary()
         reach_ps.close()
+        if reach_ship is not None:
+            # final ship: replicas converge on the close-time planes
+            reach_ship.note_state(engine.state.mins,
+                                  engine.state.registers,
+                                  engine.reach_epoch,
+                                  int(engine.state.watermark),
+                                  force=True)
+            stats_line["reach"]["ship"] = reach_ship.summary()
+            reach_store.close()
     if slo is not None:
         stats_line["slo"] = slo.verdict()
     if xfer is not None:

@@ -282,6 +282,10 @@ class ReachSketchEngine(_SketchEngineBase):
         # attach_reach — the fold hot path pays one None check per
         # flush, nothing per batch.
         self._reach_server = None
+        # Replica snapshot shipper (reach/replica.py, ISSUE 14): ships
+        # (epoch, planes, watermark) records at its cadence from the
+        # same flush-path push.
+        self._reach_shipper = None
         # Epoch of the served state: bumped on every restore so a
         # post-resume answer is distinguishable from a stale one (the
         # chaos sweep's "never return stale-epoch estimates" check).
@@ -315,17 +319,43 @@ class ReachSketchEngine(_SketchEngineBase):
         np.asarray(minhash.estimate(self.state.registers))
 
     # -- serving -------------------------------------------------------
+    def query_callable(self):
+        """The batch evaluator an attached query server dispatches
+        through (the sharded subclass swaps in its shard-local
+        two-collective program)."""
+        from streambench_tpu.reach import query as rq
+
+        return rq.batch_query
+
     def attach_reach(self, server) -> None:
-        """Wire a ReachQueryServer: immediate initial push (possibly
-        empty state — queries answer 0 until events fold), then a fresh
-        push on every flush and on restore."""
+        """Wire a ReachQueryServer: inject this engine's evaluator,
+        immediate initial push (possibly empty state — queries answer 0
+        until events fold), then a fresh push on every flush and on
+        restore."""
         self._reach_server = server
+        use = getattr(server, "use_query_fn", None)
+        if use is not None:
+            use(self.query_callable())
+        self._reach_push()
+
+    def attach_shipper(self, shipper) -> None:
+        """Wire a replica SnapshotShipper: ships from the same
+        flush-cadence push path the query server rides (the writer is
+        never blocked by readers — a ship is one host gather + one
+        appended log line, and only at the shipping cadence)."""
+        self._reach_shipper = shipper
         self._reach_push()
 
     def _reach_push(self) -> None:
         if self._reach_server is not None:
             self._reach_server.update_state(
                 self.state.mins, self.state.registers, self.reach_epoch)
+        sh = self._reach_shipper
+        if sh is not None and sh.due(self.reach_epoch):
+            # the due() pre-check keeps the watermark pull (a device
+            # sync) off the not-yet-due flushes
+            sh.note_state(self.state.mins, self.state.registers,
+                          self.reach_epoch, int(self.state.watermark))
 
     # -- harness hooks -------------------------------------------------
     def _drain_device(self) -> None:

@@ -74,6 +74,14 @@ DEFAULT_TOLERANCES: dict = {
     "reach_segment_dispatch_ms": ("lower", 1.0),
     "reach_segment_reply_ms": ("lower", 1.0),
     "reach_contention_ratio": ("lower", 1.0),
+    # reach scale-out (ISSUE 14): the cache's hit ratio on the repeated
+    # -query mix regresses DOWN (near-deterministic for a fixed mix:
+    # tight); replica staleness regresses UP (bounded by cadence + poll
+    # when healthy, but wall-timing on the 1-core host: generous), as
+    # does the off-writer contention ratio the replica rung re-measures
+    "reach_cache_hit_ratio": ("higher", 0.1),
+    "reach_staleness_ms": ("lower", 1.0),
+    "reach_offwriter_contention_ratio": ("lower", 1.0),
     # sliding A/B (ISSUE 12): both arms' catchup throughput regresses
     # DOWN; generous like every timing row on the 1-core host
     "sliding_evps": ("higher", 0.5),
@@ -171,6 +179,15 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
             out[f"reach_segment_{seg}_ms"] = _num(v)
         out["reach_contention_ratio"] = _num(
             reach.get("contention_ratio"))
+        # ISSUE 14 scale-out keys (bench_reach REACH_r03 schema, or an
+        # engine/replica stats line's nested cache block)
+        cache = reach.get("cache")
+        out["reach_cache_hit_ratio"] = _num(
+            cache.get("hit_ratio") if isinstance(cache, dict)
+            else reach.get("cache_hit_ratio"))
+        out["reach_staleness_ms"] = _num(reach.get("staleness_ms"))
+        out["reach_offwriter_contention_ratio"] = _num(
+            reach.get("offwriter_contention_ratio"))
     return {k: v for k, v in out.items() if v is not None}
 
 

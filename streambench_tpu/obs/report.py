@@ -284,6 +284,25 @@ def render_serve(s: dict) -> str:
                  f"dispatches {_fmt(rq.get('dispatches'))}  "
                  f"queue high-water {_fmt(rq.get('queue_high_water'))}"
                  f"/{_fmt(rq.get('queue_depth'))}")
+    # scale-out rows (ISSUE 14): planes epoch/staleness + cache hit mix
+    if rq.get("plane_epoch") is not None:
+        stale = rq.get("staleness_ms")
+        lines.append(
+            f"  plane epoch {_fmt(rq['plane_epoch'])}"
+            + (f"  staleness {_fmt(stale)} ms"
+               f"/{_fmt(rq.get('max_staleness_ms'))} bound"
+               if stale is not None else "")
+            + (f"  stale sheds {_fmt(rq['shed_stale'])}"
+               if rq.get("shed_stale") else ""))
+    cache = rq.get("cache")
+    if isinstance(cache, dict):
+        lines.append(
+            f"  cache hit ratio {_fmt(cache.get('hit_ratio'))} "
+            f"({_fmt(cache.get('hits'))} hits / "
+            f"{_fmt(cache.get('misses'))} misses; "
+            f"{_fmt(cache.get('entries'))}/{_fmt(cache.get('capacity'))}"
+            f" entries, {_fmt(cache.get('evictions'))} evicted, "
+            f"{_fmt(cache.get('invalidations'))} epoch invalidations)")
     lines.append(f"  lifecycle records: {_fmt(qobs.get('served_records'))}"
                  f" served + {_fmt(qobs.get('shed_records'))} shed")
     segs = qobs.get("segments") or {}
@@ -360,6 +379,12 @@ def render_serve_diff(a: dict, b: dict) -> str:
     ca = (qa.get("contention") or {}).get("ratio")
     cb = (qb.get("contention") or {}).get("ratio")
     lines.append(f"  contention ratio: A {_fmt(ca)}  B {_fmt(cb)}")
+    ha = ((a.get("reach_query") or {}).get("cache") or {}).get(
+        "hit_ratio")
+    hb = ((b.get("reach_query") or {}).get("cache") or {}).get(
+        "hit_ratio")
+    if ha is not None or hb is not None:
+        lines.append(f"  cache hit ratio:  A {_fmt(ha)}  B {_fmt(hb)}")
     return "\n".join(lines)
 
 

@@ -8,10 +8,16 @@ ring/watermark semantics, and the cross-device merge is ``pmax`` (register
 max is associative/commutative, so sharded merge is exact, SURVEY.md §2
 "Reduce/unifier" row).
 
-Registers are int32 ``[C, W, R]`` with R a power of two.  The hash is
-splitmix32 over the interned user index (dense ids hash as well as UUIDs
-once mixed).  Estimation runs on device: the classic alpha_m bias-corrected
-harmonic mean with linear-counting small-range correction.
+Registers are uint8 ``[C, W, R]`` with R a power of two: a register
+holds ``1 + leading-zero-count`` of a 32-bit hash's top bits — at most
+``33 - log2(R) <= 26`` for any R >= 64 — so a byte plane is lossless
+and packs 4x the registers per byte of the original int32 plane
+(ROADMAP item 2a; the fold casts the int32 rank at the scatter, so a
+legacy int32 plane restored from an old snapshot still folds
+bit-identically).  The hash is splitmix32 over the interned user index
+(dense ids hash as well as UUIDs once mixed).  Estimation runs on
+device: the classic alpha_m bias-corrected harmonic mean with
+linear-counting small-range correction.
 
 Unlike exact counts (flushed as HINCRBY-able deltas), HLL registers are
 NOT deltas: the flush snapshots estimates for occupied slots and zeroes
@@ -31,7 +37,9 @@ from streambench_tpu.ops.windowcount import assign_windows
 
 
 class HLLState(NamedTuple):
-    """registers: [C, W, R] int32; ring metadata as in WindowState."""
+    """registers: [C, W, R] uint8 (ranks <= 26 fit a byte; legacy int32
+    planes from old snapshots still fold); ring metadata as in
+    WindowState."""
 
     registers: jax.Array
     window_ids: jax.Array
@@ -45,7 +53,7 @@ def init_state(num_campaigns: int, window_slots: int,
         raise ValueError("num_registers must be a power of two")
     return HLLState(
         registers=jnp.zeros((num_campaigns, window_slots, num_registers),
-                            jnp.int32),
+                            jnp.uint8),
         window_ids=jnp.full((window_slots,), -1, jnp.int32),
         watermark=jnp.int32(0),
         dropped=jnp.int32(0),
@@ -103,7 +111,8 @@ def step(state: HLLState, join_table: jax.Array,
 
     flat = jnp.where(count_mask, (campaign * W + slot) * R + j, C * W * R)
     registers = (state.registers.reshape(-1)
-                 .at[flat].max(rank, mode="drop")
+                 .at[flat].max(rank.astype(state.registers.dtype),
+                               mode="drop")
                  .reshape(C, W, R))
 
     dropped = state.dropped + (

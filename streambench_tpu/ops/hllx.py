@@ -62,8 +62,11 @@ LOG_MOMENT_BIAS = 1.07
 
 
 class HLLXState(NamedTuple):
-    """registers: [C, G, R] int32 (rung g caps at T_g = 2^g); totals:
-    [C] int32 exact wanted-event counts (F1); watermark/dropped as in
+    """registers: [C, G, R] uint8 (rung g caps at T_g = 2^g; ranks
+    <= 26 fit a byte — 4x the register density of the original int32
+    plane, ROADMAP item 2a; legacy int32 planes from old snapshots
+    still fold, the scatter casts to the plane's dtype); totals: [C]
+    int32 exact wanted-event counts (F1); watermark/dropped as in
     ReachState (cumulative: nothing ever drops)."""
 
     registers: jax.Array
@@ -94,7 +97,7 @@ def init_state(num_campaigns: int, groups: int = 8,
         raise ValueError("C*G*R must fit int32 flat indices")
     return HLLXState(
         registers=jnp.zeros((num_campaigns, groups, num_registers),
-                            jnp.int32),
+                            jnp.uint8),
         totals=jnp.zeros((num_campaigns,), jnp.int32),
         watermark=jnp.int32(NEG),
         dropped=jnp.int32(0))
@@ -128,7 +131,9 @@ def step(state: HLLXState, join_table: jax.Array,
     flat = jnp.where(wanted[:, None],
                      (campaign[:, None] * G + g) * R + j, C * G * R)
     registers = (state.registers.reshape(-1)
-                 .at[flat.reshape(-1)].max(rank.reshape(-1), mode="drop")
+                 .at[flat.reshape(-1)].max(
+                     rank.reshape(-1).astype(state.registers.dtype),
+                     mode="drop")
                  .reshape(C, G, R))
 
     totals = state.totals.at[jnp.where(wanted, campaign, C)].add(

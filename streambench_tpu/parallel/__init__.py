@@ -7,6 +7,10 @@ from streambench_tpu.parallel.distributed import (
     run_distributed_catchup,
 )
 from streambench_tpu.parallel.mesh import build_mesh, mesh_from_config
+from streambench_tpu.parallel.reach import (
+    ShardedReachEngine,
+    sharded_reach_init,
+)
 from streambench_tpu.parallel.sharded import (
     ShardedWindowEngine,
     sharded_init_state,
@@ -30,11 +34,13 @@ __all__ = [
     "mesh_from_config",
     "run_distributed_catchup",
     "ShardedHLLEngine",
+    "ShardedReachEngine",
     "ShardedSessionCMSEngine",
     "ShardedSlidingTDigestEngine",
     "ShardedWindowEngine",
     "sharded_hll_init",
     "sharded_hll_step",
+    "sharded_reach_init",
     "sharded_init_state",
     "sharded_step",
 ]

@@ -1,0 +1,422 @@
+"""Campaign-sharded reach: the MinHash∪HLL planes on the PR 7 mesh,
+with query evaluation placed NEXT to the shards (ROADMAP item 3 /
+ISSUE 14).
+
+The single-device reach engine (``ops/minhash.py``) materializes a
+``[C, k]`` signature plane and a ``[C, R]`` HLL plane per campaign.
+Both merges are elementwise (min / max) — commutative, associative,
+idempotent — so campaign-sharding is *provably* exact: each campaign's
+rows live on exactly one shard, the ingest fold routes every event to
+its owner (the ``ShardedHLLEngine`` treatment without the window ring),
+and cross-shard state never has to merge at all.
+
+The interesting half is the **query path**.  A ``[Q, C]`` masked batch
+query needs, per query, the min over selected campaigns' signatures and
+the max over their signatures + registers — campaigns that live on
+different shards.  The naive spelling (gather both planes, evaluate
+replicated) moves O(C·(k+R)) bytes per dispatch; per-campaign merges
+would issue O(C) collectives.  Instead each shard reduces its OWN
+campaigns to ``[Q, k]`` / ``[Q, k+R]`` partials and the cross-shard
+merge is hoisted to exactly TWO collectives per query dispatch,
+independent of C, Q's padding, and the campaign fan-out of the queries:
+
+- ONE ``pmin`` of the ``[Q, k]`` selected-signature minima;
+- ONE ``pmax`` of the ``[Q, k + R]`` concatenation of the
+  selected-signature maxima and the selected-register maxima (the
+  register plane is bitcast-free: register values are tiny non-negative
+  ints, so a uint32 view preserves max ordering exactly).
+
+``collective_report()`` parses the compiled HLO and publishes the
+measured table (``parallel/collectives.py``) — the bench asserts the
+"exactly 2 cross-shard collectives per query dispatch" claim from the
+program text, not from this docstring.  Bit-identity with the
+single-device engine (planes AND integer collision counts) is the
+oracle; ``tests/test_sharded_reach.py`` sweeps it over adversarial
+shard splits and seeds.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from streambench_tpu.config import BenchmarkConfig
+from streambench_tpu.engine.sketches import ReachSketchEngine
+from streambench_tpu.io.redis_schema import RedisLike
+from streambench_tpu.ops import hll, minhash
+from streambench_tpu.ops import windowcount as wc
+from streambench_tpu.ops.hll import _rank, splitmix32
+from streambench_tpu.ops.minhash import EMPTY, salts
+from streambench_tpu.ops.windowcount import NEG
+from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
+from streambench_tpu.parallel.sharded import data_axis_pad, pad_data_cols
+from streambench_tpu.parallel.sketches import _gather_cols, shard_map
+
+
+def pad_campaigns(num_campaigns: int, mesh: Mesh) -> int:
+    from streambench_tpu.parallel.sharded import pad_campaigns as _pc
+
+    return _pc(num_campaigns, mesh)
+
+
+# ----------------------------------------------------------------------
+# ingest fold: the minhash.step scatter against shard-local rows
+# ----------------------------------------------------------------------
+
+def _reach_fold_local(mins, registers, watermark, join_table,
+                      ad, user, et, tm, v, *, view_type: int):
+    """Collective-free reach fold over already-replicated columns:
+    this shard owns campaigns ``[c0, c0 + Cl)``; everything else
+    scatters to the drop slot.  Mirrors ``minhash.step`` exactly (the
+    bit-identity oracle) with ``campaign`` rebased shard-locally."""
+    Cl, k = mins.shape
+    R = registers.shape[1]
+    p = R.bit_length() - 1
+
+    campaign = join_table[ad]
+    wanted = v & (et == view_type) & (campaign >= 0)
+    c0 = jax.lax.axis_index(CAMPAIGN_AXIS) * Cl
+    local_c = campaign - c0
+    in_shard = wanted & (local_c >= 0) & (local_c < Cl)
+
+    h = splitmix32(user)
+    hk = splitmix32(h[:, None] ^ salts(k)[None, :])
+    slot = jnp.arange(k, dtype=jnp.int32)[None, :]
+    flat = jnp.where(in_shard[:, None], local_c[:, None] * k + slot,
+                     Cl * k)
+    mins = (mins.reshape(-1)
+            .at[flat].min(hk, mode="drop")
+            .reshape(Cl, k))
+
+    j = (h & jnp.uint32(R - 1)).astype(jnp.int32)
+    rank = _rank(h, p)
+    rflat = jnp.where(in_shard, local_c * R + j, Cl * R)
+    registers = (registers.reshape(-1)
+                 .at[rflat].max(rank.astype(registers.dtype),
+                                mode="drop")
+                 .reshape(Cl, R))
+
+    # watermark is computed from the replicated columns — a global fact
+    # on every device, no collective needed (the _hll_fold_local rule)
+    watermark = jnp.maximum(watermark, jnp.max(jnp.where(v, tm, NEG)))
+    return mins, registers, watermark
+
+
+_STATE_SPECS = (P(CAMPAIGN_AXIS, None), P(CAMPAIGN_AXIS, None), P())
+
+
+@functools.lru_cache(maxsize=None)
+def _build_reach_step(mesh: Mesh, view_type: int = 0):
+    def body(mins, registers, watermark, join_table,
+             ad, user, et, tm, v):
+        ad, user, et, tm, v = _gather_cols(ad, user, et, tm, v)
+        return _reach_fold_local(mins, registers, watermark, join_table,
+                                 ad, user, et, tm, v,
+                                 view_type=view_type)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=_STATE_SPECS + (P(),) + (P(DATA_AXIS),) * 5,
+        out_specs=_STATE_SPECS)
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_reach_scan(mesh: Mesh, view_type: int = 0,
+                      packed: bool = False):
+    """Hoisted scan over ``[K, B]`` stacks: the stacked columns gather
+    ONCE per dispatch (PR 7/12 style) and the scan body is
+    collective-free — reach has no drop counter to psum, so the whole
+    dispatch costs exactly the column gathers."""
+
+    def body(mins, registers, watermark, join_table, *cols):
+        cols = _gather_cols(*cols)
+
+        def one(carry, xs):
+            mn, rg, wm = carry
+            if packed:
+                pk, u, t = xs
+                a, e, v = wc.unpack_columns(pk)
+            else:
+                a, u, e, t, v = xs
+            return _reach_fold_local(mn, rg, wm, join_table,
+                                     a, u, e, t, v,
+                                     view_type=view_type), None
+
+        carry, _ = jax.lax.scan(one, (mins, registers, watermark), cols)
+        return carry
+
+    n_cols = 3 if packed else 5
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=_STATE_SPECS + (P(),) + (P(None, DATA_AXIS),) * n_cols,
+        out_specs=_STATE_SPECS)
+    return jax.jit(mapped)
+
+
+# ----------------------------------------------------------------------
+# query evaluation next to the shards
+# ----------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _build_reach_query(mesh: Mesh):
+    """The sharded twin of ``reach.query.batch_query``.
+
+    Each shard reduces ITS campaign rows to per-query partials, then
+    the cross-shard merge is exactly TWO collectives per dispatch:
+
+    - ``pmin`` of the ``[Q, k]`` selected-signature minima;
+    - ``pmax`` of ONE ``[Q, k + R]`` uint32 concatenation carrying both
+      the selected-signature maxima and the selected-register maxima
+      (register values are small non-negative ints, so the uint32 view
+      preserves max ordering bit-exactly).
+
+    Outputs are replicated and bit-identical to the single-device
+    evaluation: min/max merges are order-invariant, and the estimate /
+    Jaccard arithmetic runs on the POST-merge replicated arrays — the
+    same ``hll.estimate`` graph over the same integers.
+    """
+
+    def body(mins, registers, mask, overlap):
+        empty = jnp.uint32(EMPTY)
+        k = mins.shape[1]
+        sel = mask[:, :, None]
+        loc_min = jnp.min(jnp.where(sel, mins[None], empty), axis=1)
+        loc_sigmax = jnp.max(jnp.where(sel, mins[None], jnp.uint32(0)),
+                             axis=1)
+        loc_regs = jnp.max(
+            jnp.where(sel, registers[None].astype(jnp.uint32), 0),
+            axis=1)
+        sel_min = jax.lax.pmin(loc_min, CAMPAIGN_AXIS)          # 1 pmin
+        packed = jax.lax.pmax(
+            jnp.concatenate([loc_sigmax, loc_regs], axis=1),
+            CAMPAIGN_AXIS)                                      # 1 pmax
+        sel_max = packed[:, :k]
+        union_regs = packed[:, k:].astype(registers.dtype)
+        agree = jnp.sum(((sel_min == sel_max) & (sel_min != empty))
+                        .astype(jnp.int32), axis=1)
+        union = hll.estimate(union_regs).astype(jnp.float32)
+        jacc = agree.astype(jnp.float32) / jnp.float32(k)
+        est = jnp.where(overlap, union * jacc, union)
+        return est, union, jacc, agree
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(CAMPAIGN_AXIS, None), P(CAMPAIGN_AXIS, None),
+                  P(None, CAMPAIGN_AXIS), P()),
+        out_specs=(P(), P(), P(), P()))
+    return jax.jit(mapped)
+
+
+def sharded_reach_init(num_campaigns: int, k: int, num_registers: int,
+                       mesh: Mesh) -> minhash.ReachState:
+    """Device-placed initial state: planes campaign-sharded, scalars
+    replicated.  The campaign axis pads up to a mesh multiple with
+    never-touched rows (EMPTY signature / zero registers evaluate to
+    reach 0, exactly like an unobserved campaign)."""
+    C = pad_campaigns(num_campaigns, mesh)
+    rep = NamedSharding(mesh, P())
+    return minhash.ReachState(
+        mins=jax.device_put(
+            jnp.full((C, k), EMPTY, jnp.uint32),
+            NamedSharding(mesh, P(CAMPAIGN_AXIS, None))),
+        registers=jax.device_put(
+            jnp.zeros((C, num_registers), jnp.int32),
+            NamedSharding(mesh, P(CAMPAIGN_AXIS, None))),
+        watermark=jax.device_put(jnp.int32(NEG), rep),
+        dropped=jax.device_put(jnp.int32(0), rep),
+    )
+
+
+class ShardedReachEngine(ReachSketchEngine):
+    """Reach engine with both sketch planes sharded on the campaign
+    axis of a ``(data, campaign)`` mesh and queries evaluated next to
+    the shards (two collectives per query dispatch, measured by
+    ``collective_report``).
+
+    Drop-in for :class:`ReachSketchEngine`: same host loop, serving
+    attachment (the pushed state refs stay sharded and the attached
+    query server evaluates through :meth:`query_callable`), snapshot
+    format (planes gather to host arrays), and CLI flags.
+    """
+
+    STEP_PACKS = False   # the per-batch step ships separate columns
+
+    def __init__(self, cfg: BenchmarkConfig, ad_to_campaign: dict[str, str],
+                 mesh: Mesh, campaigns: list[str] | None = None,
+                 redis: RedisLike | None = None,
+                 k: int | None = None, registers: int = 256,
+                 input_format: str = "json"):
+        super().__init__(cfg, ad_to_campaign, campaigns=campaigns,
+                         redis=redis, k=k, registers=registers,
+                         input_format=input_format)
+        self.mesh = mesh
+        self._data_pad = data_axis_pad(self.batch_size, mesh)
+        self._padded_c = pad_campaigns(self.encoder.num_campaigns, mesh)
+        self.state = sharded_reach_init(
+            self.encoder.num_campaigns, self.k, self.registers, mesh)
+        self.join_table = jax.device_put(
+            jnp.asarray(self.encoder.join_table),
+            NamedSharding(mesh, P()))
+
+    # -- fold ----------------------------------------------------------
+    def _device_step(self, batch) -> None:
+        fn = _build_reach_step(self.mesh)
+        ad, user, et, tm, va = pad_data_cols(
+            self._data_pad, batch.ad_idx, batch.user_idx,
+            batch.event_type, batch.event_time, batch.valid)
+        mins, regs, wm = fn(self.state.mins, self.state.registers,
+                            self.state.watermark, self.join_table,
+                            ad, user, et, tm, va)
+        self.state = minhash.ReachState(mins, regs, wm,
+                                        self.state.dropped)
+
+    def _device_scan(self, ad_idx, user_idx, event_type, event_time,
+                     valid) -> None:
+        fn = _build_reach_scan(self.mesh)
+        cols = pad_data_cols(self._data_pad, ad_idx, user_idx,
+                             event_type, event_time, valid)
+        mins, regs, wm = fn(self.state.mins, self.state.registers,
+                            self.state.watermark, self.join_table,
+                            *cols)
+        self.state = minhash.ReachState(mins, regs, wm,
+                                        self.state.dropped)
+
+    def _device_scan_packed(self, packed, user_idx, event_time) -> None:
+        fn = _build_reach_scan(self.mesh, packed=True)
+        cols = pad_data_cols(self._data_pad, packed, user_idx,
+                             event_time)
+        mins, regs, wm = fn(self.state.mins, self.state.registers,
+                            self.state.watermark, self.join_table,
+                            *cols)
+        self.state = minhash.ReachState(mins, regs, wm,
+                                        self.state.dropped)
+
+    # -- queries next to the shards ------------------------------------
+    def query_callable(self):
+        """The evaluator an attached query server dispatches through:
+        pads the ``[Q, C]`` mask to the sharded campaign width and runs
+        the two-collective program.  Never-touched pad campaigns can't
+        be selected (the mask pad is False), so results are bit-
+        identical to the single-device ``batch_query``."""
+        fn = _build_reach_query(self.mesh)
+        pad = self._padded_c - self.encoder.num_campaigns
+
+        def query(mins, registers, mask, overlap):
+            mask = np.asarray(mask, bool)
+            if pad:
+                mask = np.concatenate(
+                    [mask, np.zeros((mask.shape[0], pad), bool)],
+                    axis=1)
+            return fn(mins, registers, jnp.asarray(mask),
+                      jnp.asarray(np.asarray(overlap, bool)))
+
+        return query
+
+    def batch_query(self, masks, overlap):
+        """Direct sharded evaluation (tests/bench): numpy in/out."""
+        est, union, jacc, agree = self.query_callable()(
+            self.state.mins, self.state.registers, masks, overlap)
+        return (np.asarray(est), np.asarray(union), np.asarray(jacc),
+                np.asarray(agree))
+
+    def host_state(self) -> minhash.ReachState:
+        """Host-gathered planes TRIMMED to the real campaign count (the
+        single-device-comparable view; pad rows are provably inert)."""
+        C = self.encoder.num_campaigns
+        return minhash.ReachState(
+            mins=np.asarray(self.state.mins)[:C],
+            registers=np.asarray(self.state.registers)[:C],
+            watermark=int(self.state.watermark),
+            dropped=int(self.state.dropped))
+
+    def estimates(self) -> np.ndarray:
+        return np.asarray(minhash.estimate(
+            jnp.asarray(self.host_state().registers)))
+
+    # -- obs -----------------------------------------------------------
+    def attach_obs(self, registry, lifecycle: bool = False, spans=None,
+                   occupancy=None, xfer=None, shard=None) -> None:
+        super().attach_obs(registry, lifecycle, spans=spans,
+                           occupancy=occupancy, xfer=xfer, shard=shard)
+        self._obs_reg = registry
+
+    def collective_report(self, k: int | None = None,
+                          query_batch: int = 256) -> dict:
+        """Per-dispatch collective costs of the compiled reach kernels,
+        parsed from optimized HLO (``parallel/collectives.py``).  The
+        ``query`` table is the transferable headline: its per-dispatch
+        op count must read exactly 2 (one all-reduce min, one
+        all-reduce max) on any multi-shard mesh."""
+        from streambench_tpu.parallel import collectives
+
+        k = int(k or self.scan_batches)
+        B = self.batch_size + self._data_pad
+        st = self.state
+        zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+        scan_fn = _build_reach_scan(self.mesh)
+        query_fn = _build_reach_query(self.mesh)
+        Q = int(query_batch)
+        report = {
+            "batch_events": self.batch_size,
+            "scan_batches": k,
+            "query_batch": Q,
+            "step": collectives.report_for(
+                _build_reach_step(self.mesh),
+                st.mins, st.registers, st.watermark, self.join_table,
+                zi(B), zi(B), zi(B), zi(B), jnp.zeros((B,), bool)),
+            "scan": collectives.report_for(
+                scan_fn, st.mins, st.registers, st.watermark,
+                self.join_table, zi(k, B), zi(k, B), zi(k, B), zi(k, B),
+                jnp.zeros((k, B), bool), scan_len=k),
+            "query": collectives.report_for(
+                query_fn, st.mins, st.registers,
+                jnp.zeros((Q, self._padded_c), bool),
+                jnp.zeros((Q,), bool)),
+        }
+        reg = getattr(self, "_obs_reg", None)
+        if reg is not None:
+            collectives.publish_gauges(reg, report)
+            q = report["query"]["per_dispatch"]
+            reg.gauge("streambench_collective_ops",
+                      "collective ops per device dispatch",
+                      labels={"kernel": "query"}).set(q["ops"])
+            reg.gauge("streambench_collective_bytes",
+                      "collective payload bytes per device dispatch",
+                      labels={"kernel": "query"}).set(q["bytes"])
+        return report
+
+    # -- snapshot / restore (snapshot() inherits: np.asarray gathers
+    # the sharded planes to host arrays) --------------------------------
+    def restore(self, snap) -> None:
+        super().restore(snap)
+        # Re-place host-restored planes with mesh shardings, padding the
+        # campaign axis (accepts single-device ReachSketchEngine
+        # snapshots — the scale-out upgrade path).
+        C = self._padded_c
+        mins = np.asarray(self.state.mins)
+        regs = np.asarray(self.state.registers)
+        if mins.shape[0] < C:
+            mins = np.concatenate(
+                [mins, np.full((C - mins.shape[0], mins.shape[1]),
+                               EMPTY, mins.dtype)])
+            regs = np.concatenate(
+                [regs, np.zeros((C - regs.shape[0], regs.shape[1]),
+                                regs.dtype)])
+        rep = NamedSharding(self.mesh, P())
+        self.state = minhash.ReachState(
+            mins=jax.device_put(
+                jnp.asarray(mins),
+                NamedSharding(self.mesh, P(CAMPAIGN_AXIS, None))),
+            registers=jax.device_put(
+                jnp.asarray(regs),
+                NamedSharding(self.mesh, P(CAMPAIGN_AXIS, None))),
+            watermark=jax.device_put(
+                jnp.int32(self.state.watermark), rep),
+            dropped=jax.device_put(jnp.int32(self.state.dropped), rep))
+        self._reach_push()

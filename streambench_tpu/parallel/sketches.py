@@ -141,7 +141,7 @@ def _hll_fold_local(registers, window_ids, watermark, join_table,
 
     flat = jnp.where(in_shard, (local_c * W + slot) * R + j, Cl * W * R)
     new_regs = (registers.reshape(-1)
-                .at[flat].max(rank, mode="drop")
+                .at[flat].max(rank.astype(registers.dtype), mode="drop")
                 .reshape(Cl, W, R))
 
     wanted_n = jnp.sum(wanted.astype(jnp.int32))
@@ -404,7 +404,7 @@ def sharded_hll_init(num_campaigns: int, window_slots: int, mesh: Mesh,
     rep = NamedSharding(mesh, P())
     return hll.HLLState(
         registers=jax.device_put(
-            jnp.zeros((C, window_slots, num_registers), jnp.int32),
+            jnp.zeros((C, window_slots, num_registers), jnp.uint8),
             NamedSharding(mesh, P(CAMPAIGN_AXIS, None, None))),
         window_ids=jax.device_put(
             jnp.full((window_slots,), -1, jnp.int32), rep),

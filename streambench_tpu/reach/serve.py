@@ -44,6 +44,27 @@ Query-path observability (ISSUE 11, all default-off):
 State arrives by push (``update_state``): jax arrays are immutable, so
 the engine hands over its current references under the GIL and the
 worker evaluates against a consistent snapshot while folds continue.
+
+Scale-out additions (ISSUE 14):
+
+- **epoch + staleness stamps**: every push carries the host-ms stamp of
+  when its planes were serialized (``shipped_ms``; defaults to push
+  time for a writer-attached server), and every answer carries
+  ``plane_epoch`` + ``staleness_ms`` so a client can bound how old the
+  evidence behind an estimate is.  With ``max_staleness_ms`` set (read
+  replicas), queries are SHED rather than answered against planes
+  staler than the bound — including the not-yet-loaded-any-epoch case,
+  where a replica must never block clients waiting for its first
+  snapshot.
+- **result cache** (:class:`~streambench_tpu.reach.cache.ReachQueryCache`):
+  probes at admission under the live epoch, fills at evaluation, and is
+  invalidated wholesale on every epoch bump.  Hits reply synchronously
+  from the admission path — no queue, no dispatch — which is what the
+  bench's cache-hit-p99 acceptance measures.
+- **pluggable evaluator** (``query_fn``): the sharded engine passes its
+  two-collective shard-local program
+  (``ShardedReachEngine.query_callable``) so queries evaluate next to
+  the shards; the default stays ``reach.query.batch_query``.
 """
 
 from __future__ import annotations
@@ -55,6 +76,7 @@ from collections import deque
 import numpy as np
 
 from streambench_tpu.reach import query as rq
+from streambench_tpu.utils.ids import now_ms
 
 #: shared instrument name — obs/slo.py's reach objective get-or-creates
 #: the SAME histogram geometry, so both sides see one instrument
@@ -65,20 +87,30 @@ class ReachQueryServer:
     def __init__(self, campaigns: list[str], *, depth: int = 512,
                  batch: int = rq.DEFAULT_BATCH, registry=None,
                  hold: bool = False, queryattr=None, spans=None,
-                 flightrec=None):
+                 flightrec=None, cache=None,
+                 max_staleness_ms: int | None = None, query_fn=None):
         self.campaigns = list(campaigns)
         self._index = {c: i for i, c in enumerate(self.campaigns)}
         self.depth = max(int(depth), 1)
         self.batch = max(int(batch), 1)
         self._q: deque = deque()
         self._cv = threading.Condition()
-        self._state = None          # (mins, registers, k, R, epoch)
+        # (mins, registers, k, R, epoch, shipped_ms)
+        self._state = None
         self._hold = bool(hold)
         self._closed = False
         self.served = 0
         self.shed = 0
+        self.shed_stale = 0      # subset of shed: staleness-bound sheds
         self.rejected = 0
         self.dispatches = 0
+        # ISSUE 14: result cache, staleness bound (replicas), evaluator
+        self._cache = cache
+        self.max_staleness_ms = (None if max_staleness_ms is None
+                                 else max(int(max_staleness_ms), 0))
+        self._query_fn_custom = query_fn is not None
+        self._query_fn = query_fn if query_fn is not None \
+            else rq.batch_query
         # serving observability (ISSUE 11) — all None on the default
         # path: one attribute check per admission/batch, replies
         # byte-identical until jax.obs.query wires a QueryLifecycle
@@ -90,25 +122,46 @@ class ReachQueryServer:
         self._fr_shed_last = 0.0     # monotonic stamp of last shed rec
         self._warmed = False         # query kernel compiled (first push)
         self._lat_ring: deque = deque(maxlen=8192)  # ms, summary() only
+        # raw (admit_ns, pop_ns) queue-wait intervals, monotonic clock:
+        # CLOCK_MONOTONIC is system-wide on Linux, so a bench can
+        # intersect a REPLICA's waits with the WRITER's ingest-busy
+        # windows across process boundaries (the off-writer contention
+        # measurement, ISSUE 14)
+        self._wait_ring: deque = deque(maxlen=8192)
         self._served_t0: float | None = None
         self._served_t1: float | None = None
         self._c_shed = self._c_served = self._hist = None
+        self._g_epoch = self._g_staleness = self._g_qps = None
         if registry is not None:
             self._c_shed = registry.counter(
                 "streambench_reach_shed_total",
-                "reach queries shed (oldest-first) beyond queue depth")
+                "reach queries shed (oldest-first beyond queue depth, "
+                "or past the staleness bound)")
             self._c_served = registry.counter(
                 "streambench_reach_served_total",
                 "reach queries answered with an estimate")
             self._hist = registry.histogram(
                 LATENCY_HIST,
                 "reach query latency, submit to reply (ms)")
+            # replica-tier gauges (ISSUE 14): live on the writer too —
+            # a writer-attached server is just a zero-staleness replica
+            self._g_epoch = registry.gauge(
+                "streambench_reach_replica_epoch",
+                "epoch of the sketch planes this server answers against")
+            self._g_staleness = registry.gauge(
+                "streambench_reach_replica_staleness_ms",
+                "age of the served planes: now minus their shipped "
+                "stamp (bounded by the shipping cadence when healthy)")
+            self._g_qps = registry.gauge(
+                "streambench_reach_replica_qps",
+                "served queries per second over the serving span")
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="reach-query")
         self._thread.start()
 
     # -- state push ----------------------------------------------------
-    def update_state(self, mins, registers, epoch: int) -> None:
+    def update_state(self, mins, registers, epoch: int,
+                     shipped_ms: int | None = None) -> None:
         """Engine-side push of the current sketch planes (immutable jax
         arrays; the reference handoff is atomic under the GIL).  The
         FIRST push warms the padded query kernel on the caller's thread
@@ -116,25 +169,70 @@ class ReachQueryServer:
         before announcing readiness") applied to the serving tier: an
         XLA compile racing a concurrently-dispatching ingest thread can
         starve for tens of seconds on a small host, and the first push
-        happens at attach time, before traffic."""
+        happens at attach time, before traffic.
+
+        ``shipped_ms``: host-ms stamp of when these planes were
+        serialized — the replica staleness clock.  Writer-attached
+        pushes omit it: their replies carry ``plane_epoch`` only
+        (stamping a wall-clock staleness there would make replies
+        nondeterministic for zero information — the planes ARE the
+        writer's live state)."""
         if not self._warmed:
             self._warm(mins, registers)
+        epoch = int(epoch)
+        if self._cache is not None:
+            # wholesale invalidation BEFORE the swap: a concurrent probe
+            # may briefly miss under the new epoch, never hit stale
+            self._cache.note_epoch(epoch)
         with self._cv:
             self._state = (mins, registers,
                            int(mins.shape[1]), int(registers.shape[1]),
-                           int(epoch))
+                           epoch,
+                           int(shipped_ms) if shipped_ms is not None
+                           else None)
             self._cv.notify()
+        if self._g_epoch is not None:
+            self._g_epoch.set(epoch)
 
     def _warm(self, mins, registers) -> None:
         try:
             C = len(self.campaigns)
-            np.asarray(rq.batch_query(
+            np.asarray(self._query_fn(
                 mins, registers, np.zeros((self.batch, C), bool),
                 np.zeros(self.batch, bool))[0])
             self._warmed = True
         except Exception:
             pass   # a failed warmup must not block serving; the first
             #        real batch compiles instead
+
+    # -- staleness (replica serving bound) -----------------------------
+    def staleness_ms(self, st=None) -> float | None:
+        """Age of the served planes (vs their shipped stamp), or None
+        when no push carried one (writer-attached: live state)."""
+        st = st if st is not None else self._state
+        if st is None or st[5] is None:
+            return None
+        return float(max(now_ms() - st[5], 0))
+
+    def _stale(self, st) -> bool:
+        """True when answering against ``st`` would violate the
+        staleness bound.  No bound configured -> never stale.  With a
+        bound: no state yet, OR no shipped stamp to prove freshness by,
+        OR a stamp older than the bound -> stale (shed, don't block)."""
+        if self.max_staleness_ms is None:
+            return False
+        return (st is None or st[5] is None
+                or (now_ms() - st[5]) > self.max_staleness_ms)
+
+    def use_query_fn(self, fn) -> None:
+        """Engine-side evaluator injection (``attach_reach``): the
+        sharded engine routes evaluation through its shard-local
+        two-collective program.  Respected only when the constructor
+        didn't already receive an explicit ``query_fn``; must run
+        BEFORE the first state push so the warmup compiles the right
+        kernel."""
+        if not self._query_fn_custom:
+            self._query_fn = fn
 
     @property
     def epoch(self) -> int | None:
@@ -155,7 +253,9 @@ class ReachQueryServer:
                client_ms=None) -> bool:
         """Admit one query.  Returns False when it was rejected outright
         (malformed); shedding affects the *oldest* queued query, never
-        the one being admitted."""
+        the one being admitted.  A cache hit under the live epoch
+        replies synchronously from THIS path — no queue, no dispatch."""
+        t0_ns = time.perf_counter_ns()
         if op not in ("union", "overlap") or not isinstance(
                 campaigns, (list, tuple)) or not campaigns:
             self.rejected += 1
@@ -171,6 +271,14 @@ class ReachQueryServer:
                                          "campaign": c, "id": query_id})
                 return False
             idx.append(i)
+        if self._cache is not None:
+            st = self._state
+            if st is not None and not self._stale(st):
+                entry = self._cache.get(st[4], idx, op)
+                if entry is not None:
+                    self._reply_cached(entry, st, reply, query_id,
+                                       trace, client_ms, t0_ns)
+                    return True
         rec = None
         if self._queryattr is not None:
             rec = self._queryattr.admit(trace=trace, qid=query_id,
@@ -213,12 +321,59 @@ class ReachQueryServer:
                     served=self.served)
         return True
 
-    def _reply_shed(self, item) -> None:
+    def _reply_cached(self, entry: dict, st, reply, query_id, trace,
+                      client_ms, t0_ns: int) -> None:
+        """One cache-hit reply, written synchronously from the admission
+        path.  Leaves exactly one served lifecycle record (queryattr
+        reconciliation holds) and lands in BOTH latency histograms —
+        the main serving one and the cache-hit one the A/B reads."""
+        payload = dict(entry)
+        payload["id"] = query_id
+        payload["cached"] = True
+        stale = self.staleness_ms(st)
+        if stale is not None:
+            payload["staleness_ms"] = round(stale, 1)
+        rec = None
+        ql = self._queryattr
+        if ql is not None:
+            rec = ql.admit(trace=trace, qid=query_id,
+                           client_ms=client_ms)
+            now = time.perf_counter_ns()
+            rec.t_exit = now
+            payload["server"] = ql.server_block(rec, now, now)
+        self._safe_reply(reply, payload)
+        if rec is not None:
+            now = time.perf_counter_ns()
+            ql.note_reply(rec, now, now)
+        lat_ms = (time.perf_counter_ns() - t0_ns) / 1e6
+        self._lat_ring.append(lat_ms)
+        if self._hist is not None:
+            self._hist.observe(lat_ms)
+        hh = getattr(self._cache, "hit_hist", None)
+        if hh is not None:
+            hh.observe(lat_ms)
+        self.served += 1
+        if self._c_served is not None:
+            self._c_served.inc()
+        now_m = time.monotonic()
+        if self._served_t0 is None:
+            self._served_t0 = now_m
+        self._served_t1 = now_m
+
+    def _reply_shed(self, item, reason: str | None = None,
+                    st=None) -> None:
         """Answer one shed victim ``{"shed": true}``; with query obs on
         the reply also carries the queue-only server block (shed
         queries stamp too — the record count reconciles against the
-        shed counter exactly)."""
+        shed counter exactly).  Staleness sheds name their reason and
+        the epoch/staleness evidence."""
         payload = {"shed": True, "id": item[3]}
+        if reason is not None:
+            payload["reason"] = reason
+            payload["plane_epoch"] = st[4] if st is not None else None
+            stale = self.staleness_ms(st) if st is not None else None
+            if stale is not None:
+                payload["staleness_ms"] = round(stale, 1)
         rec = item[5]
         if rec is not None:
             queue_ms = self._queryattr.note_shed(rec)
@@ -244,12 +399,27 @@ class ReachQueryServer:
             return len(self._q)
 
     # -- worker --------------------------------------------------------
+    def _shed_items(self, items: list, reason: str, st) -> None:
+        """Shed a popped batch (staleness bound): counted exactly like
+        depth sheds — shed + served == sent stays an invariant."""
+        for it in items:
+            self.shed += 1
+            self.shed_stale += 1
+            if self._c_shed is not None:
+                self._c_shed.inc()
+            self._reply_shed(it, reason=reason, st=st)
+
     def _run(self) -> None:
         while True:
             with self._cv:
                 while not self._closed and (
                         self._hold or not self._q
-                        or self._state is None):
+                        or (self._state is None
+                            and self.max_staleness_ms is None)):
+                    # a staleness-bounded replica with no loaded epoch
+                    # does NOT wait for one: it falls through and sheds
+                    # (clients must never block on a replica's first
+                    # snapshot load)
                     self._cv.wait(timeout=0.5)
                 if self._closed and (not self._q
                                      or self._state is None):
@@ -267,8 +437,10 @@ class ReachQueryServer:
                             self._c_shed.inc()
                 else:
                     leftovers = None
-                if leftovers is None and (self._hold
-                                          or self._state is None):
+                if leftovers is None and (
+                        self._hold
+                        or (self._state is None
+                            and self.max_staleness_ms is None)):
                     continue
                 items = state = None
                 if leftovers is None:
@@ -280,6 +452,11 @@ class ReachQueryServer:
                 for it in leftovers:
                     self._reply_shed(it)
                 return
+            if self._stale(state):
+                # staleness bound violated (or no epoch loaded yet):
+                # shed rather than answer evidence older than the bound
+                self._shed_items(items, reason="stale", st=state)
+                continue
             try:
                 self._evaluate(items, state)
             except Exception as e:   # a bad batch must not kill serving
@@ -287,15 +464,23 @@ class ReachQueryServer:
                     self._safe_reply(it[2], {"error": repr(e),
                                              "id": it[3]})
 
+    def wait_intervals(self) -> list:
+        """Raw [admit_ns, pop_ns] queue-wait intervals of evaluated
+        queries (monotonic clock, bounded ring)."""
+        return [list(t) for t in self._wait_ring]
+
     def _evaluate(self, items: list, state) -> None:
         ql = self._queryattr
         t_exit = time.perf_counter_ns()
+        m_exit = time.monotonic_ns()
+        for it in items:
+            self._wait_ring.append((int(it[4] * 1e9), m_exit))
         recs = []
         if ql is not None:
             recs = [it[5] for it in items if it[5] is not None]
             for r in recs:
                 r.t_exit = t_exit
-        mins, registers, k, R, epoch = state
+        mins, registers, k, R, epoch, shipped_ms = state
         C = len(self.campaigns)
         mask = np.zeros((self.batch, C), bool)
         overlap = np.zeros(self.batch, bool)
@@ -303,7 +488,7 @@ class ReachQueryServer:
             mask[row, idx] = True
             overlap[row] = is_overlap
         t_submit = time.perf_counter_ns()
-        est, union, jacc, _ = rq.batch_query(
+        est, union, jacc, _ = self._query_fn(
             mins, registers, mask, overlap)
         self.dispatches += 1
         # ALWAYS resolve the dispatch with block_until_ready before the
@@ -335,6 +520,8 @@ class ReachQueryServer:
         ub = rq.union_bound(R)
         ob = rq.overlap_bound(k, R)
         now = time.monotonic()
+        staleness = (round(max(now_ms() - shipped_ms, 0), 1)
+                     if shipped_ms is not None else None)
         if self._served_t0 is None:
             self._served_t0 = now
         for row, (idx, is_overlap, reply, qid, t0, rec) in enumerate(
@@ -346,8 +533,9 @@ class ReachQueryServer:
             self.served += 1
             if self._c_served is not None:
                 self._c_served.inc()
+            op_name = "overlap" if is_overlap else "union"
             payload = {
-                "op": "overlap" if is_overlap else "union",
+                "op": op_name,
                 "estimate": round(float(est[row]), 2),
                 "union": round(float(union[row]), 2),
                 "jaccard": round(float(jacc[row]), 5),
@@ -356,8 +544,21 @@ class ReachQueryServer:
                 # Jaccard estimator's natural scale)
                 "bound": round(ob if is_overlap else ub, 5),
                 "epoch": epoch,
+                # explicit scale-out stamp (ISSUE 14): which planes
+                # answered; replicas add how old their evidence was
+                "plane_epoch": epoch,
                 "id": qid,
             }
+            if staleness is not None:
+                payload["staleness_ms"] = staleness
+            if self._cache is not None:
+                # cache the epoch-scoped answer (everything but the
+                # per-query id and the reply-time staleness; put() is a
+                # no-op if the epoch already moved)
+                self._cache.put(epoch, idx, op_name, {
+                    key: payload[key]
+                    for key in ("op", "estimate", "union", "jaccard",
+                                "bound", "epoch", "plane_epoch")})
             if rec is not None:
                 # server-side decomposition (up to reply-write start):
                 # the client splits round-trip into network-vs-server
@@ -392,6 +593,7 @@ class ReachQueryServer:
     # -- reporting -----------------------------------------------------
     def summary(self) -> dict:
         lats = sorted(self._lat_ring)
+        st = self._state
         out = {
             "served": self.served,
             "shed": self.shed,
@@ -401,6 +603,17 @@ class ReachQueryServer:
             "queue_depth": self.depth,
             "queue_high_water": self.queue_high_water,
         }
+        if self.shed_stale:
+            out["shed_stale"] = self.shed_stale
+        if self.max_staleness_ms is not None:
+            out["max_staleness_ms"] = self.max_staleness_ms
+        if st is not None:
+            out["plane_epoch"] = st[4]
+            stale = self.staleness_ms(st)
+            if stale is not None:
+                out["staleness_ms"] = round(stale, 1)
+        if self._cache is not None:
+            out["cache"] = self._cache.summary()
         if self._queryattr is not None:
             out["query_obs"] = self._queryattr.summary()
         if lats:
@@ -411,6 +624,12 @@ class ReachQueryServer:
                 and self._served_t1 > self._served_t0 and self.served):
             out["qps"] = round(
                 self.served / (self._served_t1 - self._served_t0), 1)
+        # live replica gauges (scraped between summary calls they hold
+        # the last reading; the sampler collector calls summary per tick)
+        if self._g_staleness is not None and "staleness_ms" in out:
+            self._g_staleness.set(out["staleness_ms"])
+        if self._g_qps is not None and "qps" in out:
+            self._g_qps.set(out["qps"])
         return out
 
     def close(self) -> None:

@@ -205,3 +205,55 @@ def test_fragmented_message_and_junk_json_tolerated():
         s.close()
     finally:
         srv.close()
+
+
+def test_slow_client_does_not_stall_other_replies():
+    """ISSUE 14 satellite: replies ride a per-connection queue drained
+    by a per-connection writer, so one slow client socket (tiny recv
+    buffer, never read) cannot stall a reply batch to healthy clients —
+    the reach worker's reply loop must never block on a stranger's TCP
+    window."""
+    import threading
+    import time
+
+    srv = PubSubServer().start()
+    host, port = srv.address
+    try:
+        # a "reach-like" query verb that answers every request with a
+        # burst of replies to EVERY subscriber-ish connection the way
+        # the serve worker does: synchronously, in one loop
+        replies: list = []
+
+        def verb(msg, reply):
+            reply({"id": msg.get("id"), "answer": True})
+
+        srv.register_query("q", verb)
+
+        # slow victim: subscribes to a topic, never reads, tiny buffer
+        slow = socket.create_connection((host, port))
+        slow.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1024)
+        slow.sendall(b'{"type": "subscribe", "topic": "t"}\n')
+        time.sleep(0.2)
+
+        # saturate the slow client's queue/window with fat payloads
+        blob = "x" * 4096
+        for _ in range(64):
+            srv.publish("t", {"blob": blob})
+
+        # a healthy client's query replies must land promptly even
+        # while the slow connection is wedged
+        fast = PubSubClient(host, port, timeout_s=10)
+        t0 = time.monotonic()
+        for i in range(20):
+            fast.request({"type": "q", "id": i})
+            got = fast.recv()["data"]
+            assert got == {"id": i, "answer": True}
+        elapsed = time.monotonic() - t0
+        fast.close()
+        slow.close()
+        # pre-queue, each publish to the wedged socket could eat up to
+        # timeout_s (1 s) INSIDE the publisher; 20 round trips staying
+        # well under one such stall proves the decoupling
+        assert elapsed < 5.0, elapsed
+    finally:
+        srv.close()

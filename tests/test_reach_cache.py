@@ -1,0 +1,179 @@
+"""(epoch, campaign-set, kind) reach query-result cache (reach/cache.py,
+ISSUE 14): canonical keys, LRU bounds, wholesale epoch invalidation (a
+stale entry is NEVER served after a bump — the correctness property),
+and the serve-layer integration (hit replies identical + instrumented).
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from streambench_tpu.obs import MetricsRegistry
+from streambench_tpu.ops import minhash
+from streambench_tpu.reach.cache import ReachQueryCache
+from streambench_tpu.reach.serve import ReachQueryServer
+
+
+def fold_state(users, C=4, k=16, R=16):
+    st = minhash.init_state(C, k, R)
+    join = jnp.asarray(np.arange(C, dtype=np.int32))
+    B = len(users)
+    return minhash.step(
+        st, join,
+        jnp.asarray(np.zeros(B, np.int32)),
+        jnp.asarray(np.asarray(users, np.int32)),
+        jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+        jnp.ones(B, bool))
+
+
+# ------------------------------------------------------------ unit
+def test_canonical_key_and_counts():
+    c = ReachQueryCache(capacity=8)
+    c.note_epoch(1)
+    assert c.get(1, [2, 0, 1], "union") is None          # miss
+    c.put(1, [2, 0, 1], "union", {"estimate": 5.0})
+    assert c.get(1, [0, 1, 2], "union") == {"estimate": 5.0}  # order-free
+    assert c.get(1, [0, 1, 2], "overlap") is None        # kind in key
+    assert c.get(2, [0, 1, 2], "union") is None          # epoch in key
+    s = c.summary()
+    assert s["hits"] == 1 and s["misses"] == 3
+    assert s["hit_ratio"] == 0.25
+
+
+def test_lru_eviction_bounded():
+    reg = MetricsRegistry()
+    c = ReachQueryCache(capacity=3, registry=reg)
+    c.note_epoch(1)
+    for i in range(5):
+        c.put(1, [i], "union", {"estimate": float(i)})
+    assert len(c) == 3
+    assert c.evictions == 2
+    assert c.get(1, [0], "union") is None       # oldest evicted
+    assert c.get(1, [4], "union") is not None   # newest kept
+    # touching an entry protects it from the next eviction
+    c.get(1, [2], "union")
+    c.put(1, [9], "union", {"estimate": 9.0})
+    assert c.get(1, [2], "union") is not None
+    assert c.get(1, [3], "union") is None
+    assert reg.counter(
+        "streambench_reach_cache_evictions_total").value == 3
+
+
+def test_epoch_bump_invalidates_wholesale():
+    c = ReachQueryCache(capacity=8)
+    c.note_epoch(1)
+    c.put(1, [0], "union", {"estimate": 1.0})
+    c.put(1, [1], "union", {"estimate": 2.0})
+    assert len(c) == 2
+    c.note_epoch(2)
+    assert len(c) == 0
+    assert c.invalidations == 1
+    # a worker racing the bump cannot resurrect old-epoch results
+    c.put(1, [0], "union", {"estimate": 1.0})
+    assert len(c) == 0
+    assert c.get(2, [0], "union") is None
+
+
+# ------------------------------------------------------- serve layer
+def drain(srv, got, n, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(got) >= n, (len(got), n)
+
+
+def test_cached_reply_identical_and_instrumented():
+    reg = MetricsRegistry()
+    cache = ReachQueryCache(capacity=64, registry=reg)
+    srv = ReachQueryServer(list("abcd"), depth=32, batch=8,
+                           registry=reg, cache=cache)
+    st = fold_state([11, 22, 33])
+    srv.update_state(st.mins, st.registers, epoch=1)
+    got = []
+    try:
+        srv.submit(["a", "b"], "union", lambda d: got.append(d),
+                   query_id=1)
+        drain(srv, got, 1)
+        srv.submit(["b", "a"], "union", lambda d: got.append(d),
+                   query_id=2)   # same canonical set -> hit
+        drain(srv, got, 2)
+    finally:
+        srv.close()
+    miss, hit = got
+    assert "cached" not in miss
+    assert hit["cached"] is True
+    for key in ("op", "estimate", "union", "jaccard", "bound", "epoch",
+                "plane_epoch"):
+        assert hit[key] == miss[key], key
+    assert hit["id"] == 2
+    assert srv.served == 2
+    assert srv.dispatches == 1          # the hit never dispatched
+    assert cache.summary()["hits"] == 1
+    assert reg.counter(
+        "streambench_reach_cache_hits_total").value == 1
+    assert reg.counter(
+        "streambench_reach_cache_misses_total").value == 1
+    hist = reg.histogram("streambench_reach_cache_hit_ms")
+    assert hist.summary().get("count") == 1
+
+
+def test_stale_entry_never_served_after_epoch_bump():
+    """THE invalidation property: after an epoch bump with different
+    planes, the answer must come from the new planes — never the cached
+    old-epoch result."""
+    cache = ReachQueryCache(capacity=64)
+    srv = ReachQueryServer(list("abcd"), depth=32, batch=8, cache=cache)
+    st1 = fold_state([1, 2, 3])
+    st2 = fold_state([1, 2, 3, 4, 5, 6, 7, 8])
+    srv.update_state(st1.mins, st1.registers, epoch=1)
+    got = []
+    try:
+        srv.submit(["a"], "union", lambda d: got.append(d), query_id=1)
+        drain(srv, got, 1)
+        srv.update_state(st2.mins, st2.registers, epoch=2)
+        srv.submit(["a"], "union", lambda d: got.append(d), query_id=2)
+        drain(srv, got, 2)
+    finally:
+        srv.close()
+    old, new = got
+    assert old["plane_epoch"] == 1 and new["plane_epoch"] == 2
+    assert not new.get("cached")
+    assert new["estimate"] != old["estimate"]  # different planes
+    # and the post-bump answer seeds the NEW epoch's cache
+    assert cache.summary()["epoch"] == 2
+    assert cache.summary()["entries"] == 1
+
+
+def test_queryattr_reconciles_with_cache_hits():
+    """Cache-hit replies leave exactly one served lifecycle record, so
+    the ISSUE 11 reconciliation (records == served counter) holds with
+    the cache in the path."""
+    from streambench_tpu.obs.queryattr import QueryLifecycle
+
+    reg = MetricsRegistry()
+    ql = QueryLifecycle(reg)
+    cache = ReachQueryCache(capacity=64, registry=reg)
+    srv = ReachQueryServer(list("abcd"), depth=32, batch=8,
+                           registry=reg, cache=cache, queryattr=ql)
+    st = fold_state([5, 6])
+    srv.update_state(st.mins, st.registers, epoch=1)
+    got = []
+    try:
+        for i in range(3):   # first round fills the cache
+            srv.submit([list("abc")[i]], "union",
+                       lambda d: got.append(d), query_id=i,
+                       trace=f"t{i}")
+        drain(srv, got, 3)
+        for i in range(3, 6):   # second round hits it
+            srv.submit([list("abc")[i % 3]], "union",
+                       lambda d: got.append(d), query_id=i,
+                       trace=f"t{i}")
+        drain(srv, got, 6)
+    finally:
+        srv.close()
+    assert srv.served == 6
+    assert ql.summary()["served_records"] == 6
+    assert cache.summary()["hits"] >= 1
+    hits = [d for d in got if d.get("cached")]
+    assert hits and all("server" in d for d in hits)

@@ -1,0 +1,254 @@
+"""Snapshot-shipped reach read replicas (reach/replica.py, ISSUE 14):
+shipper cadence + epoch-bump immediacy, the ship-log tailer (torn
+tails), replica serving with plane_epoch/staleness_ms stamps, the
+staleness-bound shed property (a reply's plane_epoch is never older
+than the bound allows — stale planes shed instead), and shed-or-answer
+exactness under a chaos storm with a replica attached."""
+
+import json
+import os
+import random
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.dimensions.store import DurableDimensionStore
+from streambench_tpu.ops import minhash
+from streambench_tpu.reach.replica import (
+    DEFAULT_MAX_STALENESS_MS,
+    ReachReplica,
+    ShipLogTailer,
+    SnapshotShipper,
+    decode_ship_record,
+)
+from streambench_tpu.utils.ids import now_ms
+
+NAMES = ["c0", "c1", "c2"]
+
+
+def fold_state(users, C=3, k=16, R=16):
+    st = minhash.init_state(C, k, R)
+    join = jnp.asarray(np.arange(C, dtype=np.int32))
+    B = len(users)
+    return minhash.step(
+        st, join,
+        jnp.asarray(np.zeros(B, np.int32)),
+        jnp.asarray(np.asarray(users, np.int32)),
+        jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+        jnp.ones(B, bool))
+
+
+# ------------------------------------------------------------ shipper
+def test_shipper_cadence_and_epoch_bump(tmp_path):
+    store = DurableDimensionStore(str(tmp_path))
+    ship = SnapshotShipper(store, NAMES, interval_ms=10_000)
+    st = fold_state([1, 2, 3])
+    assert ship.note_state(st.mins, st.registers, 0, 70_000)
+    # within the cadence, same epoch: suppressed
+    assert not ship.due(0)
+    assert not ship.note_state(st.mins, st.registers, 0, 70_000)
+    # an epoch bump ships IMMEDIATELY (replicas must learn about a
+    # restore within one poll, not one cadence)
+    assert ship.due(1)
+    assert ship.note_state(st.mins, st.registers, 1, 70_000)
+    # force bypasses the cadence (the writer's close-time ship)
+    assert ship.note_state(st.mins, st.registers, 1, 80_000,
+                           force=True)
+    assert ship.ships == 3
+    store.close()
+    # the shipped record is the PR 10 base64 plane record + watermark
+    rec = DurableDimensionStore(str(tmp_path)).reach_sketches()
+    assert rec["epoch"] == 1 and rec["watermark"] == 80_000
+    assert np.array_equal(rec["mins"], np.asarray(st.mins))
+    assert np.array_equal(rec["registers"], np.asarray(st.registers))
+
+
+def test_tailer_incremental_and_torn_tail(tmp_path):
+    store = DurableDimensionStore(str(tmp_path))
+    ship = SnapshotShipper(store, NAMES, interval_ms=1)
+    tail = ShipLogTailer(store.path)
+    assert tail.poll() is None
+    st = fold_state([1])
+    ship.note_state(st.mins, st.registers, 0, 1)
+    rec = tail.poll()
+    assert rec is not None and rec["epoch"] == 0
+    assert tail.poll() is None            # nothing new
+    ship.note_state(st.mins, st.registers, 1, 2)
+    ship.note_state(st.mins, st.registers, 2, 3)
+    rec = tail.poll()
+    assert rec["epoch"] == 2              # newest of the batch wins
+    # a torn tail line stays buffered until its newline lands
+    good = json.dumps({"kind": "reach_sketch", "t": now_ms(),
+                       "epoch": 7, "c": NAMES, "k": 16, "r": 16,
+                       "mins": rec_b64(st.mins),
+                       "regs": rec_b64(st.registers, np.int32)})
+    with open(store.path, "a") as f:
+        f.write(good[: len(good) // 2])
+        f.flush()
+    assert tail.poll() is None
+    with open(store.path, "a") as f:
+        f.write(good[len(good) // 2:] + "\n")
+    assert tail.poll()["epoch"] == 7
+    store.close()
+
+
+def rec_b64(arr, dtype=np.uint32):
+    import base64
+
+    return base64.b64encode(
+        np.ascontiguousarray(np.asarray(arr), dtype=dtype).tobytes()
+    ).decode()
+
+
+# ------------------------------------------------------------ replica
+def ask(host, port, campaigns, qid, op="union"):
+    from streambench_tpu.dimensions.pubsub import PubSubClient
+
+    c = PubSubClient(host, port, timeout_s=20)
+    c.request({"type": "reach", "campaigns": campaigns, "op": op,
+               "id": qid})
+    out = c.recv()["data"]
+    c.close()
+    return out
+
+
+def test_replica_serves_epoch_stamped_and_staleness_bounded(tmp_path):
+    store = DurableDimensionStore(str(tmp_path))
+    ship = SnapshotShipper(store, NAMES, interval_ms=1)
+    st = fold_state([10, 20, 30])
+    shipped_at = now_ms()
+    ship.note_state(st.mins, st.registers, 3, 70_000)
+    # deterministic tailing: start only the endpoint, poll by hand
+    rep = ReachReplica(store.path, poll_ms=20_000)
+    rep.pubsub.start()
+    try:
+        assert rep.poll_once()
+        host, port = rep.address
+        d = ask(host, port, ["c0", "c1"], 1)
+        assert "estimate" in d
+        # the staleness-bound property: the reply's plane epoch is the
+        # newest shipped epoch and its staleness honestly measures the
+        # record age (bounded by cadence + poll in a healthy loop)
+        assert d["plane_epoch"] == 3
+        assert 0 <= d["staleness_ms"] <= (now_ms() - shipped_at) + 50
+        assert d["staleness_ms"] <= DEFAULT_MAX_STALENESS_MS
+        # expected estimate == single-device evaluation of the planes
+        from streambench_tpu.reach import query as rq
+
+        m = np.zeros((1, 3), bool)
+        m[0, :2] = True
+        want, *_ = rq.batch_query(st.mins, st.registers,
+                                  jnp.asarray(m),
+                                  jnp.asarray([False]))
+        assert d["estimate"] == round(float(np.asarray(want)[0]), 2)
+    finally:
+        rep.close()
+        store.close()
+
+
+def test_replica_sheds_before_first_epoch_and_past_bound(tmp_path):
+    """The shed-not-stale contract: no epoch loaded -> shed; planes
+    older than the bound -> shed with reason + evidence; a fresh ship
+    resumes answering."""
+    store = DurableDimensionStore(str(tmp_path))
+    ship = SnapshotShipper(store, NAMES, interval_ms=1)
+    rep = ReachReplica(store.path, poll_ms=20_000,
+                       max_staleness_ms=300)
+    rep.pubsub.start()
+    try:
+        host, port = rep.address
+        d = ask(host, port, ["c0"], 1)
+        assert d.get("shed") and d.get("reason") == "stale"
+        assert d["plane_epoch"] is None
+        assert rep.shed_before_load == 1
+
+        st = fold_state([1, 2])
+        ship.note_state(st.mins, st.registers, 0, 1)
+        assert rep.poll_once()
+        d = ask(host, port, ["c0"], 2)
+        assert "estimate" in d and d["plane_epoch"] == 0
+
+        # age the planes past the bound: shed, with the evidence
+        time.sleep(0.4)
+        d = ask(host, port, ["c0"], 3)
+        assert d.get("shed") and d.get("reason") == "stale"
+        assert d["plane_epoch"] == 0 and d["staleness_ms"] > 300
+        assert rep.server.shed_stale >= 1
+
+        # a fresh ship resumes service on the new record
+        ship.note_state(st.mins, st.registers, 1, 2)
+        assert rep.poll_once()
+        d = ask(host, port, ["c0"], 4)
+        assert "estimate" in d and d["plane_epoch"] == 1
+        # invariants: every query shed or answered, none lost
+        s = rep.server.summary()
+        assert s["served"] + s["shed"] == 3  # (q2..q4; q1 pre-server)
+    finally:
+        rep.close()
+        store.close()
+
+
+def test_replica_chaos_storm_sheds_or_answers_exactly(tmp_path):
+    """Chaos with a replica attached: concurrent epoch bumps (the
+    restore signature) + re-ships while a query storm runs against the
+    replica.  Every query sheds or answers; every answer's plane_epoch
+    is one of the shipped epochs; after the dust settles answers carry
+    the LIVE epoch."""
+    from streambench_tpu.dimensions.pubsub import PubSubClient
+
+    store = DurableDimensionStore(str(tmp_path))
+    ship = SnapshotShipper(store, NAMES, interval_ms=1)
+    states = {e: fold_state(list(range(1, 3 + e * 5))) for e in range(5)}
+    ship.note_state(states[0].mins, states[0].registers, 0, 1)
+    rep = ReachReplica(store.path, poll_ms=5,
+                       max_staleness_ms=5_000).start()
+    stop = threading.Event()
+
+    def chaos():
+        rng = random.Random(9)
+        e = 0
+        while not stop.is_set():
+            e = min(e + rng.choice([0, 1]), 4)
+            st = states[e]
+            ship.note_state(st.mins, st.registers, e,
+                            1 + e, force=True)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=chaos)
+    t.start()
+    answers = []
+    try:
+        host, port = rep.address
+        c = PubSubClient(host, port, timeout_s=30)
+        n = 120
+        for i in range(n):
+            c.request({"type": "reach",
+                       "campaigns": [NAMES[i % 3]],
+                       "op": "union", "id": i})
+            answers.append(c.recv()["data"])
+            time.sleep(0.002)
+        c.close()
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert len(answers) == 120
+    assert all(("estimate" in d) or d.get("shed") for d in answers)
+    served = [d for d in answers if "estimate" in d]
+    assert served, "storm served nothing"
+    assert all(d["plane_epoch"] in range(5) for d in served)
+    assert all("staleness_ms" in d for d in served)
+    # settle: the poller converges on the final shipped record
+    time.sleep(0.2)
+    rep.poll_once()
+    d = ask(*rep.address, ["c0"], "final")
+    assert "estimate" in d
+    assert d["plane_epoch"] == rep.server.epoch
+    s = rep.summary()
+    assert s["serve"]["served"] + s["serve"]["shed"] \
+        + s["shed_before_load"] == 121
+    rep.close()
+    store.close()

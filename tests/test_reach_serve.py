@@ -195,6 +195,9 @@ def test_query_storm_under_sink_outage_and_crashes(tmp_path):
         str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
     campaigns = gen.load_ids(str(tmp_path))[0]
 
+    from streambench_tpu.dimensions.store import DurableDimensionStore
+    from streambench_tpu.reach.replica import ReachReplica, SnapshotShipper
+
     plan = FaultPlan.generate(77, sink_rate=0.3, sink_ops=8,
                               sink_outage=(0, 4), crashes=0)
     plan = FaultPlan(seed=plan.seed, sink_faults=plan.sink_faults,
@@ -205,6 +208,9 @@ def test_query_storm_under_sink_outage_and_crashes(tmp_path):
     srv = ReachQueryServer(campaigns, depth=8, batch=4)
     ps = PubSubServer(port=0).start()
     ps.register_query("reach", srv.handle)
+    # ISSUE 14: a replica rides the same chaos run — the shipper runs
+    # inside every crashing lineage, the replica tails across crashes
+    ship_store = DurableDimensionStore(str(tmp_path / "ship"))
     engines = []
 
     def make_runner():
@@ -212,6 +218,8 @@ def test_query_storm_under_sink_outage_and_crashes(tmp_path):
                                 redis=inj.wrap_redis(r), k=16,
                                 registers=16)
         eng.attach_reach(srv)
+        eng.attach_shipper(SnapshotShipper(ship_store, campaigns,
+                                           interval_ms=1))
         engines.append(eng)
         reader = inj.wrap_reader(broker.reader(cfg.kafka_topic))
         return StreamRunner(eng, reader, checkpointer=ckpt,
@@ -273,6 +281,36 @@ def test_query_storm_under_sink_outage_and_crashes(tmp_path):
         assert all(("estimate" in d) or d.get("shed") for d in data)
         published = {e.reach_epoch for e in engines} | {0}
         assert {d["epoch"] for d in data if "epoch" in d} <= published
+        # replica across the chaos: tails the shipped records (written
+        # by every crashed-and-resumed lineage) and answers with a
+        # published epoch and exact single-device results
+        import numpy as _np
+
+        from streambench_tpu.reach import query as _rq
+
+        rep = ReachReplica(ship_store.path, poll_ms=20_000)
+        rep.pubsub.start()
+        try:
+            assert rep.poll_once(), "no shipped record survived chaos"
+            rh, rp = rep.address
+            c = PubSubClient(rh, rp, timeout_s=30)
+            c.request({"type": "reach", "campaigns": campaigns[:2],
+                       "op": "union", "id": "rep"})
+            d = c.recv()["data"]
+            c.close()
+            assert "estimate" in d, d
+            assert d["plane_epoch"] in published
+            assert "staleness_ms" in d
+            rec = ship_store.reach_sketches()
+            m = _np.zeros((1, len(campaigns)), bool)
+            m[0, :2] = True
+            want, *_ = _rq.batch_query(
+                jnp.asarray(rec["mins"]), jnp.asarray(rec["registers"]),
+                jnp.asarray(m), jnp.asarray([False]))
+            assert d["estimate"] == round(float(_np.asarray(want)[0]), 2)
+        finally:
+            rep.close()
+            ship_store.close()
     finally:
         done.set()
         t.join(timeout=10)

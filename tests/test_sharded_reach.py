@@ -1,0 +1,290 @@
+"""ShardedReachEngine (parallel/reach.py, ISSUE 14): bit-identity with
+the single-device minhash kernels over adversarial shard splits and
+seeds, query evaluation next to the shards (agree counts AND float
+estimates exact), the two-collective HLO claim, engine end-to-end
+equality through the real runner, and the snapshot upgrade path."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.datagen import gen
+from streambench_tpu.engine.runner import StreamRunner
+from streambench_tpu.engine.sketches import ReachSketchEngine
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.ops import minhash
+from streambench_tpu.parallel.mesh import build_mesh
+from streambench_tpu.parallel.reach import (
+    ShardedReachEngine,
+    _build_reach_query,
+    _build_reach_scan,
+    _build_reach_step,
+    pad_campaigns,
+    sharded_reach_init,
+)
+from streambench_tpu.reach import query as rq
+
+C, K_SLOTS, R = 10, 32, 32
+MESHES = [(8, 1), (4, 2), (2, 4), (1, 8), (2, 2)]
+
+
+def make_join(n_ads=14):
+    # several ads per campaign + an unknown-ad slot (-1): join misses
+    # are part of the adversarial mix
+    rng = np.random.default_rng(3)
+    return np.concatenate([rng.integers(0, C, n_ads - 1),
+                           [-1]]).astype(np.int32)
+
+
+def rand_batches(rng, n_batches, B, join):
+    out = []
+    t = 70_000
+    for _ in range(n_batches):
+        out.append((
+            rng.integers(0, len(join), B).astype(np.int32),
+            rng.integers(0, 4000, B).astype(np.int32),
+            rng.integers(0, 3, B).astype(np.int32),
+            (t + rng.integers(0, 5_000, B)).astype(np.int32),
+            rng.random(B) < 0.9,
+        ))
+        t += 5_000
+    return out
+
+
+def fold_ref(join, batches):
+    st = minhash.init_state(C, K_SLOTS, R)
+    jt = jnp.asarray(join)
+    for ad, user, et, tm, v in batches:
+        st = minhash.step(st, jt, jnp.asarray(ad), jnp.asarray(user),
+                          jnp.asarray(et), jnp.asarray(tm),
+                          jnp.asarray(v))
+    return st
+
+
+def assert_planes_equal(sharded, ref, label):
+    assert np.array_equal(np.asarray(sharded.mins)[:C],
+                          np.asarray(ref.mins)), label
+    assert np.array_equal(np.asarray(sharded.registers)[:C],
+                          np.asarray(ref.registers)), label
+    assert int(sharded.watermark) == int(ref.watermark), label
+
+
+@pytest.mark.parametrize("dshape", MESHES)
+def test_step_scan_packed_bit_identity(dshape):
+    """Per-batch step, hoisted scan, and packed hoisted scan all land
+    the exact single-device planes on every mesh split."""
+    from streambench_tpu.ops import windowcount as wc
+
+    nd, nc = dshape
+    mesh = build_mesh(data=nd, campaign=nc)
+    join = make_join()
+    jt = jnp.asarray(join)
+    for seed in (0, 1):
+        rng = np.random.default_rng(seed)
+        batches = rand_batches(rng, 4, nd * 16, join)
+        ref = fold_ref(join, batches)
+
+        # per-batch step sequence
+        st = sharded_reach_init(C, K_SLOTS, R, mesh)
+        fn = _build_reach_step(mesh)
+        for ad, user, et, tm, v in batches:
+            mins, regs, wm = fn(st.mins, st.registers, st.watermark,
+                                jt, ad, user, et, tm, v)
+            st = minhash.ReachState(mins, regs, wm, st.dropped)
+        assert_planes_equal(st, ref, f"step mesh={dshape} seed={seed}")
+
+        # hoisted scan over the stacked batches
+        st2 = sharded_reach_init(C, K_SLOTS, R, mesh)
+        scan = _build_reach_scan(mesh)
+        stacks = [np.stack(cols) for cols in zip(*batches)]
+        mins, regs, wm = scan(st2.mins, st2.registers, st2.watermark,
+                              jt, *stacks)
+        st2 = minhash.ReachState(mins, regs, wm, st2.dropped)
+        assert_planes_equal(st2, ref, f"scan mesh={dshape} seed={seed}")
+
+        # packed hoisted scan (packed word + user + time)
+        st3 = sharded_reach_init(C, K_SLOTS, R, mesh)
+        pscan = _build_reach_scan(mesh, packed=True)
+        packed = np.stack([np.asarray(wc.pack_columns(a, e, v))
+                           for a, _, e, _, v in batches])
+        mins, regs, wm = pscan(
+            st3.mins, st3.registers, st3.watermark, jt,
+            packed, stacks[1], stacks[3])
+        st3 = minhash.ReachState(mins, regs, wm, st3.dropped)
+        assert_planes_equal(st3, ref, f"packed mesh={dshape} seed={seed}")
+
+
+@pytest.mark.parametrize("dshape", [(1, 8), (2, 4), (4, 2)])
+def test_query_next_to_shards_bit_identity(dshape):
+    """The two-collective sharded query returns the single-device
+    batch_query's results exactly — integer collision counts AND the
+    float estimates (the merge runs on integers; the float arithmetic
+    is the same post-merge graph)."""
+    nd, nc = dshape
+    mesh = build_mesh(data=nd, campaign=nc)
+    join = make_join()
+    rng = np.random.default_rng(7)
+    ref = fold_ref(join, rand_batches(rng, 4, 64, join))
+
+    Q = 24
+    masks = np.zeros((Q, C), bool)
+    overlap = np.zeros(Q, bool)
+    for i in range(Q - 2):   # leave 2 all-False rows (padding shape)
+        masks[i, rng.choice(C, size=int(rng.integers(1, 6)),
+                            replace=False)] = True
+        overlap[i] = bool(rng.integers(0, 2))
+    e0, u0, j0, a0 = rq.batch_query(ref.mins, ref.registers,
+                                    jnp.asarray(masks),
+                                    jnp.asarray(overlap))
+
+    st = sharded_reach_init(C, K_SLOTS, R, mesh)
+    st = minhash.ReachState(
+        jnp.asarray(np.concatenate(
+            [np.asarray(ref.mins),
+             np.full((pad_campaigns(C, mesh) - C, K_SLOTS),
+                     minhash.EMPTY, np.uint32)])),
+        jnp.asarray(np.concatenate(
+            [np.asarray(ref.registers),
+             np.zeros((pad_campaigns(C, mesh) - C, R), np.int32)])),
+        st.watermark, st.dropped)
+    qfn = _build_reach_query(mesh)
+    mp = np.concatenate(
+        [masks, np.zeros((Q, pad_campaigns(C, mesh) - C), bool)],
+        axis=1)
+    e1, u1, j1, a1 = qfn(st.mins, st.registers, jnp.asarray(mp),
+                         jnp.asarray(overlap))
+    assert np.array_equal(np.asarray(a0), np.asarray(a1))
+    assert np.array_equal(np.asarray(e0), np.asarray(e1))
+    assert np.array_equal(np.asarray(u0), np.asarray(u1))
+    assert np.array_equal(np.asarray(j0), np.asarray(j1))
+
+
+def test_query_dispatch_is_exactly_two_collectives():
+    """The transferable claim, read from the compiled program: one
+    all-reduce min + one all-reduce max per query dispatch on a
+    multi-shard mesh — independent of Q, C, and the campaign fan-out."""
+    from streambench_tpu.parallel import collectives
+
+    mesh = build_mesh(data=1, campaign=8)
+    st = sharded_reach_init(C, K_SLOTS, R, mesh)
+    Cp = pad_campaigns(C, mesh)
+    qfn = _build_reach_query(mesh)
+    rep = collectives.report_for(
+        qfn, st.mins, st.registers,
+        jnp.zeros((64, Cp), bool), jnp.zeros((64,), bool))
+    per = rep["per_dispatch"]
+    assert per["ops"] == 2, per
+    assert per["by_kind"] == {"all-reduce": 2}, per
+    # payload: [Q, k] uint32 pmin + [Q, k + R] uint32 pmax
+    assert per["bytes"] == 64 * K_SLOTS * 4 + 64 * (K_SLOTS + R) * 4
+
+
+def test_engine_end_to_end_and_query_callable(tmp_path):
+    """ShardedReachEngine through the real runner on a generator
+    journal: planes and served query results bit-identical to the
+    single-device ReachSketchEngine; batch padding exercised."""
+    cfg = default_config(jax_batch_size=250)  # 250 % data-axis pads
+    broker = FileBroker(str(tmp_path / "broker"))
+    r1 = as_redis(FakeRedisStore())
+    gen.do_setup(r1, cfg, broker=broker, events_num=5_000,
+                 rng=random.Random(11), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+
+    mesh = build_mesh(data=4, campaign=2)
+    eng = ShardedReachEngine(cfg, mapping, mesh, redis=None,
+                             k=K_SLOTS, registers=R)
+    assert eng._data_pad == 2  # 250 % 4
+    stats = StreamRunner(eng, broker.reader(cfg.kafka_topic)
+                         ).run_catchup()
+    assert stats.events == 5_000
+
+    ref = ReachSketchEngine(cfg, mapping, redis=None,
+                            k=K_SLOTS, registers=R)
+    StreamRunner(ref, broker.reader(cfg.kafka_topic)).run_catchup()
+
+    host = eng.host_state()
+    assert np.array_equal(host.mins, np.asarray(ref.state.mins))
+    assert np.array_equal(host.registers,
+                          np.asarray(ref.state.registers))
+
+    # queries evaluated next to the shards == single-device evaluation
+    names = list(eng.encoder.campaigns)
+    rng = np.random.default_rng(2)
+    Q = 16
+    masks = np.zeros((Q, len(names)), bool)
+    overlap = np.zeros(Q, bool)
+    for i in range(Q):
+        masks[i, rng.choice(len(names), size=2, replace=False)] = True
+        overlap[i] = bool(i % 2)
+    es, us, js, ags = eng.batch_query(masks, overlap)
+    e0, u0, j0, a0 = rq.batch_query(
+        ref.state.mins, ref.state.registers, jnp.asarray(masks),
+        jnp.asarray(overlap))
+    assert np.array_equal(ags, np.asarray(a0))
+    assert np.array_equal(es, np.asarray(e0))
+
+    # the serving path routes through the injected sharded evaluator
+    from streambench_tpu.reach.serve import ReachQueryServer
+
+    srv = ReachQueryServer(names, depth=32, batch=8)
+    eng.attach_reach(srv)
+    got = []
+    try:
+        srv.submit([names[0], names[1]], "union",
+                   lambda d: got.append(d), query_id=1)
+        deadline = 50
+        while not got and deadline:
+            import time
+
+            time.sleep(0.1)
+            deadline -= 1
+    finally:
+        srv.close()
+    assert got and "estimate" in got[0], got
+    i0, i1 = names.index(names[0]), names.index(names[1])
+    m = np.zeros((1, len(names)), bool)
+    m[0, [i0, i1]] = True
+    want, *_ = rq.batch_query(ref.state.mins, ref.state.registers,
+                              jnp.asarray(m), jnp.asarray([False]))
+    assert got[0]["estimate"] == round(float(np.asarray(want)[0]), 2)
+
+
+def test_snapshot_roundtrip_and_upgrade_path(tmp_path):
+    """Sharded -> sharded snapshot round trip, and the upgrade path: a
+    single-device reach snapshot restores into the sharded engine with
+    campaign padding (epoch bumps on restore, serving stays exact)."""
+    cfg = default_config(jax_batch_size=256)
+    broker = FileBroker(str(tmp_path / "broker"))
+    r = as_redis(FakeRedisStore())
+    gen.do_setup(r, cfg, broker=broker, events_num=3_000,
+                 rng=random.Random(4), workdir=str(tmp_path))
+    mapping = gen.load_ad_mapping_file(
+        str(tmp_path / gen.AD_TO_CAMPAIGN_FILE))
+    mesh = build_mesh(data=1, campaign=8)
+
+    ref = ReachSketchEngine(cfg, mapping, redis=None, k=K_SLOTS,
+                            registers=R)
+    StreamRunner(ref, broker.reader(cfg.kafka_topic)).run_catchup()
+    snap = ref.snapshot(offset=123)
+
+    eng = ShardedReachEngine(cfg, mapping, mesh, redis=None,
+                             k=K_SLOTS, registers=R)
+    eng.restore(snap)
+    assert eng.reach_epoch == ref.reach_epoch + 1
+    host = eng.host_state()
+    assert np.array_equal(host.mins, np.asarray(ref.state.mins))
+    assert np.array_equal(host.registers,
+                          np.asarray(ref.state.registers))
+
+    snap2 = eng.snapshot(offset=456)
+    eng2 = ShardedReachEngine(cfg, mapping, mesh, redis=None,
+                              k=K_SLOTS, registers=R)
+    eng2.restore(snap2)
+    assert np.array_equal(eng2.host_state().mins, host.mins)
+    assert np.array_equal(eng2.host_state().registers, host.registers)
