@@ -848,7 +848,7 @@ def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
     import subprocess
     import threading
 
-    from streambench_tpu.dimensions.pubsub import PubSubClient
+    from streambench_tpu.dimensions.pubsub import PubSubClient, PubSubServer
     from streambench_tpu.dimensions.store import DurableDimensionStore
     from streambench_tpu.reach.replica import SnapshotShipper
 
@@ -856,7 +856,16 @@ def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
 
     ship_dir = os.path.join(workdir, "ship")
     store = DurableDimensionStore(ship_dir)
-    shipper = SnapshotShipper(store, names, interval_ms=ship_ms)
+    # fleet freshness (ISSUE 15): stamped records + a live writer
+    # origin endpoint so the replicas' clock-offset estimate runs the
+    # real ping path; replicas launch with --fleet and their replies
+    # carry the hop decomposition the artifact summarizes
+    origin_ps = PubSubServer(port=0).start()
+    o_host, o_port = origin_ps.address
+    shipper = SnapshotShipper(store, names, interval_ms=ship_ms,
+                              origin={"addr": f"{o_host}:{o_port}",
+                                      "pid": os.getpid(),
+                                      "role": "writer"})
     eng.attach_shipper(shipper)
 
     ingest_stop = threading.Event()
@@ -899,7 +908,8 @@ def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
                     [sys.executable, "-m",
                      "streambench_tpu.reach.replica",
                      "--ship", ship_dir, "--poll-ms", "150",
-                     "--batch", "64", "--dump-queue-waits"],
+                     "--batch", "64", "--dump-queue-waits",
+                     "--fleet"],
                     env={**os.environ, "JAX_PLATFORMS": "cpu"},
                     cwd=REPO, stdout=subprocess.PIPE,
                     stderr=subprocess.DEVNULL, text=True)
@@ -974,14 +984,39 @@ def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
             assert all("plane_epoch" in d for d in flat)
             stales = [d["staleness_ms"] for d in served]
             assert all(s <= 10_000 for s in stales), max(stales)
+            # fleet freshness (ISSUE 15): every served reply carries
+            # the hop decomposition and the hops sum to its staleness
+            # within per-hop rounding (+-0.25 ms over four hops)
+            for d in served:
+                fr = d["freshness"]
+                hop_sum = sum(fr[f"{h}_ms"] for h in
+                              ("fold_lag", "ship_wait", "tail_lag",
+                               "serve"))
+                assert abs(hop_sum - fr["staleness_ms"]) <= 0.25, fr
+                assert d["staleness_ms"] == fr["staleness_ms"]
             cache_hits = sum(
                 ((s.get("serve") or {}).get("cache") or {}).get(
                     "hits", 0) for s in stats)
             for s in stats:
                 waits = s.get("queue_waits_ns") or []
                 all_waits.extend(waits)
+            # per-hop p99s out of the replicas' exit summaries (worst
+            # replica wins per hop — the fleet's honest tail)
+            fresh_p99: dict = {}
+            for s in stats:
+                hops = (((s.get("serve") or {}).get("freshness") or {})
+                        .get("hops") or {})
+                for hop, summ in hops.items():
+                    p99 = (summ or {}).get("p99")
+                    if isinstance(p99, (int, float)):
+                        fresh_p99[hop] = max(fresh_p99.get(hop, 0.0),
+                                             round(float(p99), 1))
+            clocks = [s.get("clock") for s in stats if s.get("clock")]
             stales_sorted = sorted(stales)
             ladder[f"r{n_rep}"] = {
+                "freshness_p99_ms": fresh_p99,
+                "clock_applied": all(c.get("applied") for c in clocks)
+                if clocks else None,
                 "replicas": n_rep,
                 "sent": n_rep * queries_n,
                 "served": len(served), "shed": len(shed),
@@ -999,6 +1034,7 @@ def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
     finally:
         ingest_stop.set()
         t_ing.join(timeout=60)
+        origin_ps.close()
         store.close()
 
     # off-writer contention: replica queue waits (their processes'
@@ -1017,8 +1053,14 @@ def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
         else 0
     duty = round(busy_ns / span_ns, 4) if span_ns else 0.0
     ingest_evps = int(folded["events"] / max(folded["wall"], 1e-9))
+    # fleet freshness headline: worst per-hop p99 across the ladder
+    fleet_fresh: dict = {}
+    for rung in ladder.values():
+        for hop, p99 in (rung.get("freshness_p99_ms") or {}).items():
+            fleet_fresh[hop] = max(fleet_fresh.get(hop, 0.0), p99)
     out = {
         "phase": phase, "ladder": ladder,
+        "freshness_p99_ms": fleet_fresh,
         "offwriter_contention_ratio": ratio,
         "writer_attached_baseline": 0.61,   # REACH_r02 @ ~30% duty
         "ingest_busy_duty": duty,
@@ -1204,6 +1246,15 @@ def main() -> int:
         doc["reach"]["staleness_ms"] = first.get("staleness_p50_ms")
         doc["reach"]["offwriter_contention_ratio"] = \
             rsc_doc["offwriter_contention_ratio"]
+        # ISSUE 15 fleet freshness regress keys (obs/regress reads
+        # doc.reach.freshness: total + per-hop p99s, all lower=better)
+        fresh = rsc_doc.get("freshness_p99_ms") or {}
+        if fresh:
+            doc["reach"]["freshness"] = {
+                "total_p99_ms": fresh.get("total"),
+                **{f"{hop}_p99_ms": fresh.get(hop)
+                   for hop in ("fold_lag", "ship_wait", "tail_lag",
+                               "serve")}}
     phases = ["small", "storm", "shed", "attribution", "cache_ab"]
     if not args.smoke:
         phases += ["large", "sharded", "replica_scaleout"]

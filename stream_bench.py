@@ -108,6 +108,12 @@ OBS_CAPTURE = os.environ.get("OBS_CAPTURE", "") not in (
 # queue/batch/dispatch/reply segments, keeps a bounded slow-query log,
 # and — with OBS_SPANS=1 — exports the ingest-contention ratio.
 OBS_QUERY = os.environ.get("OBS_QUERY", "") not in ("", "0", "false", "no")
+# Fleet observability (obs/fleet, ISSUE 15): OBS_FLEET=1 stamps shipped
+# reach snapshots with the freshness-ledger wall times + writer origin,
+# role-stamps the metrics journal, and is the flag the CI fleet leg
+# forwards to replicas (--fleet) so replies decompose their age into
+# fold_lag/ship_wait/tail_lag/serve hops.
+OBS_FLEET = os.environ.get("OBS_FLEET", "") not in ("", "0", "false", "no")
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -319,6 +325,7 @@ def op_setup() -> None:
         # window at startup so smoke runs always produce an xprof dir
         "jax.obs.capture.oneshot": OBS_CAPTURE,
         "jax.obs.query": OBS_QUERY,
+        "jax.obs.fleet": OBS_FLEET,
     })
     log(f"wrote {CONF_FILE}")
     try:

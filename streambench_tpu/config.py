@@ -289,6 +289,17 @@ class BenchmarkConfig:
     #   additionally timed to block_until_ready for the pure device
     #   histogram (the worker materializes results synchronously, so
     #   even 1 costs only a split stamp)
+    # --- fleet observability (obs/fleet + obs/clock; ISSUE 15 —
+    # default-off: replica replies stay byte-identical) ---
+    jax_obs_fleet: bool = False            # freshness ledger: shipped
+    #   records carry fold/ship-submit wall stamps + the writer's
+    #   pub/sub origin, writer-attached replies gain the freshness hop
+    #   decomposition, and the metrics journal is role-stamped
+    #   "writer" for the FleetCollector; replicas opt in with --fleet
+    #   (replies then decompose their evidence age into
+    #   fold_lag/ship_wait/tail_lag/serve hops summing to staleness_ms,
+    #   with the writer clock offset estimated over the pub/sub ping
+    #   verb and never applied past the jitter threshold)
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -479,6 +490,7 @@ class BenchmarkConfig:
             jax_reach_ship_interval_ms=max(
                 geti("jax.reach.ship.interval.ms", 1000), 1),
             jax_obs_query=getb("jax.obs.query", False),
+            jax_obs_fleet=getb("jax.obs.fleet", False),
             jax_obs_query_slowlog=max(
                 geti("jax.obs.query.slowlog", 128), 1),
             jax_obs_query_sample=max(geti("jax.obs.query.sample", 1), 1),

@@ -521,8 +521,12 @@ class PubSubServer:
         self._srv.pubsub = self  # type: ignore[attr-defined]
         self._subs: dict[str, set[_Handler]] = {}
         # query verbs (e.g. "reach"): message type -> fn(msg, reply);
-        # the gateway's request/response half next to topic pub/sub
-        self._queries: dict[str, object] = {}
+        # the gateway's request/response half next to topic pub/sub.
+        # "ping" is built in (ISSUE 15): it answers with this server's
+        # wall clock so peers can estimate the cross-process clock
+        # offset (obs/clock.py midpoint method) over the same socket
+        # they query through; register_query may override it.
+        self._queries: dict[str, object] = {"ping": self._handle_ping}
         self._lock = threading.Lock()
         self._started = False
         self._thread = threading.Thread(target=self._srv.serve_forever,
@@ -537,6 +541,16 @@ class PubSubServer:
         self._started = True
         return self
 
+    @staticmethod
+    def _handle_ping(msg: dict, reply) -> None:
+        """Built-in clock-probe verb: one wall-clock read, echoed with
+        the caller's id.  The reply rides the normal data-message shape
+        on the asking connection, so the round trip measures exactly
+        the path a real query's reply takes."""
+        from streambench_tpu.utils.ids import now_ms
+
+        reply({"t": now_ms(), "id": msg.get("id")})
+
     def register_query(self, kind: str, fn) -> None:
         """Register a query verb: messages with ``type == kind`` are
         routed to ``fn(msg, reply)`` instead of the pub/sub arms.
@@ -548,10 +562,10 @@ class PubSubServer:
             self._queries[str(kind)] = fn
 
     def _query_handler(self, kind):
-        if not self._queries:   # fast path: no verbs registered
-            return None
-        with self._lock:
-            return self._queries.get(kind)
+        # lock-free read: dict.get is atomic under the GIL and "ping"
+        # is always registered, so taking the lock here would tax every
+        # pub/sub message for the rare register_query mutation
+        return self._queries.get(kind)
 
     def _subscribe(self, topic: str, h: _Handler) -> None:
         with self._lock:

@@ -75,7 +75,10 @@ class DurableDimensionStore:
     def put_reach_sketches(self, mins: np.ndarray, registers: np.ndarray,
                            campaigns: list[str], epoch: int,
                            update_time_ms: int | None = None,
-                           watermark: int | None = None) -> None:
+                           watermark: int | None = None,
+                           folded_ms: int | None = None,
+                           submit_ms: int | None = None,
+                           origin: dict | None = None) -> None:
         """Materialize the reach sketch planes (reach/; ISSUE 10) as one
         durable log record, so a reopened store can serve audience
         queries without re-folding the journal.  Latest record wins on
@@ -84,7 +87,15 @@ class DurableDimensionStore:
         This record is also the replica shipping format (ISSUE 14): the
         snapshot shipper appends one per cadence tick and read-replica
         processes tail the log for them; ``watermark`` rides along so a
-        replica can report how much event time its planes cover."""
+        replica can report how much event time its planes cover.
+
+        Fleet freshness stamps (ISSUE 15, all optional): ``folded_ms``
+        is the writer wall time of the last fold into these planes,
+        ``submit_ms`` the wall time the ship was submitted (``fm`` /
+        ``sm`` on the wire — the writer-side hop boundaries of the
+        freshness ledger), and ``origin`` names the writer's pub/sub
+        endpoint + pid so replicas can ping it for the clock-offset
+        estimate (obs/clock.py)."""
         stamp = now_ms() if update_time_ms is None else update_time_ms
         mins = np.ascontiguousarray(mins, dtype=np.uint32)
         regs = np.ascontiguousarray(registers, dtype=np.int32)
@@ -95,6 +106,12 @@ class DurableDimensionStore:
                "regs": base64.b64encode(regs.tobytes()).decode()}
         if watermark is not None:
             rec["wm"] = int(watermark)
+        if folded_ms is not None:
+            rec["fm"] = int(folded_ms)
+        if submit_ms is not None:
+            rec["sm"] = int(submit_ms)
+        if origin is not None:
+            rec["origin"] = dict(origin)
         self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._f.flush()
         os.fsync(self._f.fileno())
@@ -113,7 +130,12 @@ class DurableDimensionStore:
         self._reach = {"mins": mins, "registers": regs, "campaigns": c,
                        "epoch": int(rec.get("epoch", 0)),
                        "watermark": int(rec.get("wm", 0)),
-                       "_updated": int(rec.get("t", 0))}
+                       "_updated": int(rec.get("t", 0)),
+                       # fleet freshness stamps + origin (ISSUE 15);
+                       # absent on pre-fleet records
+                       "folded_ms": rec.get("fm"),
+                       "submit_ms": rec.get("sm"),
+                       "origin": rec.get("origin")}
 
     def reach_sketches(self) -> dict | None:
         """Latest materialized reach-sketch record (or None)."""
@@ -164,17 +186,24 @@ class DurableDimensionStore:
                 f.write(json.dumps(rec, separators=(",", ":")) + "\n")
             if self._reach is not None:
                 r = self._reach
-                f.write(json.dumps(
-                    {"kind": "reach_sketch", "t": r["_updated"],
-                     "epoch": r["epoch"], "wm": r.get("watermark", 0),
-                     "c": r["campaigns"],
-                     "k": int(r["mins"].shape[1]),
-                     "r": int(r["registers"].shape[1]),
-                     "mins": base64.b64encode(
-                         r["mins"].tobytes()).decode(),
-                     "regs": base64.b64encode(
-                         r["registers"].tobytes()).decode()},
-                    separators=(",", ":")) + "\n")
+                rec = {"kind": "reach_sketch", "t": r["_updated"],
+                       "epoch": r["epoch"], "wm": r.get("watermark", 0),
+                       "c": r["campaigns"],
+                       "k": int(r["mins"].shape[1]),
+                       "r": int(r["registers"].shape[1]),
+                       "mins": base64.b64encode(
+                           r["mins"].tobytes()).decode(),
+                       "regs": base64.b64encode(
+                           r["registers"].tobytes()).decode()}
+                # freshness stamps survive compaction (a replica
+                # tailing a just-compacted log keeps its hop evidence)
+                if r.get("folded_ms") is not None:
+                    rec["fm"] = int(r["folded_ms"])
+                if r.get("submit_ms") is not None:
+                    rec["sm"] = int(r["submit_ms"])
+                if r.get("origin") is not None:
+                    rec["origin"] = dict(r["origin"])
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
             f.flush()
             os.fsync(f.fileno())
         self._f.close()

@@ -259,7 +259,7 @@ def main(argv: list[str] | None = None) -> int:
             or cfg.jax_obs_occupancy or slo_wanted
             or cfg.jax_obs_xfer or cfg.jax_obs_devmem
             or cfg.jax_obs_shard or cfg.jax_obs_capture
-            or query_obs_wanted):
+            or query_obs_wanted or cfg.jax_obs_fleet):
         from streambench_tpu.obs import (
             CaptureManager,
             DeviceMemoryLedger,
@@ -336,11 +336,15 @@ def main(argv: list[str] | None = None) -> int:
                 # ingest dispatch spans cover only the submit call)
                 occupancy.busy_sink = query_obs.note_ingest_busy
         metrics_path = os.path.join(args.workdir, "metrics.jsonl")
+        # fleet attribution (ISSUE 15): the engine CLI is the fleet's
+        # single writer; role-stamping its journal lets the
+        # FleetCollector merge it with replica journals unambiguously
         sampler = MetricsSampler(
             metrics_path,
             interval_ms=cfg.jax_metrics_interval_ms or 1000,
             registry=registry,
-            max_bytes=cfg.jax_metrics_max_bytes)
+            max_bytes=cfg.jax_metrics_max_bytes,
+            role="writer")
         sampler.add_collector(engine_collector(
             engine, reader=reader, runner=runner, registry=registry))
         if devmem is not None:
@@ -415,10 +419,17 @@ def main(argv: list[str] | None = None) -> int:
             from streambench_tpu.reach.replica import SnapshotShipper
 
             reach_store = DurableDimensionStore(cfg.jax_reach_ship_dir)
+            # origin metadata (ISSUE 15): every shipped record names
+            # this writer's pub/sub endpoint + pid, so fleet-mode
+            # replicas can ping it for the clock-offset estimate and
+            # the merged fleet view can attribute the record
+            s_host, s_port = reach_ps.address
             reach_ship = SnapshotShipper(
                 reach_store, list(engine.encoder.campaigns),
                 interval_ms=cfg.jax_reach_ship_interval_ms,
-                registry=registry)
+                registry=registry,
+                origin={"addr": f"{s_host}:{s_port}",
+                        "pid": os.getpid(), "role": "writer"})
             engine.attach_shipper(reach_ship)
         if sampler is not None:
             # every metrics.jsonl snapshot carries the live serving
@@ -528,7 +539,8 @@ def main(argv: list[str] | None = None) -> int:
                                   engine.state.registers,
                                   engine.reach_epoch,
                                   int(engine.state.watermark),
-                                  force=True)
+                                  force=True,
+                                  folded_ms=engine._fold_wall_ms)
             stats_line["reach"]["ship"] = reach_ship.summary()
             reach_store.close()
     if slo is not None:

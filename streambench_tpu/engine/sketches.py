@@ -290,6 +290,12 @@ class ReachSketchEngine(_SketchEngineBase):
         # post-resume answer is distinguishable from a stale one (the
         # chaos sweep's "never return stale-epoch estimates" check).
         self.reach_epoch = 0
+        # Fleet freshness (ISSUE 15): wall stamp of the last fold
+        # dispatch into the planes — the fold-anchored end of the
+        # freshness ledger.  One now_ms() per dispatch (tens of ns),
+        # stamped unconditionally; it only reaches the wire when
+        # jax.obs.fleet is on.
+        self._fold_wall_ms: int | None = None
 
     def _device_step(self, batch) -> None:
         self.state = minhash.step(
@@ -297,16 +303,19 @@ class ReachSketchEngine(_SketchEngineBase):
             jnp.asarray(batch.ad_idx), jnp.asarray(batch.user_idx),
             jnp.asarray(batch.event_type), jnp.asarray(batch.event_time),
             jnp.asarray(batch.valid))
+        self._fold_wall_ms = now_ms()
 
     def _device_scan(self, ad_idx, user_idx, event_type, event_time,
                      valid) -> None:
         self.state = minhash.scan_steps(
             self.state, self.join_table, ad_idx, user_idx, event_type,
             event_time, valid)
+        self._fold_wall_ms = now_ms()
 
     def _device_scan_packed(self, packed, user_idx, event_time) -> None:
         self.state = minhash.scan_steps_packed(
             self.state, self.join_table, packed, user_idx, event_time)
+        self._fold_wall_ms = now_ms()
 
     def warmup(self) -> None:
         """Base warmup + the close-time estimate program:
@@ -342,20 +351,42 @@ class ReachSketchEngine(_SketchEngineBase):
         """Wire a replica SnapshotShipper: ships from the same
         flush-cadence push path the query server rides (the writer is
         never blocked by readers — a ship is one host gather + one
-        appended log line, and only at the shipping cadence)."""
-        self._reach_shipper = shipper
-        self._reach_push()
+        appended log line, and only at the shipping cadence).
 
-    def _reach_push(self) -> None:
+        The attach itself FORCES a ship: a supervisor-restarted writer
+        re-attaches mid-lineage, and without the forced ship a replica
+        behind the crash would keep serving the pre-crash record until
+        the next cadence tick (the ISSUE 15 restart-path fix — the
+        close-time forced ship's twin)."""
+        self._reach_shipper = shipper
+        self._reach_push(force_ship=True)
+
+    def _reach_push(self, force_ship: bool = False) -> None:
         if self._reach_server is not None:
             self._reach_server.update_state(
-                self.state.mins, self.state.registers, self.reach_epoch)
+                self.state.mins, self.state.registers, self.reach_epoch,
+                freshness=self._fleet_stamps())
         sh = self._reach_shipper
-        if sh is not None and sh.due(self.reach_epoch):
+        if sh is not None and (force_ship or sh.due(self.reach_epoch)):
             # the due() pre-check keeps the watermark pull (a device
             # sync) off the not-yet-due flushes
             sh.note_state(self.state.mins, self.state.registers,
-                          self.reach_epoch, int(self.state.watermark))
+                          self.reach_epoch, int(self.state.watermark),
+                          force=force_ship,
+                          folded_ms=self._fold_wall_ms)
+
+    def _fleet_stamps(self) -> dict | None:
+        """Writer-attached freshness stamps (``jax.obs.fleet``): the
+        server answers against live planes, so submit/ship/load all
+        collapse to the push stamp — only ``fold_lag`` (push minus last
+        fold) and ``serve`` (reply minus push) have width.  None when
+        fleet obs is off, keeping replies byte-identical."""
+        if not getattr(self.cfg, "jax_obs_fleet", False):
+            return None
+        push = now_ms()
+        return {"folded_ms": self._fold_wall_ms or push,
+                "submit_ms": push, "shipped_ms": push,
+                "loaded_ms": push}
 
     # -- harness hooks -------------------------------------------------
     def _drain_device(self) -> None:
@@ -405,7 +436,11 @@ class ReachSketchEngine(_SketchEngineBase):
         # against pre-crash state are then detectable by epoch alone.
         self.reach_epoch = max(self.reach_epoch,
                                int(snap.meta.get("reach_epoch", 0))) + 1
-        self._reach_push()
+        # restart-path forced ship (ISSUE 15): the post-restore planes
+        # must reach the replica log NOW, not at the next cadence tick
+        # — a replica behind a crashed writer otherwise keeps serving
+        # the pre-crash epoch for up to one full shipping interval
+        self._reach_push(force_ship=True)
 
     def close(self) -> None:
         self._reach_push()

@@ -42,6 +42,15 @@ recorder).  Four pieces, all stdlib, all default-off:
   SIGUSR2 / config one-shot, with cooldown + cap;
   ``jax.obs.capture.*``); also owns the one process-global profiler
   start/stop path ``trace.device_trace`` delegates to
+- ``fleet``     — fleet observability (obs layer 6, ``jax.obs.fleet``):
+  metrics federation (every role's ``metrics.jsonl`` merged into one
+  attributed ``fleet.jsonl``; ``python -m streambench_tpu.obs fleet``),
+  cross-process trace stitching (``obs trace --merge``), and the
+  end-to-end reply-freshness ledger
+  (``streambench_fleet_freshness_ms{hop=}``)
+- ``clock``     — cross-process clock-offset estimation (midpoint
+  method over the pub/sub ``ping`` verb, bounded uncertainty, never
+  silently applied past a jitter threshold)
 - ``queryattr`` — per-query latency attribution for the reach serving
   tier (``jax.obs.query``): submit->reply decomposed into
   queue/batch/dispatch/reply segments that sum to it, a bounded
@@ -66,7 +75,16 @@ from streambench_tpu.obs.capture import (  # noqa: F401
     CaptureManager,
     profiler_window,
 )
+from streambench_tpu.obs.clock import (  # noqa: F401
+    offset_from_samples,
+    sync_pubsub,
+)
 from streambench_tpu.obs.devmem import DeviceMemoryLedger  # noqa: F401
+from streambench_tpu.obs.fleet import (  # noqa: F401
+    FleetCollector,
+    merge_traces,
+    summarize_fleet,
+)
 from streambench_tpu.obs.flightrec import FlightRecorder  # noqa: F401
 from streambench_tpu.obs.httpd import MetricsServer  # noqa: F401
 from streambench_tpu.obs.lifecycle import WindowLifecycle  # noqa: F401

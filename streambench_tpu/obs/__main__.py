@@ -4,6 +4,10 @@
     python -m streambench_tpu.obs diff  A/metrics.jsonl B/metrics.jsonl
     python -m streambench_tpu.obs attribution RUN/metrics.jsonl [B/metrics.jsonl]
     python -m streambench_tpu.obs trace RUN/trace_1234.json
+    python -m streambench_tpu.obs trace writer=A/trace_1.json \
+        replica=B/trace_2.json --merge --out merged_trace.json
+    python -m streambench_tpu.obs fleet writer=A/metrics.jsonl \
+        replica=B/metrics.jsonl [--out fleet.jsonl]
     python -m streambench_tpu.obs regress BASELINE.json CANDIDATE.json
 
 ``report`` renders one run's time series as a summary (throughput,
@@ -24,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from streambench_tpu.obs.report import (
@@ -71,9 +76,33 @@ def build_parser() -> argparse.ArgumentParser:
                      help="emit the serving dict(s) instead of text")
     trc = sub.add_parser(
         "trace", help="validate + summarize a Chrome trace-event file "
-                      "(obs.spans trace_<run>.json)")
-    trc.add_argument("path")
+                      "(obs.spans trace_<run>.json); several paths + "
+                      "--merge stitch one perfetto-loadable fleet "
+                      "trace with a process_name lane per file")
+    trc.add_argument("paths", nargs="+", metavar="path",
+                     help="trace file(s); with --merge each may be "
+                          "role=path to name its process lane")
+    trc.add_argument("--merge", action="store_true",
+                     help="stitch all inputs into one trace (clocks "
+                          "aligned on each file's wall0_ms epoch)")
+    trc.add_argument("--out", default=None,
+                     help="where --merge writes the stitched trace "
+                          "(default: merged_trace.json)")
     trc.add_argument("--json", action="store_true",
+                     help="emit the summary dict instead of text")
+    flt = sub.add_parser(
+        "fleet", help="merge every role's metrics.jsonl into one "
+                      "fleet.jsonl and render the per-role table "
+                      "(ingest rate, qps, cache hits, staleness, "
+                      "freshness hops, restarts)")
+    flt.add_argument("paths", nargs="+", metavar="path",
+                     help="role=metrics.jsonl specs, bare journal "
+                          "paths (role inferred), or ONE fleet "
+                          "directory to scan")
+    flt.add_argument("--out", default=None,
+                     help="write the merged attributed journal here "
+                          "(default: no file, table only)")
+    flt.add_argument("--json", action="store_true",
                      help="emit the summary dict instead of text")
     reg = sub.add_parser(
         "regress",
@@ -110,23 +139,84 @@ def main(argv: list[str] | None = None) -> int:
                 validate_chrome_trace,
             )
 
-            with open(args.path, "r", encoding="utf-8") as f:
+            if args.merge or len(args.paths) > 1:
+                from streambench_tpu.obs.fleet import (
+                    merge_traces,
+                    parse_role_spec,
+                    trace_process_names,
+                )
+
+                if not args.merge:
+                    print("error: several trace paths need --merge",
+                          file=sys.stderr)
+                    return 2
+                inputs = [parse_role_spec(p) for p in args.paths]
+                try:
+                    doc = merge_traces(inputs)
+                except (OSError, json.JSONDecodeError) as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 2
+                problems = validate_chrome_trace(doc)
+                if problems:
+                    print("error: merged trace failed validation:",
+                          file=sys.stderr)
+                    for pr in problems:
+                        print(f"  {pr}", file=sys.stderr)
+                    return 2
+                out_path = args.out or "merged_trace.json"
+                with open(out_path, "w", encoding="utf-8") as f:
+                    json.dump(doc, f)
+                s = summarize_trace(doc, path=out_path)
+                s["processes"] = {str(pid): name for pid, name in
+                                  sorted(trace_process_names(doc).items())}
+                print(json.dumps(s) if args.json
+                      else render_trace_summary(s)
+                      + "\n  processes: "
+                      + ", ".join(f"{pid}={name}" for pid, name in
+                                  s["processes"].items()))
+                return 0
+            path = args.paths[0]
+            with open(path, "r", encoding="utf-8") as f:
                 try:
                     doc = json.load(f)
                 except json.JSONDecodeError as e:
-                    print(f"error: {args.path}: not JSON: {e}",
+                    print(f"error: {path}: not JSON: {e}",
                           file=sys.stderr)
                     return 2
             problems = validate_chrome_trace(doc)
             if problems:
-                print(f"error: {args.path}: not a loadable Chrome "
+                print(f"error: {path}: not a loadable Chrome "
                       "trace:", file=sys.stderr)
                 for pr in problems:
                     print(f"  {pr}", file=sys.stderr)
                 return 2
-            s = summarize_trace(doc, path=args.path)
+            s = summarize_trace(doc, path=path)
             print(json.dumps(s) if args.json
                   else render_trace_summary(s))
+            return 0
+        if args.cmd == "fleet":
+            from streambench_tpu.obs.fleet import (
+                FleetCollector,
+                discover_roles,
+                parse_role_spec,
+                render_fleet,
+                summarize_fleet,
+            )
+
+            if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
+                roles = discover_roles(args.paths[0])
+                if not roles:
+                    print(f"error: no metrics.jsonl under "
+                          f"{args.paths[0]}", file=sys.stderr)
+                    return 2
+            else:
+                roles = [parse_role_spec(p) for p in args.paths]
+            coll = FleetCollector(roles, out_path=args.out)
+            records = coll.collect()
+            s = summarize_fleet(records,
+                                path=args.out or args.paths[0])
+            s["sources"] = coll.sources
+            print(json.dumps(s) if args.json else render_fleet(s))
             return 0
         if args.cmd == "serve":
             a = summarize_serve(load_records(args.path),

@@ -1,0 +1,282 @@
+"""Fleet observability: metrics federation + cross-process trace
+stitching (obs layer 6, ISSUE 15).
+
+Since the reach tier scaled out, one "run" is a FLEET — an engine
+writer, a snapshot shipper, N replica processes, pub/sub clients,
+supervisor-restarted children — and each process journals its own
+``metrics.jsonl`` and dumps its own ``trace_<pid>.json``.  Nothing
+spans them.  This module is the spanning instrument:
+
+- :class:`FleetCollector` tails every role's ``metrics.jsonl``
+  (reusing ``load_records``' rotation stitch, so a rotated writer
+  journal is covered end to end) into ONE ``fleet.jsonl`` whose every
+  record carries ``role``/``pid`` attribution, merged in ``ts_ms``
+  order;
+- :func:`summarize_fleet` folds the merged stream into a per-role
+  table — ingest rate, qps, cache hit ratio, staleness, freshness
+  hops, restarts — rendered by ``python -m streambench_tpu.obs
+  fleet``;
+- :func:`merge_traces` folds every role's Chrome trace file into one
+  perfetto-loadable document: per-file clocks are aligned on the
+  recorded ``wall0_ms`` epochs, real pids keep the lanes apart, and
+  ``process_name`` metadata names each lane — writer folds and replica
+  query batches sit on one timeline.
+
+Like every obs layer: read-side only, nothing here runs unless asked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: the merged-journal filename the collector writes
+FLEET_LOG = "fleet.jsonl"
+
+
+def _role_of(path: str, records: list) -> str:
+    """Role attribution for one journal: the records' own ``role``
+    stamp wins (MetricsSampler writes it), else the journal's parent
+    directory name — good enough for ``<fleetdir>/<role>/metrics.jsonl``
+    layouts."""
+    for r in records:
+        role = r.get("role")
+        if isinstance(role, str) and role:
+            return role
+    parent = os.path.basename(os.path.dirname(os.path.abspath(path)))
+    return parent or "unknown"
+
+
+def parse_role_spec(spec: str) -> tuple:
+    """``role=path`` or a bare path -> (role | None, path)."""
+    if "=" in spec and not os.path.exists(spec):
+        role, _, path = spec.partition("=")
+        return role.strip() or None, path.strip()
+    return None, spec
+
+
+class FleetCollector:
+    """Merge every role's ``metrics.jsonl`` into one attributed stream.
+
+    ``roles`` is a list of ``(role_or_None, path)`` pairs; a ``None``
+    role is inferred from the records / directory name.  ``collect()``
+    re-reads every journal (rotation-stitched), attributes, merges by
+    ``ts_ms``, optionally writes ``fleet.jsonl``, and returns the
+    merged record list — cheap enough to run per report; an always-on
+    tailer would be a daemon this repo doesn't need yet.
+    """
+
+    def __init__(self, roles: list, out_path: "str | None" = None):
+        self.roles = [tuple(r) for r in roles]
+        self.out_path = out_path
+        self.sources: list[dict] = []   # per-source read stats
+
+    def collect(self) -> list[dict]:
+        from streambench_tpu.obs.report import load_records
+
+        self.sources = []
+        merged: list[dict] = []
+        for role, path in self.roles:
+            try:
+                records = load_records(path)   # stitches <path>.1 first
+            except OSError as e:
+                self.sources.append({"role": role, "path": path,
+                                     "error": repr(e), "records": 0})
+                continue
+            role = role or _role_of(path, records)
+            for r in records:
+                out = dict(r)
+                out["role"] = role
+                out.setdefault("pid", None)
+                merged.append(out)
+            self.sources.append({"role": role, "path": path,
+                                 "records": len(records)})
+        merged.sort(key=lambda r: (r.get("ts_ms") or 0))
+        if self.out_path:
+            tmp = self.out_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for r in merged:
+                    f.write(json.dumps(r) + "\n")
+            os.replace(tmp, self.out_path)
+        return merged
+
+
+# ----------------------------------------------------------------------
+# per-role summary + rendering (the `obs fleet` table)
+def summarize_fleet(records: list[dict], path: str = "") -> dict:
+    """Fold an attributed record stream into per-(role, pid) rows.
+
+    Columns are the fleet health set the ISSUE names: ingest rate
+    (writer), qps / cache hit ratio / staleness / freshness hop p99s
+    (any serving role), restart count (supervisor annotations), plus
+    the clock-offset evidence when a replica estimated one."""
+    by_role: dict = {}
+    for r in records:
+        role = r.get("role") or "unknown"
+        key = (role, r.get("pid"))
+        agg = by_role.setdefault(key, {
+            "role": role, "pid": r.get("pid"), "snapshots": 0,
+            "restarts": 0, "events": None, "events_per_s_mean": None,
+            "_rates": [],
+        })
+        kind = r.get("kind")
+        if kind == "event":
+            if r.get("event") == "restart":
+                agg["restarts"] += 1
+            continue
+        if kind not in ("snapshot", "final"):
+            continue
+        agg["snapshots"] += 1
+        if isinstance(r.get("events"), (int, float)):
+            agg["events"] = r["events"]
+        eps = r.get("events_per_s")
+        if isinstance(eps, (int, float)) and eps > 0:
+            agg["_rates"].append(eps)
+        rq = r.get("reach_query")
+        if isinstance(rq, dict):
+            agg["qps"] = rq.get("qps")
+            agg["served"] = rq.get("served")
+            agg["shed"] = rq.get("shed")
+            agg["plane_epoch"] = rq.get("plane_epoch")
+            agg["staleness_ms"] = rq.get("staleness_ms")
+            cache = rq.get("cache")
+            if isinstance(cache, dict):
+                agg["cache_hit_ratio"] = cache.get("hit_ratio")
+            fr = rq.get("freshness")
+            if isinstance(fr, dict):
+                agg["freshness_p99_ms"] = {
+                    hop: (s or {}).get("p99")
+                    for hop, s in (fr.get("hops") or {}).items()}
+                agg["freshness_high_water_ms"] = fr.get("high_water_ms")
+        clock = r.get("clock")
+        if isinstance(clock, dict):
+            agg["clock"] = {k: clock.get(k) for k in
+                            ("offset_ms", "uncertainty_ms", "applied")}
+    rows = []
+    for agg in by_role.values():
+        rates = agg.pop("_rates")
+        if rates:
+            agg["events_per_s_mean"] = round(sum(rates) / len(rates), 1)
+        rows.append(agg)
+    rows.sort(key=lambda a: (a["role"], a["pid"] or 0))
+    return {"path": path, "records": len(records),
+            "processes": len(rows), "roles": rows}
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.1f}"
+    if isinstance(v, int):
+        return f"{v:,}"
+    return str(v)
+
+
+def render_fleet(s: dict) -> str:
+    lines = [f"fleet report: {s['path'] or '(records)'}",
+             f"  {s['processes']} process(es), {s['records']} records",
+             f"  {'role':<10} {'pid':>8} {'ev/s':>10} {'qps':>8} "
+             f"{'hit%':>6} {'stale ms':>9} {'epoch':>6} {'restarts':>8}"]
+    for a in s["roles"]:
+        hit = a.get("cache_hit_ratio")
+        lines.append(
+            f"  {a['role']:<10} {_fmt(a.get('pid')):>8} "
+            f"{_fmt(a.get('events_per_s_mean')):>10} "
+            f"{_fmt(a.get('qps')):>8} "
+            f"{(f'{hit * 100:.0f}%' if isinstance(hit, (int, float)) else '-'):>6} "
+            f"{_fmt(a.get('staleness_ms')):>9} "
+            f"{_fmt(a.get('plane_epoch')):>6} "
+            f"{_fmt(a.get('restarts')):>8}")
+        fr = a.get("freshness_p99_ms")
+        if fr:
+            hops = "  ".join(f"{hop} {_fmt(fr.get(hop))}"
+                             for hop in ("fold_lag", "ship_wait",
+                                         "tail_lag", "serve", "total"))
+            lines.append(f"    freshness p99 (ms): {hops}")
+        clock = a.get("clock")
+        if clock:
+            lines.append(
+                f"    clock offset {_fmt(clock.get('offset_ms'))} ms "
+                f"+-{_fmt(clock.get('uncertainty_ms'))} "
+                f"({'applied' if clock.get('applied') else 'NOT applied'})")
+    return "\n".join(lines)
+
+
+def discover_roles(directory: str) -> list:
+    """``(role, path)`` pairs under one fleet directory: a top-level
+    ``metrics.jsonl`` plus every ``<sub>/metrics.jsonl`` one level
+    down (the writer-workdir + per-replica-subdir layout the CI fleet
+    leg uses)."""
+    out = []
+    top = os.path.join(directory, "metrics.jsonl")
+    if os.path.exists(top):
+        out.append((None, top))
+    for name in sorted(os.listdir(directory)):
+        p = os.path.join(directory, name, "metrics.jsonl")
+        if os.path.isdir(os.path.join(directory, name)) \
+                and os.path.exists(p):
+            out.append((None, p))
+    return out
+
+
+# ----------------------------------------------------------------------
+# cross-process trace stitching (`obs trace --merge`)
+def merge_traces(inputs: list, run: str = "fleet") -> dict:
+    """Fold per-process Chrome trace files into one document.
+
+    ``inputs``: ``(role_or_None, path)`` pairs.  Every SpanTracer dump
+    stamps ``otherData.wall0_ms`` — the wall-clock epoch its relative
+    ``ts`` values are measured from — so aligning clocks is exact up to
+    wall-clock skew between the processes: each file's events shift by
+    ``(wall0_ms - min(wall0_ms)) * 1000`` µs.  Events keep their real
+    pids (distinct per process), and one ``process_name`` metadata
+    event per file names the lane, which is exactly what perfetto
+    needs to draw writer folds above replica query batches on one
+    timeline."""
+    events: list[dict] = []
+    meta = []
+    docs = []
+    for role, path in inputs:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        wall0 = (doc.get("otherData") or {}).get("wall0_ms")
+        docs.append((role or os.path.splitext(
+            os.path.basename(path))[0], path, doc,
+            float(wall0) if isinstance(wall0, (int, float)) else None))
+    known = [w for _, _, _, w in docs if w is not None]
+    base = min(known) if known else 0.0
+    for role, path, doc, wall0 in docs:
+        shift_us = ((wall0 - base) * 1000.0) if wall0 is not None else 0.0
+        pids = set()
+        for ev in doc.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            out = dict(ev)
+            if out.get("ph") == "X":
+                out["ts"] = round(float(out.get("ts", 0)) + shift_us, 3)
+            pids.add(out.get("pid"))
+            events.append(out)
+        for pid in sorted(p for p in pids if p is not None):
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": role}})
+        meta.append({"role": role, "path": os.path.basename(path),
+                     "wall0_ms": wall0,
+                     "shift_us": round(shift_us, 3)})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"run": run, "merged": meta,
+                      "wall0_ms": base, "processes": len(docs)},
+    }
+
+
+def trace_process_names(doc: dict) -> dict:
+    """{pid: process_name} out of a merged trace (validation helper)."""
+    out = {}
+    for ev in doc.get("traceEvents", []):
+        if (isinstance(ev, dict) and ev.get("ph") == "M"
+                and ev.get("name") == "process_name"):
+            out[ev.get("pid")] = (ev.get("args") or {}).get("name")
+    return out

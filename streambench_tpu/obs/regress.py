@@ -82,6 +82,15 @@ DEFAULT_TOLERANCES: dict = {
     "reach_cache_hit_ratio": ("higher", 0.1),
     "reach_staleness_ms": ("lower", 1.0),
     "reach_offwriter_contention_ratio": ("lower", 1.0),
+    # fleet freshness ledger (ISSUE 15): the end-to-end age of the
+    # evidence behind replica replies regresses UP, as does each hop's
+    # p99 — generous like every wall-timing row on the 1-core host
+    # (cadence waits dominate and the ship interval is a config knob)
+    "fleet_freshness_ms": ("lower", 1.0),
+    "fleet_fold_lag_p99_ms": ("lower", 1.0),
+    "fleet_ship_wait_p99_ms": ("lower", 1.0),
+    "fleet_tail_lag_p99_ms": ("lower", 1.0),
+    "fleet_serve_p99_ms": ("lower", 1.0),
     # sliding A/B (ISSUE 12): both arms' catchup throughput regresses
     # DOWN; generous like every timing row on the 1-core host
     "sliding_evps": ("higher", 0.5),
@@ -188,6 +197,14 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
         out["reach_staleness_ms"] = _num(reach.get("staleness_ms"))
         out["reach_offwriter_contention_ratio"] = _num(
             reach.get("offwriter_contention_ratio"))
+        # ISSUE 15 fleet freshness keys (bench_reach replica rung with
+        # --fleet replicas: total reply-age p99 + per-hop p99s)
+        fresh = reach.get("freshness")
+        if isinstance(fresh, dict):
+            out["fleet_freshness_ms"] = _num(fresh.get("total_p99_ms"))
+            for hop in ("fold_lag", "ship_wait", "tail_lag", "serve"):
+                out[f"fleet_{hop}_p99_ms"] = _num(
+                    fresh.get(f"{hop}_p99_ms"))
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -208,6 +208,21 @@ def render_report(s: dict) -> str:
             lines.append(f"    merged pairs {_fmt(sm.get('merged_pairs'))}"
                          f"  quads {_fmt(sm.get('merged_quads'))} of "
                          f"{_fmt(sm.get('cells'))} cells")
+    rqf = (s.get("reach_query") or {}).get("freshness")
+    if rqf and rqf.get("hops"):
+        # fleet freshness ledger (ISSUE 15): per-hop p99 of the age of
+        # the evidence behind every served reply
+        lines.append("  reply freshness (age by hop, p99 ms):")
+        lines.append("    " + "  ".join(
+            f"{hop} {_fmt((rqf['hops'].get(hop) or {}).get('p99'))}"
+            for hop in ("fold_lag", "ship_wait", "tail_lag", "serve",
+                        "total")))
+        clock = rqf.get("clock")
+        if clock:
+            lines.append(
+                f"    clock offset {_fmt(clock.get('offset_ms'))} ms "
+                f"+-{_fmt(clock.get('uncertainty_ms'))} "
+                f"({'applied' if clock.get('applied') else 'NOT applied'})")
     rqo = (s.get("reach_query") or {}).get("query_obs")
     if rqo:
         lines.append("  reach query attribution (submit -> reply):")
@@ -303,6 +318,13 @@ def render_serve(s: dict) -> str:
             f"{_fmt(cache.get('entries'))}/{_fmt(cache.get('capacity'))}"
             f" entries, {_fmt(cache.get('evictions'))} evicted, "
             f"{_fmt(cache.get('invalidations'))} epoch invalidations)")
+    fr = rq.get("freshness")
+    if isinstance(fr, dict) and fr.get("hops"):
+        lines.append("  reply freshness p99 (ms): " + "  ".join(
+            f"{hop} {_fmt((fr['hops'].get(hop) or {}).get('p99'))}"
+            for hop in ("fold_lag", "ship_wait", "tail_lag", "serve",
+                        "total"))
+            + f"  (high water {_fmt(fr.get('high_water_ms'))})")
     lines.append(f"  lifecycle records: {_fmt(qobs.get('served_records'))}"
                  f" served + {_fmt(qobs.get('shed_records'))} shed")
     segs = qobs.get("segments") or {}

@@ -56,6 +56,7 @@ from streambench_tpu.ops.windowcount import NEG
 from streambench_tpu.parallel.mesh import CAMPAIGN_AXIS, DATA_AXIS
 from streambench_tpu.parallel.sharded import data_axis_pad, pad_data_cols
 from streambench_tpu.parallel.sketches import _gather_cols, shard_map
+from streambench_tpu.utils.ids import now_ms
 
 
 def pad_campaigns(num_campaigns: int, mesh: Mesh) -> int:
@@ -275,6 +276,7 @@ class ShardedReachEngine(ReachSketchEngine):
                             ad, user, et, tm, va)
         self.state = minhash.ReachState(mins, regs, wm,
                                         self.state.dropped)
+        self._fold_wall_ms = now_ms()
 
     def _device_scan(self, ad_idx, user_idx, event_type, event_time,
                      valid) -> None:
@@ -286,6 +288,7 @@ class ShardedReachEngine(ReachSketchEngine):
                             *cols)
         self.state = minhash.ReachState(mins, regs, wm,
                                         self.state.dropped)
+        self._fold_wall_ms = now_ms()
 
     def _device_scan_packed(self, packed, user_idx, event_time) -> None:
         fn = _build_reach_scan(self.mesh, packed=True)
@@ -296,6 +299,7 @@ class ShardedReachEngine(ReachSketchEngine):
                             *cols)
         self.state = minhash.ReachState(mins, regs, wm,
                                         self.state.dropped)
+        self._fold_wall_ms = now_ms()
 
     # -- queries next to the shards ------------------------------------
     def query_callable(self):

@@ -28,6 +28,16 @@ from collections import OrderedDict
 #: shared instrument name — the serve layer observes hit latencies here
 HIT_LATENCY_HIST = "streambench_reach_cache_hit_ms"
 
+#: The reply keys that are pure functions of (epoch, campaign-set,
+#: kind) and therefore sound to cache.  Everything else is REPLY-TIME
+#: state and must be recomputed on every hit: the per-query ``id``, and
+#: the age evidence — ``staleness_ms`` and the fleet ``freshness`` hop
+#: decomposition (ISSUE 15).  A hit served with the FILL-time freshness
+#: block would claim the answer is as fresh as it was minutes ago; the
+#: serve layer recomputes both against the live plane stamps instead.
+CACHEABLE_KEYS = ("op", "estimate", "union", "jaccard", "bound",
+                  "epoch", "plane_epoch")
+
 
 class ReachQueryCache:
     """Bounded LRU of reach answers, epoch-scoped.

@@ -75,7 +75,12 @@ def decode_ship_record(rec: dict) -> dict | None:
     return {"mins": mins, "registers": regs, "campaigns": c,
             "epoch": int(rec.get("epoch", 0)),
             "watermark": int(rec.get("wm", 0)),
-            "shipped_ms": int(rec.get("t", 0))}
+            "shipped_ms": int(rec.get("t", 0)),
+            # fleet freshness stamps + writer origin (ISSUE 15);
+            # None on pre-fleet records
+            "folded_ms": rec.get("fm"),
+            "submit_ms": rec.get("sm"),
+            "origin": rec.get("origin")}
 
 
 class SnapshotShipper:
@@ -89,13 +94,19 @@ class SnapshotShipper:
     gather + one appended line, and only at the cadence."""
 
     def __init__(self, store, campaigns: list[str],
-                 interval_ms: int = 1000, registry=None):
+                 interval_ms: int = 1000, registry=None,
+                 origin: dict | None = None):
         self.store = store
         self.campaigns = list(campaigns)
         self.interval_ms = max(int(interval_ms), 1)
         self.ships = 0
         self._last_ship = 0.0      # monotonic
         self._last_epoch: int | None = None
+        # fleet origin metadata (ISSUE 15): the writer's pub/sub
+        # endpoint + pid, stamped into every shipped record so a
+        # replica can (a) ping it for the clock-offset estimate and
+        # (b) attribute the record in the merged fleet view
+        self.origin = dict(origin) if origin else None
         self._g_ships = None
         if registry is not None:
             self._g_ships = registry.counter(
@@ -111,18 +122,29 @@ class SnapshotShipper:
                 >= self.interval_ms)
 
     def note_state(self, mins, registers, epoch: int,
-                   watermark: int = 0, force: bool = False) -> bool:
+                   watermark: int = 0, force: bool = False,
+                   folded_ms: int | None = None) -> bool:
         """Maybe ship; returns True when a record was written.
-        ``force`` bypasses the cadence (the writer's close-time ship —
-        replicas must converge on the final planes)."""
+        ``force`` bypasses the cadence — the writer's close-time ship
+        AND the restart-path ship (engine restore / shipper re-attach
+        after a supervised crash): replicas must converge on the live
+        planes immediately, not at the next cadence tick.
+
+        ``folded_ms``: wall stamp of the last fold into these planes
+        (the engine's ``_fold_wall_ms``) — the fold-anchored end of the
+        freshness ledger; the ship-submit stamp is taken here."""
         now = time.monotonic()
         epoch = int(epoch)
         if (not force and self._last_epoch == epoch
                 and (now - self._last_ship) * 1000.0 < self.interval_ms):
             return False
+        submit_ms = now_ms()
         self.store.put_reach_sketches(
             np.asarray(mins), np.asarray(registers), self.campaigns,
-            epoch, watermark=int(watermark))
+            epoch, watermark=int(watermark),
+            folded_ms=(int(folded_ms) if folded_ms is not None
+                       else submit_ms),
+            submit_ms=submit_ms, origin=self.origin)
         self._last_ship = now
         self._last_epoch = epoch
         self.ships += 1
@@ -189,7 +211,8 @@ class ReachReplica:
                  port: int = 0, poll_ms: int = 200,
                  max_staleness_ms: int = DEFAULT_MAX_STALENESS_MS,
                  cache_capacity: int = 4096, depth: int = 512,
-                 batch: int = 64, registry=None, queryattr=None):
+                 batch: int = 64, registry=None, queryattr=None,
+                 fleet: bool = False, spans=None, flightrec=None):
         from streambench_tpu.dimensions.pubsub import PubSubServer
         from streambench_tpu.obs import MetricsRegistry
 
@@ -203,6 +226,15 @@ class ReachReplica:
         self._batch = batch
         self._cache_capacity = int(cache_capacity)
         self._queryattr = queryattr
+        # fleet freshness (ISSUE 15): pass the shipped records' stamp
+        # chain through to the server (replies then carry the hop
+        # decomposition) and estimate the clock offset to the writer's
+        # pub/sub origin so cross-host stamp deltas are honest
+        self.fleet = bool(fleet)
+        self._spans = spans
+        self._flightrec = flightrec
+        self.clock: dict | None = None        # last offset estimate
+        self._clock_origin: str | None = None  # addr it was made against
         self.server = None            # built at first record (campaigns)
         self.cache = None
         self.epoch_loads = 0
@@ -231,6 +263,47 @@ class ReachReplica:
             return
         srv.handle(msg, reply)
 
+    # -- clock-domain correction (fleet mode) --------------------------
+    def _sync_clock(self, origin: dict | None) -> None:
+        """One midpoint-method offset estimate against the writer's
+        pub/sub origin, refreshed when the origin address changes.  A
+        failed sync (writer gone, port closed) records ``applied:
+        False`` — raw stamps are then used as-is, never corrected by a
+        guess."""
+        addr = (origin or {}).get("addr")
+        if not addr or addr == self._clock_origin:
+            return
+        from streambench_tpu.obs import clock as obs_clock
+
+        self._clock_origin = addr
+        try:
+            host, port = addr.rsplit(":", 1)
+            self.clock = obs_clock.sync_pubsub(host, int(port), n=8,
+                                               timeout_s=2.0)
+        except (OSError, ValueError) as e:
+            self.clock = {"offset_ms": 0.0, "applied": False,
+                          "error": repr(e), "endpoint": addr}
+
+    def _freshness(self, rec: dict, loaded_ms: int) -> dict | None:
+        """The stamp dict a fleet-mode state push carries: writer-clock
+        stamps mapped into this replica's clock (when the offset
+        estimate passed the jitter gate) + the local load stamp."""
+        if not self.fleet:
+            return None
+        from streambench_tpu.obs import clock as obs_clock
+
+        def local(stamp):
+            return (None if stamp is None
+                    else obs_clock.to_local_ms(stamp, self.clock))
+
+        out = {"folded_ms": local(rec.get("folded_ms")),
+               "submit_ms": local(rec.get("submit_ms")),
+               "shipped_ms": local(rec.get("shipped_ms")),
+               "loaded_ms": int(loaded_ms)}
+        if self.clock is not None:
+            out["clock"] = dict(self.clock)
+        return out
+
     # -- plane loading -------------------------------------------------
     def _load(self, rec: dict) -> None:
         import jax.numpy as jnp
@@ -238,6 +311,10 @@ class ReachReplica:
         from streambench_tpu.reach.cache import ReachQueryCache
         from streambench_tpu.reach.serve import ReachQueryServer
 
+        if self.fleet:
+            # outside the lock: a slow/failed ping must not stall the
+            # admission path's server lookup
+            self._sync_clock(rec.get("origin"))
         with self._lock:
             if self.server is None:
                 self.cache = (ReachQueryCache(self._cache_capacity,
@@ -248,11 +325,13 @@ class ReachReplica:
                     batch=self._batch, registry=self.registry,
                     cache=self.cache,
                     max_staleness_ms=self.max_staleness_ms,
-                    queryattr=self._queryattr)
+                    queryattr=self._queryattr, spans=self._spans,
+                    flightrec=self._flightrec)
             prev = self.server.epoch
             self.server.update_state(
                 jnp.asarray(rec["mins"]), jnp.asarray(rec["registers"]),
-                rec["epoch"], shipped_ms=rec["shipped_ms"])
+                rec["epoch"], shipped_ms=rec["shipped_ms"],
+                freshness=self._freshness(rec, now_ms()))
             self.plane_loads += 1
             if prev != rec["epoch"]:
                 self.epoch_loads += 1
@@ -287,6 +366,10 @@ class ReachReplica:
             "epoch_loads": self.epoch_loads,
             "shed_before_load": self.shed_before_load,
         }
+        if self.fleet:
+            out["fleet"] = True
+            if self.clock is not None:
+                out["clock"] = dict(self.clock)
         if self.server is not None:
             out["serve"] = self.server.summary()
         return out
@@ -328,6 +411,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="include raw queue-wait intervals in the exit "
                          "stats (the bench's off-writer contention "
                          "measurement reads them)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet observability (ISSUE 15): replies carry "
+                         "the freshness hop decomposition, the clock "
+                         "offset to the writer origin is estimated, and "
+                         "--metrics-dir gets this role's metrics.jsonl/"
+                         "trace/flight files for the FleetCollector")
+    ap.add_argument("--metrics-dir", default=None,
+                    help="workdir for this replica's metrics.jsonl + "
+                         "trace_<pid>.json + flight dumps (fleet mode)")
+    ap.add_argument("--metrics-interval-ms", type=int, default=1000)
     args = ap.parse_args(argv)
 
     ship = args.ship
@@ -336,15 +429,57 @@ def main(argv: list[str] | None = None) -> int:
 
         ship = os.path.join(ship, LOG_NAME)
 
+    sampler = spans = flightrec = None
+    registry = None
+    if args.metrics_dir:
+        from streambench_tpu.obs import (
+            FlightRecorder,
+            MetricsRegistry,
+            MetricsSampler,
+            SpanTracer,
+        )
+
+        os.makedirs(args.metrics_dir, exist_ok=True)
+        registry = MetricsRegistry()
+        sampler = MetricsSampler(
+            os.path.join(args.metrics_dir, "metrics.jsonl"),
+            interval_ms=args.metrics_interval_ms, registry=registry,
+            role="replica")
+        if args.fleet:
+            spans = SpanTracer(registry=registry)
+            flightrec = FlightRecorder(args.metrics_dir)
+            flightrec.span_source = spans.tail
+
     rep = ReachReplica(ship, host=args.host, port=args.port,
                        poll_ms=args.poll_ms,
                        max_staleness_ms=args.max_staleness_ms,
                        cache_capacity=args.cache, depth=args.depth,
-                       batch=args.batch).start()
+                       batch=args.batch, registry=registry,
+                       fleet=args.fleet, spans=spans,
+                       flightrec=flightrec).start()
+    if sampler is not None:
+        # the replica's side of the fleet story: every snapshot carries
+        # the SAME "reach_query" block shape the writer journals, so
+        # the FleetCollector and `obs fleet` render both roles from one
+        # schema; "replica" adds the tailer's own counters
+        def _collect(rec, dt_s):
+            rec["reach_query"] = (rep.server.summary()
+                                  if rep.server is not None else None)
+            rec["replica"] = {
+                "plane_loads": rep.plane_loads,
+                "epoch_loads": rep.epoch_loads,
+                "shed_before_load": rep.shed_before_load,
+            }
+            if rep.clock is not None:
+                rec["clock"] = dict(rep.clock)
+
+        sampler.add_collector(_collect)
+        sampler.start()
     host, port = rep.address
+    fleet_note = " fleet=on" if args.fleet else ""
     print(f"replica: pubsub={host}:{port} ship={ship} "
           f"max_staleness_ms={args.max_staleness_ms} "
-          f"cache={args.cache}", flush=True)
+          f"cache={args.cache}{fleet_note}", flush=True)
 
     done = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: done.set())
@@ -359,6 +494,17 @@ def main(argv: list[str] | None = None) -> int:
     if args.dump_queue_waits and rep.server is not None:
         stats["queue_waits_ns"] = rep.server.wait_intervals()
     rep.close()
+    if spans is not None:
+        spans.dump(os.path.join(args.metrics_dir,
+                                f"trace_{os.getpid()}.json"),
+                   run="reach-replica")
+    if flightrec is not None and len(flightrec):
+        # the replica's black box: staleness high-water / shed trail
+        # (dumped at exit so a storm postmortem has the evidence even
+        # when the process itself ended cleanly)
+        flightrec.dump("replica_exit")
+    if sampler is not None:
+        sampler.close(final=stats)
     print(json.dumps(stats), flush=True)
     return 0
 

@@ -1,0 +1,545 @@
+"""Fleet observability (obs layer 6, ISSUE 15): clock-offset estimator
+units (symmetric exact, asymmetric bounded, jitter refusal), the
+pub/sub ping verb, the freshness-ledger hop partition on a real
+writer->replica run (hops sum to the reply's staleness_ms), cache hits
+carrying the PLANE's reply-time freshness, metrics federation + the
+``obs fleet`` CLI, merged-trace validation with named process lanes,
+and off-flag reply bit-identity."""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from streambench_tpu.config import default_config
+from streambench_tpu.dimensions.store import DurableDimensionStore
+from streambench_tpu.obs import clock as obs_clock
+from streambench_tpu.obs.fleet import (
+    FleetCollector,
+    merge_traces,
+    parse_role_spec,
+    render_fleet,
+    summarize_fleet,
+    trace_process_names,
+)
+from streambench_tpu.obs.spans import validate_chrome_trace
+from streambench_tpu.ops import minhash
+from streambench_tpu.reach.replica import ReachReplica, SnapshotShipper
+from streambench_tpu.reach.serve import (
+    FRESHNESS_HOPS,
+    ReachQueryServer,
+    freshness_hops,
+)
+from streambench_tpu.utils.ids import now_ms
+
+NAMES = ["c0", "c1", "c2"]
+
+
+def fold_state(users, C=3, k=16, R=16):
+    st = minhash.init_state(C, k, R)
+    join = jnp.asarray(np.arange(C, dtype=np.int32))
+    B = len(users)
+    return minhash.step(
+        st, join,
+        jnp.asarray(np.zeros(B, np.int32)),
+        jnp.asarray(np.asarray(users, np.int32)),
+        jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+        jnp.ones(B, bool))
+
+
+def ask(host, port, campaigns, qid, op="union"):
+    from streambench_tpu.dimensions.pubsub import PubSubClient
+
+    c = PubSubClient(host, port, timeout_s=20)
+    c.request({"type": "reach", "campaigns": campaigns, "op": op,
+               "id": qid})
+    out = c.recv()["data"]
+    c.close()
+    return out
+
+
+# ----------------------------------------------------- clock estimator
+def _samples(true_offset, delays):
+    """Synthetic ping samples: (d1, d2) network delays per exchange,
+    server clock ahead of local by ``true_offset`` ms."""
+    out = []
+    t0 = 1_000_000.0
+    for d1, d2 in delays:
+        ts = t0 + d1 + true_offset
+        out.append((t0, ts, t0 + d1 + d2))
+        t0 += 100.0
+    return out
+
+
+def test_clock_symmetric_rtt_exact():
+    # symmetric delay: the midpoint method recovers the offset EXACTLY
+    est = obs_clock.offset_from_samples(
+        _samples(1234.5, [(5, 5), (8, 8), (3, 3)]))
+    assert est["applied"]
+    assert est["offset_ms"] == pytest.approx(1234.5, abs=1e-6)
+    assert est["rtt_min_ms"] == pytest.approx(6.0)
+    # uncertainty = min-rtt/2 + quantization floor
+    assert est["uncertainty_ms"] == pytest.approx(3.5)
+
+
+def test_clock_asymmetric_bounded():
+    # asymmetric delay errs by at most rtt/2, and the reported
+    # uncertainty covers it
+    est = obs_clock.offset_from_samples(_samples(-500.0, [(9, 1)]))
+    assert abs(est["offset_ms"] - (-500.0)) <= est["uncertainty_ms"]
+    assert abs(est["offset_ms"] - (-500.0)) <= 5.0 + 1e-6
+
+
+def test_clock_jitter_threshold_refusal():
+    # offsets spread past the threshold: reported, NEVER applied
+    est = obs_clock.offset_from_samples(
+        _samples(0.0, [(1, 1), (200, 1), (1, 200)]),
+        jitter_threshold_ms=50.0)
+    assert not est["applied"]
+    assert est["jitter_ms"] > 50.0
+    # a huge min-rtt alone also refuses
+    est = obs_clock.offset_from_samples(
+        _samples(0.0, [(120, 120)]), jitter_threshold_ms=50.0)
+    assert not est["applied"]
+    # and applied=False means to_local_ms keeps raw stamps
+    assert obs_clock.to_local_ms(777.0, est) == 777.0
+    applied = obs_clock.offset_from_samples(_samples(100.0, [(2, 2)]))
+    assert obs_clock.to_local_ms(777.0, applied) == pytest.approx(677.0)
+
+
+def test_clock_no_samples():
+    est = obs_clock.offset_from_samples([])
+    assert not est["applied"] and est["samples"] == 0
+
+
+def test_ping_verb_and_live_sync():
+    from streambench_tpu.dimensions.pubsub import PubSubClient, PubSubServer
+
+    ps = PubSubServer(port=0).start()
+    try:
+        host, port = ps.address
+        c = PubSubClient(host, port, timeout_s=10)
+        c.request({"type": "ping", "id": 7})
+        d = c.recv()["data"]
+        c.close()
+        assert d["id"] == 7
+        assert abs(d["t"] - now_ms()) < 5_000
+        # live estimate against the same process: offset ~0.  A very
+        # generous jitter threshold keeps this deterministic on a
+        # loaded 1-core host — the refusal gate has its own unit tests
+        est = obs_clock.sync_pubsub(host, port, n=8,
+                                    jitter_threshold_ms=2_000)
+        assert est["applied"], est
+        assert abs(est["offset_ms"]) <= est["uncertainty_ms"] + 50.0
+    finally:
+        ps.close()
+
+
+# ------------------------------------------------- freshness partition
+def test_freshness_hops_partition_and_clamp():
+    base = float(now_ms())
+    fresh = {"folded_ms": base - 400, "submit_ms": base - 300,
+             "shipped_ms": base - 290, "loaded_ms": base - 50}
+    hops = freshness_hops(fresh, reply_ms=base)
+    assert hops["fold_lag"] == pytest.approx(100.0)
+    assert hops["ship_wait"] == pytest.approx(10.0)
+    assert hops["tail_lag"] == pytest.approx(240.0)
+    assert hops["serve"] == pytest.approx(50.0)
+    assert sum(hops[h] for h in FRESHNESS_HOPS) == pytest.approx(
+        hops["total"])
+    # a backwards stamp (uncorrected skew) clamps monotone: hops stay
+    # >= 0 and the partition contract survives
+    fresh = {"folded_ms": base - 100, "submit_ms": base - 150,
+             "shipped_ms": base - 160, "loaded_ms": base - 10}
+    hops = freshness_hops(fresh, reply_ms=base)
+    assert all(hops[h] >= 0 for h in FRESHNESS_HOPS)
+    assert sum(hops[h] for h in FRESHNESS_HOPS) == pytest.approx(
+        hops["total"])
+
+
+def test_writer_to_replica_freshness_partition(tmp_path):
+    """The acceptance shape, in-process: a writer ships stamped
+    records (origin = a live pub/sub endpoint for the clock ping), a
+    fleet-mode replica loads them, and EVERY served reply — misses and
+    cache hits — carries a freshness decomposition whose hops sum to
+    its staleness_ms within rounding tolerance."""
+    from streambench_tpu.dimensions.pubsub import PubSubServer
+
+    origin_ps = PubSubServer(port=0).start()
+    o_host, o_port = origin_ps.address
+    store = DurableDimensionStore(str(tmp_path))
+    ship = SnapshotShipper(store, NAMES, interval_ms=1,
+                           origin={"addr": f"{o_host}:{o_port}",
+                                   "pid": os.getpid(),
+                                   "role": "writer"})
+    st = fold_state([10, 20, 30])
+    folded_at = now_ms()
+    ship.note_state(st.mins, st.registers, 2, 70_000,
+                    folded_ms=folded_at)
+    rep = ReachReplica(store.path, poll_ms=20_000, fleet=True)
+    rep.pubsub.start()
+    try:
+        assert rep.poll_once()
+        # the clock synced against the live origin (same process, so a
+        # passing estimate reads ~0 offset); on a loaded 1-core host
+        # the jitter gate may legitimately REFUSE — either way the
+        # estimate ran, is recorded, and every reply echoes its verdict
+        assert rep.clock is not None, "clock sync never ran"
+        assert "error" not in rep.clock, rep.clock
+        applied = rep.clock["applied"]
+        if applied:
+            assert abs(rep.clock["offset_ms"]) <= 50.0
+        host, port = rep.address
+        replies = [ask(host, port, ["c0", "c1"], i) for i in range(4)]
+        for i, d in enumerate(replies):
+            assert "estimate" in d, d
+            fr = d["freshness"]
+            hop_sum = sum(fr[f"{h}_ms"] for h in FRESHNESS_HOPS)
+            # per-hop rounding to 0.1 ms: the sum check carries 0.25 ms
+            assert hop_sum == pytest.approx(fr["staleness_ms"],
+                                            abs=0.25), fr
+            assert d["staleness_ms"] == fr["staleness_ms"]
+            assert fr["clock"]["applied"] is applied
+            if i > 0:
+                # repeats hit the (epoch, campaign-set) cache — and
+                # must carry the PLANE's freshness recomputed at reply
+                # time, not the fill-time hops (cache.CACHEABLE_KEYS)
+                assert d.get("cached") is True
+                assert fr["staleness_ms"] >= \
+                    replies[0]["freshness"]["staleness_ms"]
+        # the decomposition is fold-anchored: a reply asked AFTER
+        # t_before carries at least t_before - folded_at of age (the
+        # anchor may shift by the applied clock correction, and hop
+        # rounding trims up to 0.25 ms)
+        t_before = now_ms()
+        d_last = ask(host, port, ["c0", "c2"], "anchor")
+        off = abs(rep.clock["offset_ms"]) if applied else 0.0
+        assert d_last["freshness"]["staleness_ms"] >= \
+            (t_before - folded_at) - off - 1
+        # the summary side: per-hop histograms counted one sample per
+        # served reply, so the p99 table explains exactly these replies
+        served = len(replies) + 1     # + the anchor probe above
+        fr_sum = rep.server.summary()["freshness"]
+        assert fr_sum["hops"]["total"]["count"] == served
+        for hop in FRESHNESS_HOPS:
+            assert fr_sum["hops"][hop]["count"] == served
+    finally:
+        rep.close()
+        store.close()
+        origin_ps.close()
+
+
+def test_off_flag_replies_bit_identical(tmp_path):
+    """Writer stamps ride every shipped record, but a fleet-OFF
+    replica's replies are byte-identical to the PR 14 shape: no
+    freshness block, staleness anchored at the SHIP stamp (not the
+    fold stamp the fleet anchor uses)."""
+    store = DurableDimensionStore(str(tmp_path))
+    ship = SnapshotShipper(store, NAMES, interval_ms=1,
+                           origin={"addr": "127.0.0.1:1", "pid": 1})
+    st = fold_state([1, 2, 3])
+    # a fold stamp 60 s in the past: the fleet anchor would read ~60 s
+    # of staleness; the off-flag ship anchor reads ~0
+    ship.note_state(st.mins, st.registers, 0, 1,
+                    folded_ms=now_ms() - 60_000)
+    rep = ReachReplica(store.path, poll_ms=20_000)   # fleet OFF
+    rep.pubsub.start()
+    try:
+        assert rep.poll_once()
+        d = ask(*rep.address, ["c0"], 1)
+        assert "estimate" in d
+        assert set(d) == {"op", "estimate", "union", "jaccard", "bound",
+                          "epoch", "plane_epoch", "id", "staleness_ms"}
+        assert d["staleness_ms"] < 30_000      # ship-anchored, not fold
+        assert rep.clock is None               # no ping ever attempted
+    finally:
+        rep.close()
+        store.close()
+
+
+def test_freshness_high_water_flightrec():
+    """Satellite: the replica-side flight recorder gets rate-limited
+    fleet_freshness_high_water records (doubling high-water, hop
+    decomposition attached) so a staleness-shed storm's crash dump
+    explains itself."""
+    from streambench_tpu.obs import FlightRecorder, MetricsRegistry
+
+    fr = FlightRecorder(".")
+    reg = MetricsRegistry()
+    srv = ReachQueryServer(NAMES, registry=reg, flightrec=fr,
+                           max_staleness_ms=60_000)
+    st = fold_state([5, 6])
+    base = now_ms()
+    srv.update_state(st.mins, st.registers, 0, shipped_ms=base,
+                     freshness={"folded_ms": base - 20_000,
+                                "submit_ms": base - 19_000,
+                                "shipped_ms": base - 18_000,
+                                "loaded_ms": base - 100})
+    got = []
+    srv.submit(["c0"], "union", lambda d: got.append(d))
+    deadline = time.monotonic() + 10
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    srv.close()
+    assert got and "freshness" in got[0]
+    recs = [r for r in fr.snapshot()
+            if r["kind"] == "fleet_freshness_high_water"]
+    assert recs, fr.snapshot()
+    assert recs[-1]["staleness_ms"] >= 20_000 - 100
+    assert all(f"{h}_ms" in recs[-1] for h in FRESHNESS_HOPS)
+    assert srv.freshness_high_water >= 20_000 - 100
+
+
+def test_writer_attached_fleet_stamps(tmp_path):
+    """jax.obs.fleet on the writer: its attached server's replies gain
+    the degenerate decomposition (live planes: fold_lag + serve only),
+    still summing to the reply's staleness."""
+    from streambench_tpu.engine.sketches import ReachSketchEngine
+
+    mapping = {f"ad{i}": NAMES[i % 3] for i in range(9)}
+    cfg = default_config(jax_num_campaigns=3)
+    eng = ReachSketchEngine(cfg, mapping, campaigns=NAMES, redis=None,
+                            k=16, registers=16)
+    object.__setattr__(cfg, "jax_obs_fleet", True)
+    lines = b"".join(
+        json.dumps({"user_id": f"u{i}", "page_id": "p", "ad_id": "ad0",
+                    "ad_type": "banner", "event_type": "view",
+                    "event_time": str(1_700_000_000_000 + i)}).encode()
+        + b"\n" for i in range(50))
+    eng.process_block(lines)
+    srv = ReachQueryServer(NAMES)
+    eng.attach_reach(srv)
+    got = []
+    srv.submit(["c0"], "union", lambda d: got.append(d))
+    deadline = time.monotonic() + 10
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    srv.close()
+    d = got[0]
+    fr = d["freshness"]
+    assert fr["tail_lag_ms"] == 0.0 and fr["ship_wait_ms"] == 0.0
+    assert sum(fr[f"{h}_ms"] for h in FRESHNESS_HOPS) == pytest.approx(
+        fr["staleness_ms"], abs=0.25)
+
+
+def test_restore_and_reattach_force_ship(tmp_path):
+    """Satellite fix: the restart path ships IMMEDIATELY.  A
+    supervisor-restarted writer re-attaches its shipper (possibly with
+    an unchanged epoch — the crashed-before-first-checkpoint shape)
+    and restores mid-cadence; both paths must put the live planes in
+    the log now, not one cadence tick later."""
+    from streambench_tpu.engine.sketches import ReachSketchEngine
+
+    mapping = {f"ad{i}": NAMES[i % 3] for i in range(9)}
+    cfg = default_config(jax_num_campaigns=3)
+    store = DurableDimensionStore(str(tmp_path))
+    ship = SnapshotShipper(store, NAMES, interval_ms=10**9)
+
+    def make_engine():
+        return ReachSketchEngine(cfg, mapping, campaigns=NAMES,
+                                 redis=None, k=16, registers=16)
+
+    a = make_engine()
+    a.attach_shipper(ship)
+    assert ship.ships == 1              # attach force-ships
+    a.flush()
+    assert ship.ships == 1              # cadence holds mid-lineage
+    snap = a.snapshot(0)
+
+    # restart WITHOUT a checkpoint: same epoch (0), cadence not due —
+    # exactly the shape that used to leave replicas on the pre-crash
+    # record until the next tick
+    b = make_engine()
+    b.attach_shipper(ship)
+    assert ship.ships == 2, "re-attach after restart must force a ship"
+
+    # restart WITH a checkpoint: restore bumps the epoch and must ship
+    # the restored planes immediately, cadence notwithstanding
+    c = make_engine()
+    c.attach_shipper(ship)
+    assert ship.ships == 3
+    c.restore(snap)
+    assert ship.ships == 4, "restore must force a ship"
+    assert store.reach_sketches()["epoch"] == c.reach_epoch
+    store.close()
+
+
+# -------------------------------------------------- metrics federation
+def _write_journal(path, role, pid, records, ts_base=1_000):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for i, rec in enumerate(records):
+            out = {"kind": "snapshot", "seq": i,
+                   "ts_ms": ts_base + i * 100, "uptime_ms": i * 100,
+                   "pid": pid}
+            if role:
+                out["role"] = role
+            out.update(rec)
+            f.write(json.dumps(out) + "\n")
+
+
+def test_fleet_collector_merges_roles(tmp_path):
+    wpath = str(tmp_path / "writer" / "metrics.jsonl")
+    rpath = str(tmp_path / "replica" / "metrics.jsonl")
+    _write_journal(wpath, "writer", 100, [
+        {"events": 1000, "events_per_s": 500.0},
+        {"events": 2000, "events_per_s": 600.0},
+        {"kind": "event", "event": "restart", "restarts": 1},
+    ])
+    _write_journal(rpath, "replica", 200, [
+        {"reach_query": {
+            "served": 40, "shed": 2, "qps": 80.0, "plane_epoch": 3,
+            "staleness_ms": 450.0,
+            "cache": {"hit_ratio": 0.75},
+            "freshness": {"hops": {
+                "fold_lag": {"count": 40, "p99": 120.0},
+                "ship_wait": {"count": 40, "p99": 2.0},
+                "tail_lag": {"count": 40, "p99": 180.0},
+                "serve": {"count": 40, "p99": 300.0},
+                "total": {"count": 40, "p99": 600.0}},
+                "high_water_ms": 650.0}},
+         "clock": {"offset_ms": 1.2, "uncertainty_ms": 3.0,
+                   "applied": True}},
+    ])
+    # rotation stitch: a rotated writer journal half is covered too
+    # (the current file's records continue the rotated half's clock)
+    os.replace(wpath, wpath + ".1")
+    _write_journal(wpath, "writer", 100, [
+        {"events": 3000, "events_per_s": 700.0}], ts_base=2_000)
+
+    out_path = str(tmp_path / "fleet.jsonl")
+    coll = FleetCollector([(None, wpath), (None, rpath)],
+                          out_path=out_path)
+    records = coll.collect()
+    assert os.path.exists(out_path)
+    assert all("role" in r for r in records)
+    roles = {r["role"] for r in records}
+    assert roles == {"writer", "replica"}
+    # rotation stitched: ALL writer snapshots present
+    assert sum(r.get("kind") == "snapshot" and r["role"] == "writer"
+               for r in records) == 3
+    # ts-ordered merge
+    ts = [r["ts_ms"] for r in records]
+    assert ts == sorted(ts)
+
+    s = summarize_fleet(records, path=out_path)
+    assert s["processes"] == 2
+    by_role = {a["role"]: a for a in s["roles"]}
+    w, r = by_role["writer"], by_role["replica"]
+    assert w["events"] == 3000 and w["restarts"] == 1
+    assert w["events_per_s_mean"] == pytest.approx(600.0)
+    assert r["qps"] == 80.0 and r["cache_hit_ratio"] == 0.75
+    assert r["staleness_ms"] == 450.0
+    assert r["freshness_p99_ms"]["total"] == 600.0
+    assert r["clock"]["applied"] is True
+    text = render_fleet(s)
+    assert "writer" in text and "replica" in text
+    assert "fold_lag 120.0" in text and "total 600.0" in text
+
+    # the merged fleet.jsonl round-trips through the same summarizer
+    from streambench_tpu.obs.report import load_records
+
+    again = summarize_fleet(load_records(out_path), path=out_path)
+    assert again["processes"] == 2
+
+
+def test_fleet_cli(tmp_path, capsys):
+    from streambench_tpu.obs.__main__ import main
+
+    wpath = str(tmp_path / "writer" / "metrics.jsonl")
+    rpath = str(tmp_path / "rep" / "metrics.jsonl")
+    _write_journal(wpath, "writer", 1, [{"events": 10,
+                                         "events_per_s": 5.0}])
+    _write_journal(rpath, None, 2, [{"reach_query": {"qps": 9.0,
+                                                     "served": 3}}])
+    out = str(tmp_path / "fleet.jsonl")
+    rc = main(["fleet", f"writer={wpath}", rpath, "--out", out,
+               "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["processes"] == 2
+    # the bare path's role was inferred from its directory name
+    assert {a["role"] for a in s["roles"]} == {"writer", "rep"}
+    assert os.path.exists(out)
+    # directory discovery: one arg, scan <dir>/*/metrics.jsonl
+    rc = main(["fleet", str(tmp_path), "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["processes"] == 2
+
+
+# ----------------------------------------------------- trace stitching
+def _trace_doc(pid, wall0_ms, names):
+    events = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+               "args": {"name": "main"}}]
+    for i, name in enumerate(names):
+        events.append({"name": name, "cat": "stage", "ph": "X",
+                       "ts": 1000.0 * i, "dur": 500.0,
+                       "pid": pid, "tid": 1})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"run": "t", "wall0_ms": wall0_ms,
+                          "spans": len(names), "spans_dropped": 0}}
+
+
+def test_merge_traces_aligns_clocks_and_names_lanes(tmp_path):
+    a = str(tmp_path / "trace_100.json")
+    b = str(tmp_path / "trace_200.json")
+    json.dump(_trace_doc(100, 50_000, ["device_scan", "drain"]),
+              open(a, "w"))
+    json.dump(_trace_doc(200, 50_250, ["query_dispatch"]),
+              open(b, "w"))
+    doc = merge_traces([("writer", a), ("replica", b)])
+    assert validate_chrome_trace(doc) == []
+    lanes = trace_process_names(doc)
+    assert lanes == {100: "writer", 200: "replica"}
+    # the later process's events shifted by the wall-epoch delta so
+    # both sit on one timeline
+    xs = {(e["pid"], e["name"]): e["ts"]
+          for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert xs[(100, "device_scan")] == 0.0
+    assert xs[(200, "query_dispatch")] == pytest.approx(250_000.0)
+
+
+def test_trace_merge_cli(tmp_path, capsys):
+    from streambench_tpu.obs.__main__ import main
+
+    a = str(tmp_path / "trace_1.json")
+    b = str(tmp_path / "trace_2.json")
+    json.dump(_trace_doc(11, 1_000, ["encode"]), open(a, "w"))
+    json.dump(_trace_doc(22, 2_000, ["query_reply"]), open(b, "w"))
+    out = str(tmp_path / "merged.json")
+    rc = main(["trace", f"writer={a}", f"replica={b}", "--merge",
+               "--out", out, "--json"])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["processes"] == {"11": "writer", "22": "replica"}
+    merged = json.load(open(out))
+    assert validate_chrome_trace(merged) == []
+    assert len(trace_process_names(merged)) == 2
+    # multiple paths WITHOUT --merge is a usage error, not a guess
+    assert main(["trace", a, b]) == 2
+
+
+def test_parse_role_spec(tmp_path):
+    p = tmp_path / "x=weird.json"
+    p.write_text("{}")
+    # an existing path containing '=' stays a path
+    assert parse_role_spec(str(p)) == (None, str(p))
+    assert parse_role_spec("writer=/tmp/m.jsonl") == (
+        "writer", "/tmp/m.jsonl")
+
+
+def test_sampler_role_and_pid_stamps(tmp_path):
+    from streambench_tpu.obs import MetricsSampler
+
+    path = str(tmp_path / "metrics.jsonl")
+    s = MetricsSampler(path, interval_ms=10_000, role="replica")
+    s.annotate("restart", restarts=1)
+    s.close(final={"ok": True})
+    recs = [json.loads(line) for line in open(path)]
+    assert all(r["pid"] == os.getpid() for r in recs)
+    assert all(r["role"] == "replica" for r in recs)
+    assert recs[0]["kind"] == "event" and recs[-1]["kind"] == "final"
