@@ -931,9 +931,11 @@ def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
                 # must load a shipped record; shed-only replies mean
                 # not ready — retry a few times, they COUNT as sheds
                 # in the replica's ledger but not in this storm's)
-                for _ in range(100):
+                # fresh id per attempt: the server's request-id dedup
+                # (ISSUE 16) silently drops an id it already answered
+                for wi in range(100):
                     c.request({"type": "reach", "campaigns": [names[0]],
-                               "op": "union", "id": "warm"})
+                               "op": "union", "id": f"warm{wi}"})
                     if "estimate" in c.recv()["data"]:
                         break
                     time.sleep(0.2)
@@ -1089,6 +1091,355 @@ def run_replica_scaleout(eng, names, journal_path: str, workdir: str, *,
     return out
 
 
+def run_fleet_chaos(workdir: str, *, seed: int = 7, replicas_n: int = 2,
+                    epochs_n: int = 14, queries_n: int = 160,
+                    ship_gap_s: float = 0.4, gap_s: float = 0.02,
+                    max_staleness_ms: int = 10_000,
+                    phase: str = "fleet_chaos") -> dict:
+    """The ISSUE 16 chaos rung: a routed replica fleet survives network
+    + ship-log faults + crash-kills with VERIFIED shed-or-answer.
+
+    Two arms off one deterministic plane sequence (seeded numpy, no
+    engine — the invariants are about the serving fleet, not the fold):
+    the CLEAN arm writes the full ship log upfront; the CHAOS arm
+    writes it live at a cadence through the ship-fault hook while two
+    in-process replicas (behind per-replica ``ChaosPubSub`` proxies
+    sharing one injector) serve a router-fronted storm.  Mid-storm each
+    replica is crash-killed once; the :class:`FleetSupervisor` respawns
+    it at the SAME pinned port (the router's replica list stays valid)
+    and the restart hook force-ships the writer's current planes.
+
+    Verified invariants (chaos/verify.py, all hard gates on ``ok``):
+
+    - ``sent == answered + shed`` by exact request id — the router
+      never silently drops a query;
+    - no answered reply served planes staler than the bound relative to
+      what was DURABLE at submit time (driver and ship log share this
+      host's clock);
+    - post-heal the fleet converges on the writer's final epoch and the
+      close-time reach record is bit-identical to the fault-free arm's
+      — chaos may delay convergence, never change what is converged TO.
+
+    Headline regress keys: ``router.failover_p99_ms`` (the cost of a
+    failover episode) and ``router.shed_ratio`` (honesty is visible,
+    not free) — both advisory, lower-is-better.
+    """
+    import socket
+    import threading
+
+    from streambench_tpu.chaos import (ChaosPubSub, FaultInjector,
+                                       FaultPlan, FleetSupervisor,
+                                       check_fleet_accounting,
+                                       check_fleet_convergence,
+                                       check_staleness_bound,
+                                       ship_epoch_timeline)
+    from streambench_tpu.dimensions.pubsub import PubSubClient
+    from streambench_tpu.dimensions.store import (DurableDimensionStore,
+                                                  LOG_NAME)
+    from streambench_tpu.reach.replica import ReachReplica
+    from streambench_tpu.reach.router import ReachRouter
+    from streambench_tpu.utils.ids import now_ms
+
+    camps = [f"fleet-c{i}" for i in range(8)]
+    K, R = 64, 128
+
+    def planes(epoch: int):
+        rng = np.random.default_rng(seed * 1000 + epoch)
+        mins = rng.integers(0, 1 << 32, size=(len(camps), K),
+                            dtype=np.uint32)
+        regs = rng.integers(0, 30, size=(len(camps), R)).astype(np.int32)
+        return mins, regs
+
+    # -- clean arm: the fault-free ship log, written upfront -----------
+    clean_dir = os.path.join(workdir, "fleet_clean")
+    clean_store = DurableDimensionStore(clean_dir)
+    for e in range(1, epochs_n + 1):
+        m, r = planes(e)
+        clean_store.put_reach_sketches(m, r, camps, e, submit_ms=now_ms(),
+                                       folded_ms=now_ms())
+    clean_store.close()
+
+    # -- chaos arm: live writer at a cadence through the fault hook ----
+    # rates sized for the 1-core wall clock: every dropped request or
+    # reply frame costs a full router-handle timeout, so the partition
+    # window + drop rate dominate the rung's runtime, not its queries
+    plan = FaultPlan.generate(
+        seed, net_drop_rate=0.06, net_delay_rate=0.04, net_delay_ms=20,
+        net_dup_rate=0.06, net_torn_rate=0.04, net_msgs=6000,
+        partition_windows=((120, 30),),
+        ship_rate=0.3, ship_ops=epochs_n)
+    injector = FaultInjector(plan)
+    chaos_dir = os.path.join(workdir, "fleet_chaos")
+    chaos_store = DurableDimensionStore(chaos_dir)
+    ship_filter = injector.attach_ship_chaos(chaos_store)
+    chaos_log = os.path.join(chaos_dir, LOG_NAME)
+    ship_lock = threading.Lock()
+    last_epoch = {"e": 0}
+
+    def ship(epoch: int) -> None:
+        m, r = planes(epoch)
+        with ship_lock:
+            chaos_store.put_reach_sketches(
+                m, r, camps, epoch, submit_ms=now_ms(),
+                folded_ms=now_ms())
+            last_epoch["e"] = max(last_epoch["e"], epoch)
+
+    # boot ship OUTSIDE chaos (pre-storm state: the fleet must have
+    # something intact to serve before adversity begins)
+    chaos_store.ship_fault_hook = None
+    ship(1)
+    chaos_store.ship_fault_hook = ship_filter
+
+    writer_stop = threading.Event()
+
+    def writer() -> None:
+        for e in range(2, epochs_n + 1):
+            if writer_stop.is_set():
+                return
+            time.sleep(ship_gap_s)
+            ship(e)
+
+    t_writer = threading.Thread(target=writer, daemon=True)
+
+    # -- the fleet: pinned-port replicas behind chaos proxies ----------
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    rep_ports = [free_port() for _ in range(replicas_n)]
+    reps: dict = {}
+    proxies: list = []
+
+    class _Handle:
+        """In-process stand-in for a replica Popen: poll/kill close the
+        live ReachReplica and sever its proxied connections (the wire
+        view of a process death — ThreadingTCPServer handler threads
+        would otherwise keep answering established sockets)."""
+
+        def __init__(self, idx: int):
+            self.idx = idx
+            self.pid = os.getpid()
+            self._code = None
+
+        def poll(self):
+            return self._code
+
+        def kill(self):
+            if self._code is not None:
+                return
+            rep = reps.pop(self.idx, None)
+            if rep is not None:
+                rep.close()
+            proxies[self.idx].drop_conns()
+            self._code = -9
+
+        terminate = kill
+
+    def spawn(idx: int, attempt: int):
+        rep = ReachReplica(chaos_log, host="127.0.0.1",
+                           port=rep_ports[idx], poll_ms=100,
+                           max_staleness_ms=max_staleness_ms,
+                           depth=256, batch=32).start()
+        reps[idx] = rep
+        return _Handle(idx)
+
+    def on_restart(idx: int, attempt: int) -> None:
+        # PR 15 restart-path forced ship: the respawned replica finds a
+        # RECENT record instead of sitting shed-stale until the cadence
+        if last_epoch["e"]:
+            ship(last_epoch["e"])
+
+    sup = FleetSupervisor(spawn, replicas_n, backoff_base_ms=40.0,
+                          backoff_cap_ms=400.0, max_restarts=5,
+                          healthy_after_s=0.3, seed=seed,
+                          on_restart=on_restart,
+                          counters=injector.counters).start()
+    for idx in range(replicas_n):
+        proxies.append(ChaosPubSub(("127.0.0.1", rep_ports[idx]),
+                                   injector, name=f"-r{idx}").start())
+    watch_stop = threading.Event()
+
+    def watch() -> None:
+        while not watch_stop.is_set():
+            sup.step()
+            time.sleep(0.05)
+
+    t_watch = threading.Thread(target=watch, daemon=True)
+
+    # timeout sized post-warm: the union/overlap kernels are compiled
+    # during the direct warm-up below and the jit cache is process-wide
+    # (respawned replicas reuse it), so a healthy reply is milliseconds
+    # and 1.5 s is pure fault headroom
+    router = ReachRouter([f"{h}:{p}" for h, p in
+                          (pr.address for pr in proxies)],
+                         timeout_s=1.5, retries=1).start()
+    r_host, r_port = router.address
+
+    sent_ids: list = []
+    replies: list = []
+    stamped: list = []      # (submit_ms, reply) for the staleness bound
+    kill_at = {queries_n // 3: 0, (2 * queries_n) // 3: 1}
+    rng = np.random.default_rng(seed)
+    try:
+        # warm DIRECT (off-proxy: no plan indices consumed; JAX compile
+        # for these shapes is shared process-wide by the jit cache)
+        for idx in range(replicas_n):
+            wc = PubSubClient("127.0.0.1", rep_ports[idx], timeout_s=60)
+            for wi in range(200):
+                try:
+                    d = wc.request({"type": "reach",
+                                    "campaigns": [camps[0]],
+                                    "op": "union",
+                                    "id": f"warm{idx}-{wi}"},
+                                   timeout_s=10.0)
+                except (TimeoutError, ConnectionError, OSError):
+                    time.sleep(0.1)
+                    continue
+                if "estimate" in d:
+                    break
+                time.sleep(0.1)
+            wc.close()
+        t_writer.start()
+        t_watch.start()
+        c = PubSubClient(r_host, r_port, timeout_s=120)
+        t0 = time.monotonic()
+        for qi in range(queries_n):
+            idx = kill_at.get(qi)
+            if idx is not None:
+                sup.kill(idx)
+                log(f"fleet chaos: crash-killed replica {idx} at "
+                    f"query {qi}")
+            sel = sorted(camps[j] for j in rng.choice(
+                len(camps), size=int(rng.integers(1, 4)), replace=False))
+            qid = f"fc{qi}"
+            submit_ms = now_ms()
+            # driver->router link is clean TCP: the router ALWAYS
+            # terminates a query (answer, error, or honest shed), so no
+            # driver-side retry — ids stay 1:1 for exact accounting
+            data = c.request({"type": "reach", "campaigns": sel,
+                              "op": "overlap" if qi % 3 == 0 else "union",
+                              "id": qid}, timeout_s=60.0)
+            sent_ids.append(qid)
+            replies.append(data)
+            stamped.append((submit_ms, data))
+            time.sleep(gap_s)
+        storm_s = time.monotonic() - t0
+        c.close()
+        t_writer.join(timeout=60)
+
+        # -- heal: respawns settle, then the forced clean close ship ---
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            sup.step()
+            if all(sup.alive(i) for i in range(replicas_n)):
+                break
+            time.sleep(0.05)
+        # written twice: a trailing torn stub (no newline) would eat
+        # exactly one following append; the plan is exhausted here so
+        # the second copy is always intact
+        ship(epochs_n)
+        ship(epochs_n)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            eps = [getattr(reps.get(i), "server", None) and
+                   reps[i].server.epoch for i in range(replicas_n)]
+            if all(e == epochs_n for e in eps):
+                break
+            time.sleep(0.1)
+        replica_epochs = [
+            (reps[i].server.epoch
+             if i in reps and reps[i].server is not None else None)
+            for i in range(replicas_n)]
+    finally:
+        watch_stop.set()
+        t_watch.join(timeout=10)
+        writer_stop.set()
+        router.close()
+        for rep in list(reps.values()):
+            rep.close()
+        for pr in proxies:
+            pr.close()
+        chaos_store.close()
+
+    # -- the verdict ---------------------------------------------------
+    v = check_fleet_accounting(
+        sent_ids, replies,
+        repro=f"bench_reach.py run_fleet_chaos seed={seed}")
+    check_staleness_bound(stamped, ship_epoch_timeline(chaos_log),
+                          max_staleness_ms, verdict=v, slack_ms=50)
+    check_fleet_convergence(chaos_log, replica_epochs,
+                            clean_ship_path=os.path.join(clean_dir,
+                                                         LOG_NAME),
+                            verdict=v)
+    log(v.summary())
+
+    rt = router.summary()
+    proxy_stats: dict = {}
+    for pr in proxies:
+        for k2, n in pr.stats.items():
+            proxy_stats[k2] = proxy_stats.get(k2, 0) + n
+    sup_sum = sup.summary()
+
+    # per-role journals for `obs fleet` (ISSUE 16): the router row
+    # (routed/failovers/shed_ratio sub-line) and the supervisor row
+    # (restart events + net-fault counters) render from these exactly
+    # like any live sampler journal; CI asserts on the table and ships
+    # them as failure artifacts
+    fleet_dir = os.path.join(workdir, "fleet_chaos")
+    os.makedirs(fleet_dir, exist_ok=True)
+    stamp = now_ms()
+    with open(os.path.join(fleet_dir, "router_metrics.jsonl"), "w",
+              encoding="utf-8") as f:
+        f.write(json.dumps({"kind": "final", "role": "router",
+                            "pid": os.getpid(), "ts_ms": stamp,
+                            "router": rt}) + "\n")
+    with open(os.path.join(fleet_dir, "supervisor_metrics.jsonl"), "w",
+              encoding="utf-8") as f:
+        for slot in sup_sum["replicas"]:
+            for _ in range(slot["restarts"]):
+                f.write(json.dumps(
+                    {"kind": "event", "event": "replica_restart",
+                     "role": "supervisor", "pid": os.getpid(),
+                     "ts_ms": stamp, "idx": slot["idx"]}) + "\n")
+        f.write(json.dumps({"kind": "final", "role": "supervisor",
+                            "pid": os.getpid(), "ts_ms": stamp,
+                            "faults": injector.counters.snapshot()})
+                + "\n")
+
+    out = {
+        "phase": phase, "seed": seed, "replicas": replicas_n,
+        "epochs": epochs_n,
+        "sent": v.sent, "answered": v.answered, "shed": v.shed,
+        "accounting_exact": not (v.duplicate_ids or v.missing_ids
+                                 or v.unexpected_ids),
+        "stale_violations": len(v.stale_violations),
+        "max_staleness_ms": max_staleness_ms,
+        "lagging_replicas": v.lagging_replicas,
+        "bit_identical_final": not v.divergent,
+        "writer_epoch": v.writer_epoch,
+        "storm_s": round(storm_s, 2),
+        "router": {k2: rt.get(k2) for k2 in
+                   ("routed", "answered", "shed", "failovers",
+                    "shed_ratio", "failover_p50_ms", "failover_p99_ms",
+                    "qps")},
+        "proxy": proxy_stats,
+        "supervisor": {"restarts": sup_sum["restarts"],
+                       "kills": sup_sum["kills"],
+                       "gave_up": sup_sum["gave_up"]},
+        "faults": injector.counters.snapshot(),
+    }
+    assert out["accounting_exact"], v.summary()
+    assert out["stale_violations"] == 0, v.stale_violations[:5]
+    assert not v.lagging_replicas and not v.divergent, v.summary()
+    assert sup_sum["restarts"] >= 2, sup_sum
+    assert rt.get("failovers", 0) >= 1 and "failover_p99_ms" in rt, rt
+    out["ok"] = v.ok
+    return out
+
+
 # ----------------------------------------------------------------------
 
 def main() -> int:
@@ -1153,6 +1504,14 @@ def main() -> int:
         doc["cache_ab"] = cab
         print(compact_line(cab), flush=True)
         log(f"cache A/B ok: miss/hit p99 {cab['miss_over_hit_p99']}x")
+        fc = run_fleet_chaos(workdir, queries_n=60, epochs_n=10,
+                             ship_gap_s=0.3)
+        doc["fleet_chaos"] = fc
+        print(compact_line(fc), flush=True)
+        log(f"fleet chaos ok: {fc['answered']} answered + {fc['shed']} "
+            f"shed == {fc['sent']} sent, "
+            f"{fc['supervisor']['restarts']} restarts, failover p99 "
+            f"{fc['router'].get('failover_p99_ms')} ms")
     elif time.monotonic() > deadline - 120:
         doc["large"] = {"skipped": "budget"}
         doc["storm"] = {"skipped": "budget"}
@@ -1222,6 +1581,20 @@ def main() -> int:
             log(f"replica rung ok: off-writer contention "
                 f"{rsc['offwriter_contention_ratio']} "
                 f"(writer-attached baseline 0.61)")
+        # ---- ISSUE 16 fleet chaos rung -------------------------------
+        if time.monotonic() > deadline - 60:
+            doc["fleet_chaos"] = {"skipped": "budget"}
+            ok = False
+            log("budget exhausted before the fleet chaos rung — recorded")
+        else:
+            fc = run_fleet_chaos(workdir)
+            doc["fleet_chaos"] = fc
+            print(compact_line(fc), flush=True)
+            log(f"fleet chaos ok: {fc['answered']} answered + "
+                f"{fc['shed']} shed == {fc['sent']} sent, "
+                f"{fc['supervisor']['restarts']} restarts, failover "
+                f"p99 {fc['router'].get('failover_p99_ms')} ms, final "
+                f"record bit-identical to the fault-free arm")
 
     # regress-gate keys (obs/regress.py normalize_bench reads doc.reach)
     storm_doc = doc.get("storm") or {}
@@ -1255,7 +1628,16 @@ def main() -> int:
                 **{f"{hop}_p99_ms": fresh.get(hop)
                    for hop in ("fold_lag", "ship_wait", "tail_lag",
                                "serve")}}
-    phases = ["small", "storm", "shed", "attribution", "cache_ab"]
+    # ISSUE 16 regress keys: router failover cost + shed honesty (both
+    # advisory, lower=better — obs/regress reads doc.reach.router)
+    fc_doc = doc.get("fleet_chaos") or {}
+    if fc_doc.get("ok") and "reach" in doc:
+        frt = fc_doc.get("router") or {}
+        doc["reach"]["router"] = {
+            "failover_p99_ms": frt.get("failover_p99_ms"),
+            "shed_ratio": frt.get("shed_ratio")}
+    phases = ["small", "storm", "shed", "attribution", "cache_ab",
+              "fleet_chaos"]
     if not args.smoke:
         phases += ["large", "sharded", "replica_scaleout"]
     doc["ok"] = ok and all(

@@ -5,19 +5,33 @@ See ROBUSTNESS.md for the failure model.  The pieces:
 - ``plan``       — seeded :class:`FaultPlan`, :class:`CrashScheduler`,
   the simulated :class:`EngineCrash`
 - ``inject``     — :class:`FaultInjector` and its surface wrappers
-  (:class:`ChaosRedis`, :class:`ChaosJournalReader`)
+  (:class:`ChaosRedis`, :class:`ChaosJournalReader`,
+  :class:`ShipChaosFilter`)
+- ``netchaos``   — :class:`ChaosPubSub`, the fault-injecting TCP proxy
+  over the pub/sub query plane (drops, delays, dups, torn frames,
+  partitions)
 - ``supervisor`` — :class:`Supervisor` restart loop with capped
   exponential backoff and no-progress give-up
+- ``fleet_supervisor`` — :class:`FleetSupervisor`, the same semantics
+  at the replica-process level (crash-kill, backoff restart, give-up)
 - ``verify``     — the executable at-least-once bound
-  (:func:`check_at_least_once`) and the strict exactly-once check
-  (:func:`check_exactly_once`, ``jax.sink.exactly_once`` runs)
+  (:func:`check_at_least_once`), the strict exactly-once check
+  (:func:`check_exactly_once`, ``jax.sink.exactly_once`` runs), and
+  the fleet invariants (:func:`check_fleet_accounting`,
+  :func:`check_staleness_bound`, :func:`check_fleet_convergence`)
 """
 
+from streambench_tpu.chaos.fleet_supervisor import (  # noqa: F401
+    FleetSupervisor,
+    ReplicaSlot,
+)
 from streambench_tpu.chaos.inject import (  # noqa: F401
     ChaosJournalReader,
     ChaosRedis,
     FaultInjector,
+    ShipChaosFilter,
 )
+from streambench_tpu.chaos.netchaos import ChaosPubSub  # noqa: F401
 from streambench_tpu.chaos.plan import (  # noqa: F401
     CrashScheduler,
     EngineCrash,
@@ -29,8 +43,15 @@ from streambench_tpu.chaos.supervisor import (  # noqa: F401
 )
 from streambench_tpu.chaos.verify import (  # noqa: F401
     ChaosVerdict,
+    FleetVerdict,
     check_at_least_once,
     check_exactly_once,
+    check_fleet_accounting,
+    check_fleet_convergence,
+    check_staleness_bound,
+    durable_epoch_at,
+    final_reach_record,
     replay_note,
     segment_view_counts,
+    ship_epoch_timeline,
 )

@@ -1,0 +1,309 @@
+"""Fleet supervisor: crash-fault, restart, and give up on replica
+processes (ISSUE 16 tentpole (c)).
+
+PR 1's :class:`~streambench_tpu.chaos.supervisor.Supervisor` proved the
+recovery semantics for the single writer: fresh attempt per crash,
+capped exponential backoff with seeded jitter, give-up when restarts
+stop making progress.  The :class:`FleetSupervisor` lifts the same
+semantics to the PROCESS level for reach read replicas:
+
+- it spawns N replica slots through an injectable ``spawn(idx, attempt)
+  -> handle`` (a subprocess.Popen, or an in-process stand-in in tests —
+  anything with ``pid`` / ``poll()`` / ``terminate()`` / ``kill()``);
+- :meth:`kill` is the chaos driver's crash fault — SIGKILL by default,
+  so the replica gets no chance to shed gracefully or release its
+  pidfile (the pidfile's recycled-pid check is what makes that safe);
+- :meth:`step` notices deaths, schedules a respawn after the SAME
+  capped-backoff-with-jitter formula as PR 1 (seeded: a sweep replays
+  bit-identically), and respawns when the backoff elapses;
+- a slot whose process keeps dying *young* — uptime under
+  ``healthy_after_s`` on ``max_restarts`` consecutive deaths — is given
+  up on, exactly PR 1's no-progress rule with uptime as the progress
+  proxy (a replica that served for a while and then was crash-faulted
+  earns its restart counter back);
+- ``on_restart(idx, attempt)`` is the PR 15 restart-path hook: the
+  bench wires it to the writer shipper's forced ship
+  (``note_state(..., force=True)``), so a freshly restarted replica
+  finds a RECENT record to load instead of sitting shed-stale until the
+  next cadence tick.
+
+Crash/restart/give-up events are annotated onto the shared telemetry
+stream and flight recorder under the ``fleet_supervisor`` key, and the
+``restarts`` counter feeds the ``obs fleet`` table.
+"""
+
+from __future__ import annotations
+
+import random
+import signal as _signal
+import time
+
+from streambench_tpu.metrics import FaultCounters
+
+
+class ReplicaSlot:
+    """One supervised replica seat: the live handle plus its ledger."""
+
+    __slots__ = ("idx", "handle", "attempt", "restarts",
+                 "consecutive_young_deaths", "gave_up", "spawned_at",
+                 "restart_at", "exit_codes", "kills")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.handle = None
+        self.attempt = 0
+        self.restarts = 0
+        self.consecutive_young_deaths = 0
+        self.gave_up = False
+        self.spawned_at = 0.0          # monotonic
+        self.restart_at: float | None = None  # backoff deadline
+        self.exit_codes: list = []
+        self.kills = 0
+
+    def summary(self) -> dict:
+        return {"idx": self.idx,
+                "pid": getattr(self.handle, "pid", None),
+                "attempt": self.attempt, "restarts": self.restarts,
+                "kills": self.kills, "gave_up": self.gave_up,
+                "exit_codes": list(self.exit_codes)}
+
+
+class FleetSupervisor:
+    """Spawn/kill/restart N replica slots under capped backoff.
+
+    ``spawn(idx, attempt)`` must return a fresh process handle each
+    call; the supervisor never reuses a dead handle (a crashed replica
+    is abandoned exactly as PR 1 abandons a crashed engine).  Drive it
+    with :meth:`watch` (poll loop) or :meth:`step` directly from a
+    test's own clock.
+    """
+
+    def __init__(self, spawn, n: int, *,
+                 backoff_base_ms: float = 50.0,
+                 backoff_cap_ms: float = 2000.0,
+                 max_restarts: int = 5,
+                 healthy_after_s: float = 5.0,
+                 seed: int = 0,
+                 on_restart=None,
+                 counters: FaultCounters | None = None,
+                 sampler=None, flightrec=None,
+                 sleep=time.sleep, clock=time.monotonic):
+        self.spawn = spawn
+        self.slots = [ReplicaSlot(i) for i in range(int(n))]
+        self.backoff_base_ms = max(float(backoff_base_ms), 0.0)
+        self.backoff_cap_ms = max(float(backoff_cap_ms),
+                                  self.backoff_base_ms)
+        self.max_restarts = max(int(max_restarts), 1)
+        self.healthy_after_s = float(healthy_after_s)
+        self.on_restart = on_restart
+        self.counters = counters if counters is not None else FaultCounters()
+        self.sampler = sampler
+        self.flightrec = flightrec
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._clock = clock
+        self._stopping = False
+
+    # -- PR 1's backoff, verbatim semantics ----------------------------
+    def _backoff(self, consecutive: int) -> float:
+        """Capped exponential backoff with jitter (ms)."""
+        n = min(consecutive, 16)
+        base = min(self.backoff_base_ms * (1 << max(n - 1, 0)),
+                   self.backoff_cap_ms)
+        return base * (0.5 + 0.5 * self._rng.random())
+
+    def _annotate(self, event: str, **fields) -> None:
+        if self.sampler is not None:
+            self.sampler.annotate(event, **fields)
+        if self.flightrec is not None:
+            self.flightrec.record("fleet_supervisor", event=event,
+                                  **fields)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "FleetSupervisor":
+        for slot in self.slots:
+            self._spawn(slot)
+        return self
+
+    def _spawn(self, slot: ReplicaSlot) -> None:
+        slot.attempt += 1
+        slot.handle = self.spawn(slot.idx, slot.attempt)
+        slot.spawned_at = self._clock()
+        slot.restart_at = None
+
+    def alive(self, idx: int) -> bool:
+        h = self.slots[idx].handle
+        return h is not None and h.poll() is None
+
+    def kill(self, idx: int, *, hard: bool = True) -> bool:
+        """Crash-fault one replica.  ``hard`` (default) is SIGKILL —
+        no graceful shed, no pidfile release; False is SIGTERM.
+        Returns False when the slot has no live process to kill."""
+        slot = self.slots[idx]
+        h = slot.handle
+        if h is None or h.poll() is not None:
+            return False
+        (h.kill if hard else h.terminate)()
+        slot.kills += 1
+        self.counters.inc("crash_kills")
+        self._annotate("replica_kill", idx=idx,
+                       pid=getattr(h, "pid", None), hard=hard)
+        return True
+
+    def step(self, now: float | None = None) -> int:
+        """One supervision pass: notice deaths, schedule backoffs,
+        respawn slots whose backoff elapsed.  Returns restarts
+        performed this pass."""
+        if self._stopping:
+            return 0
+        now = self._clock() if now is None else now
+        restarted = 0
+        for slot in self.slots:
+            if slot.gave_up:
+                continue
+            if slot.restart_at is None:
+                h = slot.handle
+                code = h.poll() if h is not None else 0
+                if code is None:
+                    continue
+                # death observed: ledger it, decide give-up vs backoff
+                slot.exit_codes.append(code)
+                uptime = now - slot.spawned_at
+                if uptime >= self.healthy_after_s:
+                    slot.consecutive_young_deaths = 0
+                else:
+                    slot.consecutive_young_deaths += 1
+                self._annotate("replica_crash", idx=slot.idx,
+                               exit_code=code,
+                               uptime_s=round(uptime, 3))
+                if slot.consecutive_young_deaths >= self.max_restarts:
+                    slot.gave_up = True
+                    self.counters.inc("give_ups")
+                    self._annotate("replica_give_up", idx=slot.idx,
+                                   attempts=slot.attempt,
+                                   young_deaths=
+                                   slot.consecutive_young_deaths)
+                    continue
+                back_ms = self._backoff(
+                    max(slot.consecutive_young_deaths, 1))
+                slot.restart_at = now + back_ms / 1000.0
+            if slot.restart_at is not None and now >= slot.restart_at:
+                self._spawn(slot)
+                slot.restarts += 1
+                restarted += 1
+                self.counters.inc("restarts")
+                self._annotate("replica_restart", idx=slot.idx,
+                               attempt=slot.attempt,
+                               pid=getattr(slot.handle, "pid", None))
+                if self.on_restart is not None:
+                    self.on_restart(slot.idx, slot.attempt)
+        return restarted
+
+    def watch(self, duration_s: float, poll_s: float = 0.05) -> int:
+        """Poll loop for ``duration_s``; returns total restarts."""
+        deadline = self._clock() + float(duration_s)
+        total = 0
+        while self._clock() < deadline and not self._stopping:
+            total += self.step()
+            self._sleep(poll_s)
+        return total
+
+    def stop(self, *, grace_s: float = 5.0) -> None:
+        """Terminate every live replica (SIGTERM, escalate to SIGKILL
+        after ``grace_s``) and stop restarting."""
+        self._stopping = True
+        live = [s for s in self.slots
+                if s.handle is not None and s.handle.poll() is None]
+        for slot in live:
+            try:
+                slot.handle.terminate()
+            except OSError:
+                pass
+        deadline = self._clock() + float(grace_s)
+        for slot in live:
+            while (slot.handle.poll() is None
+                   and self._clock() < deadline):
+                self._sleep(0.02)
+            if slot.handle.poll() is None:
+                try:
+                    slot.handle.kill()
+                except OSError:
+                    pass
+                slot.handle.poll()
+
+    def summary(self) -> dict:
+        return {"replicas": [s.summary() for s in self.slots],
+                "restarts": sum(s.restarts for s in self.slots),
+                "kills": sum(s.kills for s in self.slots),
+                "gave_up": sum(1 for s in self.slots if s.gave_up)}
+
+
+def cli_spawn(ship_path: str, workdir: str, *,
+              host: str = "127.0.0.1", ports=None,
+              max_staleness_ms: int | None = None,
+              poll_ms: int | None = None, fleet: bool = False,
+              metrics: bool = False, extra_args=()):
+    """A ``spawn`` callable running the real replica CLI per slot:
+    ``python -m streambench_tpu.reach.replica --ship ... --pid-file
+    pids/replica_<idx>`` with stdout teed to
+    ``<workdir>/replica_<idx>.out`` (the harness parses the ready
+    line from it).  ``ports[idx]`` pins each slot's pub/sub port so a
+    restarted replica comes back at the SAME address — the router's
+    replica list stays valid across restarts."""
+    import os
+    import subprocess
+    import sys
+
+    os.makedirs(workdir, exist_ok=True)
+
+    def spawn(idx: int, attempt: int):
+        cmd = [sys.executable, "-m", "streambench_tpu.reach.replica",
+               "--ship", ship_path, "--host", host,
+               "--port", str(ports[idx] if ports else 0),
+               "--pid-file",
+               os.path.join(workdir, "pids", f"replica_{idx}")]
+        if max_staleness_ms is not None:
+            cmd += ["--max-staleness-ms", str(max_staleness_ms)]
+        if poll_ms is not None:
+            cmd += ["--poll-ms", str(poll_ms)]
+        if fleet:
+            cmd.append("--fleet")
+        if metrics:
+            d = os.path.join(workdir, f"replica_{idx}")
+            os.makedirs(d, exist_ok=True)
+            cmd += ["--metrics-dir", d]
+        cmd += list(extra_args)
+        out = open(os.path.join(workdir, f"replica_{idx}.out"), "ab")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(cmd, stdout=out, stderr=out, env=env)
+        out.close()
+        return proc
+
+    return spawn
+
+
+def wait_ready(out_path: str, *, timeout_s: float = 30.0,
+               marker: str = "replica: pubsub=") -> tuple[str, int]:
+    """Parse a spawned replica's ready line from its teed stdout;
+    returns (host, port).  Raises TimeoutError when the line never
+    lands (the spawn died before serving)."""
+    import os
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(out_path):
+            with open(out_path, encoding="utf-8",
+                      errors="replace") as f:
+                for line in f:
+                    if marker in line:
+                        addr = line.split(marker, 1)[1].split()[0]
+                        host, port = addr.rsplit(":", 1)
+                        return host, int(port)
+        time.sleep(0.05)
+    raise TimeoutError(f"no ready line in {out_path}")
+
+
+# re-exported so chaos drivers need one import for the kill signal set
+SIGKILL = _signal.SIGKILL
+SIGTERM = _signal.SIGTERM

@@ -26,6 +26,27 @@ Fault surfaces (see ``chaos.inject`` for the wrappers):
 - **crash** — ordered ``(boundary, count)`` points consumed one at a
   time by the :class:`CrashScheduler`; boundary kinds are ``batch``,
   ``flush``, ``checkpoint`` (the hooks in ``StreamRunner``).
+
+Fleet surfaces (ISSUE 16; see ``chaos.netchaos`` for the proxy and
+``chaos.inject`` for the ship-log filter):
+
+- **net** — per pub/sub-message index through a :class:`ChaosPubSub`
+  proxy: ``drop`` (the message vanishes), ``delay`` (held
+  ``net_delay_ms`` before forwarding), ``dup`` (forwarded twice —
+  the duplicated-reply/retried-request case the request-id dedup must
+  absorb), ``torn`` (the frame is damaged in flight: the line's tail
+  is NUL-smashed, so the peer sees one undecodable line and the
+  message is lost WITHOUT desyncing the stream).
+  ``partition_windows`` additionally drops EVERY message whose global
+  index falls in a ``(start, length)`` window — a full partition, the
+  index-based peer of ``sink_outage``.
+- **ship** — per ``put_reach_sketches`` append index: ``torn`` (a
+  prefix with no newline; the next append concatenates into one
+  garbage line the tailer must skip), ``corrupt`` (NUL-damaged tail,
+  newline intact), ``delayed`` (the record is held and appended in
+  front of the NEXT ship — late, out of order).  Beyond ``ship_ops``
+  the surface runs clean, so the writer's close-time forced ship is
+  always delivered intact and post-heal convergence is provable.
 """
 
 from __future__ import annotations
@@ -45,6 +66,9 @@ SINK_KINDS = ("refused", "timeout", "resp")
 SINK_PARTIAL = "partial"
 JOURNAL_KINDS = ("truncated", "torn", "corrupt")
 CRASH_KINDS = ("batch", "flush", "checkpoint")
+# Fleet surfaces (ISSUE 16): pub/sub transport + ship-log append.
+NET_KINDS = ("drop", "delay", "dup", "torn")
+SHIP_FAULT_KINDS = ("torn", "corrupt", "delayed")
 
 
 class EngineCrash(RuntimeError):
@@ -71,6 +95,12 @@ class FaultPlan:
     sink_faults: dict = field(default_factory=dict)      # op idx -> kind
     journal_faults: dict = field(default_factory=dict)   # poll idx -> kind
     crashes: tuple = ()                                  # ((kind, n), ...)
+    # fleet surfaces (ISSUE 16); empty on every pre-fleet plan, so
+    # old plans stay bit-identical under the same seed
+    net_faults: dict = field(default_factory=dict)       # msg idx -> kind
+    net_delay_ms: int = 0                                # "delay" hold time
+    partition_windows: tuple = ()                        # ((start, len), ...)
+    ship_faults: dict = field(default_factory=dict)      # ship idx -> kind
 
     @classmethod
     def zeros(cls) -> "FaultPlan":
@@ -86,7 +116,16 @@ class FaultPlan:
                  journal_rate: float = 0.0,
                  journal_polls: int = 0,
                  crashes: int = 0,
-                 crash_span: int = 8) -> "FaultPlan":
+                 crash_span: int = 8,
+                 net_drop_rate: float = 0.0,
+                 net_delay_rate: float = 0.0,
+                 net_delay_ms: int = 25,
+                 net_dup_rate: float = 0.0,
+                 net_torn_rate: float = 0.0,
+                 net_msgs: int = 0,
+                 partition_windows: tuple = (),
+                 ship_rate: float = 0.0,
+                 ship_ops: int = 0) -> "FaultPlan":
         """Roll a deterministic plan from ``seed``.
 
         ``sink_rate``/``journal_rate`` are per-operation fault
@@ -101,6 +140,20 @@ class FaultPlan:
         only, see :data:`SINK_PARTIAL`.  ``crashes`` schedules that many
         crash points, each at a random boundary kind within the first
         ``crash_span`` boundaries of an attempt.
+
+        Fleet surfaces (ISSUE 16, all default-off): the ``net_*_rate``
+        knobs roll one fault decision per pub/sub message over the
+        first ``net_msgs`` messages through a ``ChaosPubSub`` proxy
+        (one RNG draw per index, cumulative thresholds — a rate at 0
+        leaves the other kinds' schedule unchanged); ``net_delay_ms``
+        is the hold a ``delay`` fault imposes.
+        ``partition_windows=((start, length), ...)`` drops every
+        message in those global-index windows outright.  ``ship_rate``
+        rolls torn/corrupt/delayed append damage over the first
+        ``ship_ops`` ship-log appends.  All fleet draws happen AFTER
+        the legacy surfaces' draws, so plans with the fleet knobs at
+        their defaults are bit-identical to pre-fleet plans under the
+        same seed (the ``sink_partial_rate`` precedent).
         """
         rng = random.Random(seed)
         sink: dict[int, str] = {}
@@ -129,12 +182,35 @@ class FaultPlan:
             hi = crash_span if kind == "batch" else min(crash_span, 2)
             crash_script.append((kind, rng.randrange(1, hi + 1)))
         crash_script = tuple(crash_script)
+        # fleet draws LAST (bit-identity for pre-fleet plans): one roll
+        # per message index, kinds picked by cumulative rate thresholds
+        # so turning one kind on never reshuffles another kind's draws
+        net: dict[int, str] = {}
+        rates = (("drop", net_drop_rate), ("delay", net_delay_rate),
+                 ("dup", net_dup_rate), ("torn", net_torn_rate))
+        for i in range(net_msgs):
+            roll = rng.random()
+            lo = 0.0
+            for kind, rate in rates:
+                if rate and roll < lo + rate:
+                    net[i] = kind
+                    break
+                lo += rate
+        ship: dict[int, str] = {}
+        for i in range(ship_ops):
+            if rng.random() < ship_rate:
+                ship[i] = rng.choice(SHIP_FAULT_KINDS)
+        windows = tuple((int(s), int(n)) for s, n in partition_windows)
         return cls(seed=seed, sink_faults=sink, journal_faults=journal,
-                   crashes=crash_script)
+                   crashes=crash_script, net_faults=net,
+                   net_delay_ms=int(net_delay_ms),
+                   partition_windows=windows, ship_faults=ship)
 
     @property
     def is_zero(self) -> bool:
-        return not (self.sink_faults or self.journal_faults or self.crashes)
+        return not (self.sink_faults or self.journal_faults
+                    or self.crashes or self.net_faults
+                    or self.partition_windows or self.ship_faults)
 
 
 class CrashScheduler:

@@ -167,6 +167,12 @@ MAX_LINE_BYTES = 64 << 10
 REPLY_QUEUE_MAX = 1024
 REPLY_QUEUE_MAX_BYTES = 2 << 20
 
+#: per-connection request-id dedup window (ISSUE 16): a duplicated
+#: query-verb message (net_dup, or a client retry racing its own
+#: predecessor) is answered at most once per id within this many most
+#: recent ids — bounded so a hostile/id-less client can't grow memory
+QUERY_DEDUP_MAX = 1024
+
 
 class _SockStream:
     """recv-based reader whose buffer SURVIVES socket timeouts.
@@ -386,6 +392,11 @@ class _Handler(socketserver.StreamRequestHandler):
         self._rq_cv = threading.Condition()
         self._rq_dead = False
         self._rq_thread: threading.Thread | None = None
+        # request-id dedup window (ISSUE 16): ids this connection has
+        # already routed to a query verb; duplicates are dropped so
+        # net_dup / client retries stay exactly-once-answered
+        self._seen_ids: deque = deque()
+        self._seen_idset: set = set()
         my_topics: set[str] = set()
         try:
             for msg in self._messages(_SockStream(self.connection)):
@@ -400,6 +411,15 @@ class _Handler(socketserver.StreamRequestHandler):
                     # interleave with pub/sub traffic safely.  The
                     # topic defaults to the verb name; the reply rides
                     # the normal data-message shape.
+                    qid = msg.get("id")
+                    if isinstance(qid, (str, int)):
+                        if qid in self._seen_idset:
+                            continue   # duplicate delivery: answered once
+                        self._seen_idset.add(qid)
+                        self._seen_ids.append(qid)
+                        if len(self._seen_ids) > QUERY_DEDUP_MAX:
+                            self._seen_idset.discard(
+                                self._seen_ids.popleft())
                     self._answer_query(server, qfn, msg,
                                        str(msg.get("topic") or kind))
                     continue
@@ -704,13 +724,33 @@ class WebSocketClient(_LatencySplitMixin):
             self._sock.close()
 
 
+#: bounded buffers on the client's synchronous request path: pending
+#: out-of-turn messages kept for recv(), and abandoned retry ids whose
+#: late replies must be discarded rather than surfaced
+CLIENT_PENDING_MAX = 1024
+CLIENT_STALE_IDS_MAX = 4096
+
+
 class PubSubClient(_LatencySplitMixin):
-    """Blocking JSON-lines client (tests + CLI queries)."""
+    """Blocking JSON-lines client (tests + CLI queries).
+
+    Reads go through an internal recv buffer rather than the makefile
+    reader: ``BufferedReader.readline`` silently DISCARDS a partial
+    line when the socket times out mid-read, which desyncs the framing
+    exactly when the timeout/retry path (ISSUE 16) needs it intact.
+    Here a timeout leaves the partial line buffered; the next read
+    resumes where it stopped.
+    """
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0):
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout_s)
-        self._file = self._sock.makefile("rwb")
+        self._timeout_s = timeout_s
+        self._file = self._sock.makefile("wb")
+        self._rbuf = bytearray()
+        self._pending: list = []        # out-of-turn messages for recv()
+        self._stale_ids: dict = {}      # abandoned retry ids (ordered)
+        self._auto_id = 0
 
     def subscribe(self, topic: str) -> None:
         self._send({"type": "subscribe", "topic": topic})
@@ -718,19 +758,124 @@ class PubSubClient(_LatencySplitMixin):
     def unsubscribe(self, topic: str) -> None:
         self._send({"type": "unsubscribe", "topic": topic})
 
-    def request(self, msg: dict) -> None:
-        """Send a query-verb message; the answer arrives as a normal
-        data message via ``recv()``.  The send time is stamped per id
-        so ``latency_split`` can divide the round trip."""
-        self._note_request(msg)
-        self._send(msg)
+    def request(self, msg: dict, *, timeout_s: float | None = None,
+                retries: int = 0):
+        """Send a query-verb message.
+
+        Legacy mode (``timeout_s=None``): fire-and-forget — the answer
+        arrives as a normal data message via ``recv()``, and a dropped
+        reply blocks that recv forever.  Returns None.
+
+        Synchronous mode (``timeout_s`` set, ISSUE 16): waits for the
+        id-matched reply and returns its DATA payload.  Each timed-out
+        attempt retries with a FRESH derived id (``<id>~r<n>``) — the
+        server answers each id at most once (request-id dedup), so a
+        retry racing its predecessor's late reply stays exactly-once-
+        answered: the first reply wins and the other ids' replies are
+        discarded.  Raises TimeoutError when every attempt times out.
+        The send time is stamped per id either way so ``latency_split``
+        can divide the round trip.
+        """
+        if timeout_s is None:
+            self._note_request(msg)
+            self._send(msg)
+            return None
+        base = msg.get("id")
+        if base is None:
+            self._auto_id += 1
+            base = f"q{self._auto_id}"
+        attempt_ids = []
+        for attempt in range(max(int(retries), 0) + 1):
+            qid = base if attempt == 0 else f"{base}~r{attempt}"
+            attempt_ids.append(qid)
+            m = dict(msg)
+            m["id"] = qid
+            self._note_request(m)
+            self._send(m)
+            deadline = time.monotonic() + timeout_s
+            try:
+                data = self._recv_reply(qid, deadline)
+            except (TimeoutError, socket.timeout):
+                # abandoned attempt: a late reply to this id must be
+                # dropped, not surfaced as someone else's answer
+                self._mark_stale(qid)
+                continue
+            for other in attempt_ids[:-1]:
+                self._mark_stale(other)
+            return data
+        raise TimeoutError(
+            f"pub/sub request timed out after {len(attempt_ids)} "
+            f"attempt(s) ({timeout_s}s each)")
+
+    def _mark_stale(self, qid) -> None:
+        self._stale_ids[qid] = True
+        while len(self._stale_ids) > CLIENT_STALE_IDS_MAX:
+            self._stale_ids.pop(next(iter(self._stale_ids)))
+
+    def _recv_reply(self, qid, deadline: float) -> dict:
+        """Drain messages until the data reply carrying ``qid``
+        arrives.  Torn frames (undecodable lines) are skipped — the
+        framing resyncs on the next newline; out-of-turn messages are
+        buffered for ``recv()``; late replies to abandoned retry ids
+        are discarded."""
+        while True:
+            line = self._readline(deadline)
+            if not line:
+                raise ConnectionError(
+                    "pub/sub server closed the connection")
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue   # damaged frame: the message is lost, the
+                #            stream is not — resync on the next line
+            if not isinstance(msg, dict):
+                continue
+            data = msg.get("data")
+            rid = data.get("id") if isinstance(data, dict) else None
+            if msg.get("type") == "data" and rid is not None:
+                if rid == qid:
+                    return data
+                if self._stale_ids.pop(rid, None) is not None:
+                    continue   # late reply to an abandoned attempt
+            self._pending.append(msg)
+            if len(self._pending) > CLIENT_PENDING_MAX:
+                self._pending.pop(0)
+
+    def _readline(self, deadline: float | None = None) -> bytes:
+        """One newline-terminated line from the recv buffer.  With a
+        deadline, raises TimeoutError when it passes — the partial
+        line stays buffered for the next read.  Returns b'' on EOF
+        with an empty buffer (a partial line at EOF is returned as
+        is; its json parse fails like any damaged frame)."""
+        while b"\n" not in self._rbuf:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("pub/sub read deadline passed")
+                self._sock.settimeout(remaining)
+            try:
+                chunk = self._sock.recv(65536)
+            finally:
+                if deadline is not None:
+                    self._sock.settimeout(self._timeout_s)
+            if not chunk:
+                out = bytes(self._rbuf)
+                self._rbuf.clear()
+                return out
+            self._rbuf += chunk
+        i = self._rbuf.find(b"\n")
+        out = bytes(self._rbuf[:i + 1])
+        del self._rbuf[:i + 1]
+        return out
 
     def _send(self, msg: dict) -> None:
         self._file.write(json.dumps(msg).encode() + b"\n")
         self._file.flush()
 
     def recv(self) -> dict:
-        line = self._file.readline()
+        if self._pending:
+            return self._pending.pop(0)
+        line = self._readline()
         if not line:
             raise ConnectionError("pub/sub server closed the connection")
         return json.loads(line)

@@ -50,6 +50,11 @@ class DurableDimensionStore:
         # {"mins": [C,k] uint32, "registers": [C,R] int32,
         #  "campaigns": [...], "epoch": int, "_updated": ms} or None
         self._reach: dict | None = None
+        # chaos hook (ISSUE 16): when set, every put_reach_sketches
+        # line passes through ``hook(line) -> (data, intact)`` before
+        # hitting the file — the ship-log fault surface.  None (the
+        # default) is a byte-exact pass-through.
+        self.ship_fault_hook = None
         if os.path.exists(self.path):
             self._replay()
         self._f = open(self.path, "a", encoding="utf-8")
@@ -112,10 +117,21 @@ class DurableDimensionStore:
             rec["sm"] = int(submit_ms)
         if origin is not None:
             rec["origin"] = dict(origin)
-        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        data = json.dumps(rec, separators=(",", ":")) + "\n"
+        intact = True
+        hook = self.ship_fault_hook
+        if hook is not None:
+            # ship-log fault surface (ISSUE 16): the hook may tear,
+            # corrupt, or delay the appended record; a damaged record
+            # must not be absorbed — the writer's own replay view
+            # stays no fresher than what it durably wrote
+            data, intact = hook(data)
+        if data:
+            self._f.write(data)
         self._f.flush()
         os.fsync(self._f.fileno())
-        self._absorb_reach(rec)
+        if intact:
+            self._absorb_reach(rec)
 
     def _absorb_reach(self, rec: dict) -> None:
         try:

@@ -121,7 +121,10 @@ def summarize_fleet(records: list[dict], path: str = "") -> dict:
         })
         kind = r.get("kind")
         if kind == "event":
-            if r.get("event") == "restart":
+            # "restart" = the writer supervisor's annotation; "replica_
+            # restart" = the fleet supervisor respawning a replica
+            # process (ISSUE 16) — one restart column covers both roles
+            if r.get("event") in ("restart", "replica_restart"):
                 agg["restarts"] += 1
             continue
         if kind not in ("snapshot", "final"):
@@ -148,6 +151,34 @@ def summarize_fleet(records: list[dict], path: str = "") -> dict:
                     hop: (s or {}).get("p99")
                     for hop, s in (fr.get("hops") or {}).items()}
                 agg["freshness_high_water_ms"] = fr.get("high_water_ms")
+        # router journal (ISSUE 16): the fronting router's sampler
+        # writes rec["router"] = ReachRouter.summary() — folded into
+        # the same serving columns (routed qps, answered as served) so
+        # the router reads as one more row of the fleet table, plus
+        # its own failover/shed evidence as a sub-line
+        rt = r.get("router")
+        if isinstance(rt, dict):
+            agg["qps"] = rt.get("qps")
+            agg["served"] = rt.get("answered")
+            agg["shed"] = rt.get("shed")
+            agg["router"] = {
+                "routed": rt.get("routed"),
+                "failovers": rt.get("failovers"),
+                "shed_ratio": rt.get("shed_ratio"),
+                "failover_p99_ms": rt.get("failover_p99_ms"),
+                "replicas": len(rt.get("replicas") or ()),
+                "suspect": sum(1 for h in (rt.get("replicas") or ())
+                               if isinstance(h, dict)
+                               and h.get("suspect")),
+            }
+        # chaos fault counters (ISSUE 16): any role may journal its
+        # injector's snapshot under "faults"; the net_faults column is
+        # the fleet-wide message-fault evidence next to restarts
+        faults = r.get("faults")
+        if isinstance(faults, dict):
+            n = faults.get("net_faults")
+            if isinstance(n, (int, float)):
+                agg["net_faults"] = int(n)
         clock = r.get("clock")
         if isinstance(clock, dict):
             agg["clock"] = {k: clock.get(k) for k in
@@ -177,7 +208,8 @@ def render_fleet(s: dict) -> str:
     lines = [f"fleet report: {s['path'] or '(records)'}",
              f"  {s['processes']} process(es), {s['records']} records",
              f"  {'role':<10} {'pid':>8} {'ev/s':>10} {'qps':>8} "
-             f"{'hit%':>6} {'stale ms':>9} {'epoch':>6} {'restarts':>8}"]
+             f"{'hit%':>6} {'stale ms':>9} {'epoch':>6} {'restarts':>8} "
+             f"{'netflt':>6}"]
     for a in s["roles"]:
         hit = a.get("cache_hit_ratio")
         lines.append(
@@ -187,7 +219,20 @@ def render_fleet(s: dict) -> str:
             f"{(f'{hit * 100:.0f}%' if isinstance(hit, (int, float)) else '-'):>6} "
             f"{_fmt(a.get('staleness_ms')):>9} "
             f"{_fmt(a.get('plane_epoch')):>6} "
-            f"{_fmt(a.get('restarts')):>8}")
+            f"{_fmt(a.get('restarts')):>8} "
+            f"{_fmt(a.get('net_faults')):>6}")
+        rt = a.get("router")
+        if rt:
+            ratio = rt.get("shed_ratio")
+            ratio_s = (f"{ratio:.3f}"
+                       if isinstance(ratio, (int, float)) else "-")
+            lines.append(
+                f"    router: routed {_fmt(rt.get('routed'))}  "
+                f"failovers {_fmt(rt.get('failovers'))}  "
+                f"shed_ratio {ratio_s}  "
+                f"failover p99 {_fmt(rt.get('failover_p99_ms'))} ms  "
+                f"replicas {_fmt(rt.get('replicas'))} "
+                f"({_fmt(rt.get('suspect'))} suspect)")
         fr = a.get("freshness_p99_ms")
         if fr:
             hops = "  ".join(f"{hop} {_fmt(fr.get(hop))}"

@@ -91,6 +91,14 @@ DEFAULT_TOLERANCES: dict = {
     "fleet_ship_wait_p99_ms": ("lower", 1.0),
     "fleet_tail_lag_p99_ms": ("lower", 1.0),
     "fleet_serve_p99_ms": ("lower", 1.0),
+    # fleet chaos router (ISSUE 16): the failover episode tail and the
+    # honest-shed fraction of the seeded chaos rung both regress UP.
+    # Advisory-by-tolerance like every wall-timing row here: the
+    # failover episode is dominated by connect/timeout wall time on
+    # the 1-core host, and the shed ratio by where the seeded faults
+    # land relative to the storm's pacing.
+    "router_failover_p99_ms": ("lower", 2.0),
+    "router_shed_ratio": ("lower", 2.0),
     # sliding A/B (ISSUE 12): both arms' catchup throughput regresses
     # DOWN; generous like every timing row on the 1-core host
     "sliding_evps": ("higher", 0.5),
@@ -205,6 +213,13 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
             for hop in ("fold_lag", "ship_wait", "tail_lag", "serve"):
                 out[f"fleet_{hop}_p99_ms"] = _num(
                     fresh.get(f"{hop}_p99_ms"))
+        # ISSUE 16 fleet chaos keys (bench_reach fleet_chaos rung, or a
+        # router stats line / metrics record compared directly)
+        rt = reach.get("router")
+        if isinstance(rt, dict):
+            out["router_failover_p99_ms"] = _num(
+                rt.get("failover_p99_ms"))
+            out["router_shed_ratio"] = _num(rt.get("shed_ratio"))
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -421,7 +421,24 @@ def main(argv: list[str] | None = None) -> int:
                     help="workdir for this replica's metrics.jsonl + "
                          "trace_<pid>.json + flight dumps (fleet mode)")
     ap.add_argument("--metrics-interval-ms", type=int, default=1000)
+    ap.add_argument("--pid-file", default=None,
+                    help="pidfile path (pids/replica_<n>); written as "
+                         "'<pid> <starttime>' so liveness checks survive "
+                         "pid recycling; REFUSES to start when the file "
+                         "names a live process")
     args = ap.parse_args(argv)
+
+    if args.pid_file:
+        from streambench_tpu.utils.pidfile import (
+            acquire_pidfile,
+            pidfile_alive,
+            release_pidfile,
+        )
+
+        if acquire_pidfile(args.pid_file) is None:
+            print(f"replica: refusing to start — {args.pid_file} names "
+                  f"live pid {pidfile_alive(args.pid_file)}", flush=True)
+            return 1
 
     ship = args.ship
     if os.path.isdir(ship):
@@ -505,6 +522,8 @@ def main(argv: list[str] | None = None) -> int:
         flightrec.dump("replica_exit")
     if sampler is not None:
         sampler.close(final=stats)
+    if args.pid_file:
+        release_pidfile(args.pid_file)
     print(json.dumps(stats), flush=True)
     return 0
 

@@ -1,0 +1,603 @@
+"""Fleet chaos (ISSUE 16): network + ship-log fault injection, the
+freshness-aware failover router, the replica fleet supervisor, and the
+verified shed-or-answer invariants.
+
+Tier-1 subset pins the contracts:
+
+- fleet fault draws are seeded-deterministic and a rate-0 plan is
+  bit-identical to a pre-fleet plan under the same seed (knobs
+  default-off means NOTHING changes);
+- a no-injector ``ChaosPubSub`` is a byte-exact pass-through;
+- the ship-log filter's torn/corrupt/delayed damage is skip-and-resync
+  durable: damaged records never load, the writer's own view never runs
+  ahead of what it durably wrote;
+- ``PubSubClient.request`` retries with FRESH ids and the server-side
+  request-id dedup keeps dup-faulted traffic exactly-once-answered;
+- pidfiles use the "pid starttime" format and refuse live pids while
+  accepting recycled ones;
+- the router is sticky by campaign-set hash, fails over in freshness
+  order, and sheds honestly when every replica is stale;
+- the ``FleetSupervisor`` respawns crash-killed replicas under the PR 1
+  capped-backoff formula and gives up on no-progress slots;
+- the ``chaos.verify`` fleet invariants catch every violation class
+  they exist for.
+
+The 20-seed randomized sweep is marked ``slow``.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from streambench_tpu.chaos import (
+    ChaosPubSub,
+    FaultInjector,
+    FaultPlan,
+    FleetSupervisor,
+    check_fleet_accounting,
+    check_fleet_convergence,
+    check_staleness_bound,
+    durable_epoch_at,
+    ship_epoch_timeline,
+)
+from streambench_tpu.dimensions.pubsub import PubSubClient, PubSubServer
+from streambench_tpu.dimensions.store import LOG_NAME, DurableDimensionStore
+from streambench_tpu.reach.router import ReachRouter, campaign_shard
+from streambench_tpu.utils.ids import now_ms
+from streambench_tpu.utils.pidfile import (
+    acquire_pidfile,
+    pidfile_alive,
+    proc_starttime,
+    read_pidfile,
+    release_pidfile,
+)
+
+
+# ----------------------------------------------------------------------
+# plan: fleet draws
+def test_fleet_plan_seeded_deterministic():
+    kw = dict(net_drop_rate=0.2, net_delay_rate=0.1, net_dup_rate=0.1,
+              net_torn_rate=0.05, net_msgs=200,
+              partition_windows=((30, 10),), ship_rate=0.3, ship_ops=40)
+    a = FaultPlan.generate(7, **kw)
+    assert a == FaultPlan.generate(7, **kw)
+    assert a != FaultPlan.generate(8, **kw)
+    assert a.net_faults and a.ship_faults and not a.is_zero
+    assert a.partition_windows == ((30, 10),)
+
+
+def test_fleet_knobs_off_is_bit_identical_to_pre_fleet_plan():
+    """Fleet draws happen AFTER the legacy surfaces' draws, so leaving
+    every fleet knob at its default changes NOTHING about a legacy
+    plan — the default-off guarantee at the plan layer."""
+    legacy = dict(sink_rate=0.3, sink_ops=50, journal_rate=0.2,
+                  journal_polls=30, crashes=3)
+    a = FaultPlan.generate(42, **legacy)
+    b = FaultPlan.generate(42, **legacy, net_drop_rate=0.0,
+                           net_delay_rate=0.0, net_dup_rate=0.0,
+                           net_torn_rate=0.0, net_msgs=500,
+                           ship_rate=0.0, ship_ops=100)
+    assert a == b
+    assert b.is_zero is False and not b.net_faults and not b.ship_faults
+
+
+def test_partition_window_outranks_rolled_kind():
+    plan = FaultPlan.generate(3, net_dup_rate=1.0, net_msgs=20,
+                              partition_windows=((5, 5),))
+    inj = FaultInjector(plan)
+    kinds = [inj.net_fault() for _ in range(20)]
+    assert kinds[5:10] == ["drop"] * 5          # window wins over dup
+    assert all(k == "dup" for k in kinds[:5] + kinds[10:])
+    assert inj.counters.get("net_partition_drops") == 5
+
+
+# ----------------------------------------------------------------------
+# ship-log fault filter through the real store
+def _planes(seed, camps, k=16, r=32):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 1 << 32, size=(len(camps), k),
+                         dtype=np.uint32),
+            rng.integers(0, 20, size=(len(camps), r)).astype(np.int32))
+
+
+def test_ship_faults_skip_and_resync(tmp_path):
+    """torn eats itself AND the next append (one garbage line);
+    corrupt eats itself; delayed lands late; the store's own view and
+    the decodable timeline only ever contain intact records."""
+    camps = ["a", "b"]
+    store = DurableDimensionStore(str(tmp_path))
+    plan = FaultPlan(ship_faults={1: "torn", 3: "delayed"})
+    FaultInjector(plan).attach_ship_chaos(store)
+    for epoch in range(1, 6):     # ship indexes 0..4
+        m, r = _planes(epoch, camps)
+        store.put_reach_sketches(m, r, camps, epoch,
+                                 submit_ms=now_ms(), folded_ms=now_ms())
+    store.close()
+    timeline = ship_epoch_timeline(str(tmp_path / LOG_NAME))
+    # epoch 2 torn -> its stub merges with epoch 3's append into one
+    # undecodable line; epoch 4 held, flushed intact before epoch 5
+    assert [e for _, e in timeline] == [1, 4, 5]
+    # the writer's own view never absorbed a damaged append: reopen
+    # replays the log, latest DECODABLE record wins
+    re = DurableDimensionStore(str(tmp_path))
+    assert re.reach_sketches()["epoch"] == 5
+    re.close()
+
+
+def test_ship_fault_hook_default_off_is_byte_identical(tmp_path):
+    camps = ["a", "b"]
+    m, r = _planes(1, camps)
+    plain = DurableDimensionStore(str(tmp_path / "plain"))
+    plain.put_reach_sketches(m, r, camps, 1, update_time_ms=123,
+                             submit_ms=456, folded_ms=455)
+    plain.close()
+    wired = DurableDimensionStore(str(tmp_path / "wired"))
+    FaultInjector(FaultPlan.zeros()).attach_ship_chaos(wired)
+    wired.put_reach_sketches(m, r, camps, 1, update_time_ms=123,
+                             submit_ms=456, folded_ms=455)
+    wired.close()
+    read = lambda p: open(os.path.join(p, LOG_NAME), "rb").read()
+    assert read(str(tmp_path / "plain")) == read(str(tmp_path / "wired"))
+
+
+# ----------------------------------------------------------------------
+# network chaos proxy + client retry + server dedup
+def _echo_server(counts: dict):
+    """A pub/sub server with a 'reach' verb that ledgers every handler
+    invocation per id and echoes the payload."""
+    srv = PubSubServer(port=0)
+    lock = threading.Lock()
+
+    def handle(msg, reply):
+        with lock:
+            counts[msg.get("id")] = counts.get(msg.get("id"), 0) + 1
+        reply({"id": msg.get("id"), "v": msg.get("v"),
+               "estimate": 1.0, "plane_epoch": 1})
+
+    srv.register_query("reach", handle)
+    return srv.start()
+
+
+def test_chaos_proxy_no_injector_is_passthrough():
+    counts: dict = {}
+    srv = _echo_server(counts)
+    proxy = ChaosPubSub(srv.address).start()
+    try:
+        direct = PubSubClient(*srv.address, timeout_s=10)
+        proxied = PubSubClient(*proxy.address, timeout_s=10)
+        for i in range(10):
+            a = direct.request({"type": "reach", "id": f"d{i}", "v": i},
+                               timeout_s=5.0)
+            b = proxied.request({"type": "reach", "id": f"d{i}~p",
+                                 "v": i}, timeout_s=5.0)
+            assert a["v"] == b["v"] == i
+        direct.close()
+        proxied.close()
+        assert proxy.stats["dropped"] == proxy.stats["torn"] == 0
+        assert proxy.stats["dupped"] == proxy.stats["delayed"] == 0
+        assert proxy.stats["msgs"] >= 20
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_retry_plus_dedup_exactly_once_under_drops_and_dups():
+    """40% drops + 20% dups on the wire: every request still returns
+    exactly one answer, the server executed each delivered id at most
+    once, and retries used FRESH derived ids."""
+    counts: dict = {}
+    srv = _echo_server(counts)
+    plan = FaultPlan.generate(11, net_drop_rate=0.4, net_dup_rate=0.2,
+                              net_msgs=2000)
+    inj = FaultInjector(plan)
+    proxy = ChaosPubSub(srv.address, inj).start()
+    try:
+        c = PubSubClient(*proxy.address, timeout_s=30)
+        got = []
+        for i in range(30):
+            try:
+                got.append(c.request({"type": "reach", "id": f"q{i}",
+                                      "v": i},
+                                     timeout_s=0.5, retries=8))
+            except TimeoutError:
+                pass   # honest exhaustion is allowed; double answers not
+        c.close()
+        vals = [d["v"] for d in got]
+        assert len(vals) == len(set(vals)), "double-answered request"
+        assert len(vals) >= 20
+        # at the server every executed id ran exactly once — duplicated
+        # request frames were absorbed by the request-id dedup
+        assert all(n == 1 for n in counts.values()), counts
+        assert {str(k).split("~r")[0] for k in counts} <= {
+            f"q{i}" for i in range(30)}
+        assert proxy.stats["dropped"] > 0 and proxy.stats["dupped"] > 0
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_proxy_torn_frames_resync():
+    """A torn frame is one undecodable line — the receiver skips it and
+    the NEXT message still parses (framing never desyncs)."""
+    counts: dict = {}
+    srv = _echo_server(counts)
+    plan = FaultPlan(net_faults={1: "torn"})
+    proxy = ChaosPubSub(srv.address, FaultInjector(plan)).start()
+    try:
+        c = PubSubClient(*proxy.address, timeout_s=10)
+        # msg idx 0 = request out intact, idx 1 = reply TORN: the torn
+        # reply never decodes as t0's answer, so attempt 2 (fresh id
+        # t0~r1, msg idx 2/3) lands it
+        a = c.request({"type": "reach", "id": "t0", "v": 0},
+                      timeout_s=1.0, retries=2)
+        assert a["v"] == 0 and a["id"] == "t0~r1"
+        c.close()
+        assert proxy.stats["torn"] == 1
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_proxy_drop_conns_severs_but_keeps_listening():
+    counts: dict = {}
+    srv = _echo_server(counts)
+    proxy = ChaosPubSub(srv.address).start()
+    try:
+        c = PubSubClient(*proxy.address, timeout_s=10)
+        assert c.request({"type": "reach", "id": "a", "v": 1},
+                         timeout_s=5.0)["v"] == 1
+        assert proxy.drop_conns() >= 2
+        with pytest.raises((TimeoutError, ConnectionError, OSError)):
+            c.request({"type": "reach", "id": "b", "v": 2},
+                      timeout_s=0.5)
+        c.close()
+        c2 = PubSubClient(*proxy.address, timeout_s=10)   # re-dial works
+        assert c2.request({"type": "reach", "id": "c", "v": 3},
+                          timeout_s=5.0)["v"] == 3
+        c2.close()
+    finally:
+        proxy.close()
+        srv.close()
+
+
+# ----------------------------------------------------------------------
+# pidfile
+def test_pidfile_format_and_refusal(tmp_path):
+    path = str(tmp_path / "pids" / "replica_0")
+    assert acquire_pidfile(path) == os.getpid()
+    pid, started = read_pidfile(path)
+    assert pid == os.getpid()
+    assert started == proc_starttime(os.getpid())
+    # a live pidfile refuses a second acquire
+    assert acquire_pidfile(path) is None
+    assert pidfile_alive(path) == os.getpid()
+    release_pidfile(path)
+    assert not os.path.exists(path)
+
+
+def test_pidfile_recycled_pid_is_dead(tmp_path):
+    """Same pid number, different starttime: the process the file named
+    is GONE — a recycled pid must not block the seat."""
+    path = str(tmp_path / "replica_1")
+    with open(path, "w") as f:
+        f.write(f"{os.getpid()} 1\n")     # our pid, wrong starttime
+    assert pidfile_alive(path) is None
+    assert acquire_pidfile(path) == os.getpid()
+    release_pidfile(path)
+
+
+def test_pidfile_release_refuses_foreign(tmp_path):
+    path = str(tmp_path / "replica_2")
+    with open(path, "w") as f:
+        f.write(f"{os.getpid() + 1} 1\n")
+    release_pidfile(path)                  # not ours: left alone
+    assert os.path.exists(path)
+
+
+# ----------------------------------------------------------------------
+# router: stickiness / failover order / honest shed
+def _fake_replica(tag: str, *, shed=None, staleness_ms=5.0, epoch=3):
+    """A pub/sub endpoint impersonating a replica's reach verb."""
+    srv = PubSubServer(port=0)
+
+    def handle(msg, reply):
+        if shed is not None:
+            reply({"shed": True, "reason": shed, "plane_epoch": epoch,
+                   "staleness_ms": staleness_ms, "id": msg.get("id")})
+            return
+        reply({"estimate": 1.0, "plane_epoch": epoch, "tag": tag,
+               "staleness_ms": staleness_ms, "id": msg.get("id")})
+
+    srv.register_query("reach", handle)
+    return srv.start()
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_router_sticky_by_campaign_set_hash():
+    reps = [_fake_replica("r0"), _fake_replica("r1")]
+    router = ReachRouter([f"127.0.0.1:{r.address[1]}" for r in reps],
+                         timeout_s=5.0, retries=0).start()
+    try:
+        c = PubSubClient(*router.address, timeout_s=10)
+        sets = [[f"c{i}"] for i in range(8)] + [["c1", "c2"]]
+        for sel in sets:
+            want = campaign_shard(sel, 2)
+            for n in range(2):           # stickiness: same answer twice
+                d = c.request({"type": "reach", "campaigns": sel,
+                               "op": "union", "id": f"{sel}-{n}"},
+                              timeout_s=5.0)
+                assert d["tag"] == f"r{want}", (sel, d)
+        c.close()
+        assert router.failovers == 0
+    finally:
+        router.close()
+        for r in reps:
+            r.close()
+
+
+def test_router_failover_order_and_episode_recorded():
+    """Dead primary: the query lands on the freshest secondary, the
+    failover counter and episode latency ring record it, and after
+    SUSPECT_AFTER consecutive failures the primary is demoted."""
+    live = _fake_replica("live")
+    dead = _dead_port()
+    # find a campaign set whose sticky primary is the dead seat 0
+    sel = next([f"s{i}"] for i in range(64)
+               if campaign_shard([f"s{i}"], 2) == 0)
+    router = ReachRouter([f"127.0.0.1:{dead}",
+                          f"127.0.0.1:{live.address[1]}"],
+                         timeout_s=1.0, retries=0).start()
+    try:
+        c = PubSubClient(*router.address, timeout_s=30)
+        for n in range(3):
+            d = c.request({"type": "reach", "campaigns": sel,
+                           "op": "union", "id": n}, timeout_s=10.0)
+            assert d["tag"] == "live"
+        c.close()
+        s = router.summary()
+        # queries 1+2 fail over off the dead primary; by query 3 the
+        # primary is SUSPECT (2 consecutive failures) and demoted, so
+        # the live replica is tried first — no failover episode
+        assert s["failovers"] == 2 and s["answered"] == 3
+        assert "failover_p99_ms" in s and s["failover_p99_ms"] >= 0
+        assert router.handles[0].suspect()        # demoted
+        assert not router.handles[1].suspect()
+    finally:
+        router.close()
+        live.close()
+
+
+def test_router_all_stale_sheds_honestly():
+    reps = [_fake_replica("r0", shed="stale"),
+            _fake_replica("r1", shed="stale")]
+    router = ReachRouter([f"127.0.0.1:{r.address[1]}" for r in reps],
+                         timeout_s=5.0, retries=0).start()
+    try:
+        c = PubSubClient(*router.address, timeout_s=10)
+        d = c.request({"type": "reach", "campaigns": ["x"],
+                       "op": "union", "id": "q"}, timeout_s=5.0)
+        assert d == {"shed": True, "reason": "all_stale", "id": "q"}
+        c.close()
+        assert router.shed == 1 and router.answered == 0
+    finally:
+        router.close()
+        for r in reps:
+            r.close()
+
+
+def test_router_forwards_client_errors_without_failover():
+    srv = PubSubServer(port=0)
+
+    def refuse(msg, reply):
+        reply({"error": "bad_request", "id": msg.get("id")})
+
+    srv.register_query("reach", refuse)
+    srv.start()
+    other = _fake_replica("other")
+    router = ReachRouter([f"127.0.0.1:{srv.address[1]}",
+                          f"127.0.0.1:{other.address[1]}"],
+                         timeout_s=5.0, retries=0).start()
+    try:
+        sel = next([f"s{i}"] for i in range(64)
+                   if campaign_shard([f"s{i}"], 2) == 0)
+        c = PubSubClient(*router.address, timeout_s=10)
+        d = c.request({"type": "reach", "campaigns": sel, "op": "nope",
+                       "id": "e"}, timeout_s=5.0)
+        assert d["error"] == "bad_request" and d["id"] == "e"
+        c.close()
+        assert router.failovers == 0       # malformed != failed over
+    finally:
+        router.close()
+        srv.close()
+        other.close()
+
+
+# ----------------------------------------------------------------------
+# fleet supervisor (injected clock + sleep: no real waiting)
+class _FakeProc:
+    def __init__(self):
+        self.pid = 4242
+        self.code = None
+
+    def poll(self):
+        return self.code
+
+    def kill(self):
+        self.code = -9
+
+    terminate = kill
+
+
+def _stepper(**kw):
+    clock = {"t": 0.0}
+    spawned = []
+
+    def spawn(idx, attempt):
+        p = _FakeProc()
+        spawned.append((idx, attempt, p))
+        return p
+
+    sup = FleetSupervisor(spawn, 1, clock=lambda: clock["t"],
+                          sleep=lambda s: None, **kw)
+    return sup, clock, spawned
+
+
+def test_supervisor_respawns_after_backoff_and_hooks_restart():
+    restarts = []
+    sup, clock, spawned = _stepper(
+        backoff_base_ms=100.0, backoff_cap_ms=1000.0,
+        healthy_after_s=1.0, max_restarts=3, seed=0,
+        on_restart=lambda idx, attempt: restarts.append((idx, attempt)))
+    sup.start()
+    assert len(spawned) == 1
+    clock["t"] = 5.0                      # healthy uptime
+    assert sup.kill(0)
+    assert sup.step() == 0                # death seen, backoff scheduled
+    slot = sup.slots[0]
+    assert slot.restart_at is not None
+    # jittered backoff in [base/2, base): healthy death resets the
+    # young-death counter so the exponent is the floor
+    assert 0.05 <= slot.restart_at - 5.0 <= 0.1
+    clock["t"] = slot.restart_at + 0.001
+    assert sup.step() == 1
+    assert len(spawned) == 2 and spawned[1][1] == 2
+    assert restarts == [(0, 2)]
+    assert sup.summary()["restarts"] == 1
+
+
+def test_supervisor_gives_up_on_consecutive_young_deaths():
+    sup, clock, spawned = _stepper(
+        backoff_base_ms=10.0, backoff_cap_ms=50.0,
+        healthy_after_s=10.0, max_restarts=3, seed=1)
+    sup.start()
+    for _ in range(3):
+        spawned[-1][2].code = 1           # dies instantly (young)
+        sup.step()                        # notice + schedule
+        slot = sup.slots[0]
+        if slot.gave_up:
+            break
+        clock["t"] = slot.restart_at + 0.001
+        sup.step()                        # respawn
+    assert sup.slots[0].gave_up
+    assert sup.summary()["gave_up"] == 1
+    n = len(spawned)
+    sup.step()
+    assert len(spawned) == n              # a given-up slot stays down
+
+
+def test_supervisor_healthy_uptime_resets_young_counter():
+    sup, clock, spawned = _stepper(
+        backoff_base_ms=10.0, backoff_cap_ms=50.0,
+        healthy_after_s=1.0, max_restarts=2, seed=2)
+    sup.start()
+    for _ in range(5):                    # would give up at 2 young
+        clock["t"] += 5.0                 # served long enough
+        spawned[-1][2].code = -9
+        sup.step()
+        clock["t"] = sup.slots[0].restart_at + 0.001
+        sup.step()
+    assert not sup.slots[0].gave_up
+    assert sup.summary()["restarts"] == 5
+
+
+# ----------------------------------------------------------------------
+# fleet invariants
+def test_accounting_exact_by_id():
+    ok = check_fleet_accounting(
+        ["a", "b", "c"],
+        [{"id": "a", "estimate": 1.0}, {"id": "b", "shed": True},
+         {"id": "c", "error": "bad_request"}])
+    assert ok.ok and ok.answered == 2 and ok.shed == 1
+
+    bad = check_fleet_accounting(
+        ["a", "b"],
+        [{"id": "a", "estimate": 1.0}, {"id": "a", "estimate": 1.0},
+         {"id": "z", "estimate": 1.0}])
+    assert not bad.ok
+    assert bad.duplicate_ids == ["a"]
+    assert bad.missing_ids == ["b"]
+    assert bad.unexpected_ids == ["z"]
+
+
+def test_staleness_bound_floor(tmp_path):
+    timeline = [(1000, 1), (2000, 2), (3000, 3)]
+    assert durable_epoch_at(timeline, 999) is None
+    assert durable_epoch_at(timeline, 2500) == 2
+    v = check_staleness_bound(
+        [(3500, {"id": "ok", "plane_epoch": 2}),       # floor(2500)=2
+         (3500, {"id": "old", "plane_epoch": 1}),      # below floor
+         (3500, {"id": "shed", "shed": True, "plane_epoch": 0})],
+        timeline, max_staleness_ms=1000)
+    assert not v.ok
+    assert [x[0] for x in v.stale_violations] == ["old"]
+
+
+def test_convergence_and_bit_identity(tmp_path):
+    camps = ["a", "b"]
+    m, r = _planes(9, camps)
+    for name in ("clean", "chaos", "diverged"):
+        st = DurableDimensionStore(str(tmp_path / name))
+        mm = m if name != "diverged" else m + 1
+        st.put_reach_sketches(mm, r, camps, 7, submit_ms=now_ms())
+        st.close()
+    chaos = str(tmp_path / "chaos" / LOG_NAME)
+    clean = str(tmp_path / "clean" / LOG_NAME)
+    v = check_fleet_convergence(chaos, [7, 7], clean_ship_path=clean)
+    assert v.ok and v.writer_epoch == 7 and not v.divergent
+
+    lag = check_fleet_convergence(chaos, [7, 6], clean_ship_path=clean)
+    assert not lag.ok and lag.lagging_replicas == [(1, 6, 7)]
+
+    div = check_fleet_convergence(
+        str(tmp_path / "diverged" / LOG_NAME), [7],
+        clean_ship_path=clean)
+    assert not div.ok and div.divergent
+
+
+# ----------------------------------------------------------------------
+# the randomized sweep (slow): retry+dedup exactly-once over 20 seeds
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(20))
+def test_exactly_once_sweep_over_seeds(seed):
+    counts: dict = {}
+    srv = _echo_server(counts)
+    plan = FaultPlan.generate(seed, net_drop_rate=0.18,
+                              net_delay_rate=0.05, net_delay_ms=5,
+                              net_dup_rate=0.15, net_torn_rate=0.08,
+                              net_msgs=4000,
+                              partition_windows=((30 + seed, 8),))
+    proxy = ChaosPubSub(srv.address, FaultInjector(plan)).start()
+    try:
+        c = PubSubClient(*proxy.address, timeout_s=60)
+        got = []
+        for i in range(24):
+            try:
+                got.append(c.request({"type": "reach",
+                                      "id": f"s{seed}q{i}", "v": i},
+                                     timeout_s=0.25, retries=10))
+            except (TimeoutError, ConnectionError, OSError):
+                # the partition can outlast the retry budget; honest
+                # failure is allowed — double answering is not
+                c.close()
+                c = PubSubClient(*proxy.address, timeout_s=60)
+        c.close()
+        vals = [d["v"] for d in got]
+        assert len(vals) == len(set(vals)), "double-answered request"
+        assert all(n == 1 for n in counts.values()), counts
+        assert len(vals) >= 18    # the plan runs clean past net_msgs
+    finally:
+        proxy.close()
+        srv.close()
