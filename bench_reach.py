@@ -1441,6 +1441,548 @@ def run_fleet_chaos(workdir: str, *, seed: int = 7, replicas_n: int = 2,
 
 
 # ----------------------------------------------------------------------
+# ISSUE 17: the SLO autopilot rung
+# ----------------------------------------------------------------------
+
+def qps_ramp_schedule(*, seed: int, duration_s: float, qps0: float,
+                      qps1: float, ramp=(0.2, 0.7),
+                      burst_rate_hz: float = 0.5,
+                      burst_n: int = 8) -> list:
+    """Seeded ramp/burst arrival offsets (ISSUE 17 satellite; the
+    ROADMAP 4(a) load shape scoped to the query side): a Poisson
+    arrival process whose rate ramps piecewise-linearly ``qps0 ->
+    qps1`` between the ``ramp`` fractions of the run, plus Poisson
+    bursts (``burst_rate_hz`` expected bursts/s, each landing
+    ``burst_n`` simultaneous arrivals).  Deterministic under the run
+    seed — both bench arms replay the identical schedule."""
+    rng = np.random.default_rng(seed)
+    lo, hi = ramp
+    t, out = 0.0, []
+    while True:
+        frac = min(t / duration_s, 1.0)
+        if frac <= lo:
+            rate = qps0
+        elif frac >= hi:
+            rate = qps1
+        else:
+            rate = qps0 + (qps1 - qps0) * (frac - lo) / (hi - lo)
+        t += float(rng.exponential(1.0 / rate))
+        if t >= duration_s:
+            return out
+        out.append(round(t, 4))
+        # per-arrival burst draw with probability burst_rate_hz/rate
+        # => bursts arrive ~Poisson(burst_rate_hz) per second at any
+        # ramp position, independent of the base rate
+        if float(rng.random()) < burst_rate_hz / rate:
+            out.extend([round(t, 4)] * (burst_n - 1))
+
+
+def run_autoscale(workdir: str, *, seed: int = 13,
+                  duration_s: float = 14.0, tail_s: float = 7.0,
+                  qps0: float = 6.0, qps1: float = 36.0,
+                  burst_n: int = 24, camps_n: int = 16,
+                  K: int = 8192, R: int = 4096,
+                  ship0_ms: int = 2000, objective_staleness_ms: int = 1000,
+                  objective_p99_ms: int = 15, max_replicas: int = 3,
+                  clients: int = 16, phase: str = "autoscale") -> dict:
+    """The ISSUE 17 tentpole proof: a seeded >=5x QPS ramp (with
+    Poisson bursts) against the replica fleet, two arms off the SAME
+    schedule.
+
+    The OFF arm is the fleet as configured: one replica, a lazy
+    2 s ship cadence — replies breach the staleness objective between
+    ships and ramp bursts overrun the depth-2 queue into honest
+    overloaded sheds.  The ON arm runs :class:`AutoscaleController`
+    on a 250 ms cadence over LIVE fleet evidence: the staleness breach
+    diagnoses ``fold_lag`` (the age sits upstream of the tailer) and
+    halves the ship cadence; overloaded sheds diagnose ``serve`` and
+    grow the fleet through ``FleetSupervisor.spawn()`` +
+    ``router.add_replica`` (sheds become failover redirects); the
+    post-ramp idle goes healthy and gracefully retires a replica
+    (deregister -> drain -> stop).  Every decision carries the
+    freshness-hop p99 evidence that justified it; the controller
+    journal + per-role finals render the ``obs fleet`` controller
+    sub-line, and the shared SpanTracer puts the whole episode on one
+    ``obs trace --merge`` timeline.
+
+    Headline regress keys (advisory): ``autoscale.breach_ratio_on``
+    (lower) and ``autoscale.decisions`` (higher).
+    """
+    import socket
+
+    from streambench_tpu.chaos import FleetSupervisor
+    from streambench_tpu.dimensions.pubsub import PubSubClient
+    from streambench_tpu.dimensions.store import (DurableDimensionStore,
+                                                  LOG_NAME)
+    from streambench_tpu.obs import (AutoscaleController, FlightRecorder,
+                                     MetricsRegistry, MetricsSampler,
+                                     SpanTracer)
+    from streambench_tpu.reach.replica import ReachReplica, SnapshotShipper
+    from streambench_tpu.reach.router import ReachRouter
+    from streambench_tpu.utils.ids import now_ms
+
+    camps = [f"as-c{i}" for i in range(camps_n)]
+    rng0 = np.random.default_rng(seed * 1000)
+    mins0 = rng0.integers(0, 1 << 32, size=(len(camps), K),
+                          dtype=np.uint32)
+    regs0 = rng0.integers(0, 30, size=(len(camps), R)).astype(np.int32)
+    objective = {"staleness_ms": objective_staleness_ms,
+                 "p99_ms": objective_p99_ms}
+    schedule = qps_ramp_schedule(seed=seed, duration_s=duration_s,
+                                 qps0=qps0, qps1=qps1,
+                                 burst_n=burst_n)
+    qrng = np.random.default_rng(seed + 1)
+    qsets = [sorted(camps[j] for j in qrng.choice(
+        len(camps), size=int(qrng.integers(2, 7)), replace=False))
+        for _ in range(len(schedule))]
+    fleet_dir = os.path.join(workdir, "autoscale_fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def _arm(on: bool) -> dict:
+        arm_dir = os.path.join(workdir,
+                               f"autoscale_{'on' if on else 'off'}")
+        store = DurableDimensionStore(arm_dir)
+        ship_log = os.path.join(arm_dir, LOG_NAME)
+        shipper = SnapshotShipper(store, camps, interval_ms=ship0_ms)
+        ship_lock = threading.Lock()
+        folds = {"n": 0}
+        writer_stop = threading.Event()
+
+        def fold_tick(force: bool = False) -> None:
+            # epoch stays 1 throughout: due()'s epoch-bump bypass must
+            # not defeat the cadence the controller is tuning
+            with ship_lock:
+                folds["n"] += 1
+                shipper.note_state(mins0, regs0, 1,
+                                   watermark=folds["n"], force=force,
+                                   folded_ms=now_ms())
+
+        def writer() -> None:
+            while not writer_stop.is_set():
+                fold_tick()
+                writer_stop.wait(0.2)
+
+        rep_ports = [free_port() for _ in range(max_replicas)]
+        reps: dict = {}
+        tracer = SpanTracer(capacity=8192) if on else None
+
+        class _Handle:
+            """In-process replica-Popen stand-in (the fleet-chaos
+            idiom): poll/kill/terminate close the live ReachReplica."""
+
+            def __init__(self, idx: int):
+                self.idx = idx
+                self.pid = os.getpid()
+                self._code = None
+
+            def poll(self):
+                return self._code
+
+            def _stop(self, code: int) -> None:
+                if self._code is not None:
+                    return
+                rep = reps.pop(self.idx, None)
+                if rep is not None:
+                    rep.close()
+                self._code = code
+
+            def kill(self):
+                self._stop(-9)
+
+            def terminate(self):
+                self._stop(0)
+
+        def spawn(idx: int, attempt: int):
+            # cache OFF: the campaign-set mix repeats under a pinned
+            # epoch, and the result cache would absorb the whole ramp
+            # from the admission path — this rung loads the DISPATCH
+            # path (serving capacity), which is what replica count buys
+            rep = ReachReplica(ship_log, host="127.0.0.1",
+                               port=rep_ports[idx], poll_ms=100,
+                               max_staleness_ms=30_000,
+                               cache_capacity=0, depth=2,
+                               batch=2, fleet=True,
+                               spans=tracer).start()
+            reps[idx] = rep
+            return _Handle(idx)
+
+        fold_tick(force=True)   # boot record: replicas load at start
+        sup = FleetSupervisor(spawn, 1, backoff_base_ms=40.0,
+                              backoff_cap_ms=400.0,
+                              healthy_after_s=0.3, seed=seed).start()
+        router = ReachRouter([f"127.0.0.1:{rep_ports[0]}"],
+                             timeout_s=5.0, retries=1).start()
+        r_host, r_port = router.address
+
+        # warm direct (compile is process-wide; ON-arm spawns reuse it)
+        wc = PubSubClient("127.0.0.1", rep_ports[0], timeout_s=60)
+        for wi in range(200):
+            try:
+                d = wc.request({"type": "reach", "campaigns": [camps[0]],
+                                "op": "union",
+                                "id": f"aswarm{int(on)}-{wi}"},
+                               timeout_s=10.0)
+            except (TimeoutError, ConnectionError, OSError):
+                time.sleep(0.1)
+                continue
+            if "estimate" in d:
+                break
+            time.sleep(0.1)
+        wc.close()
+
+        ctrl = None
+        sampler = None
+        ctrl_stop = threading.Event()
+        t_ctrl = None
+        if on:
+            registry = MetricsRegistry()
+            ctrl_dir = os.path.join(fleet_dir, "controller")
+            os.makedirs(ctrl_dir, exist_ok=True)
+            sampler = MetricsSampler(
+                os.path.join(ctrl_dir, "metrics.jsonl"),
+                interval_ms=500, registry=registry, role="controller")
+            flightrec = FlightRecorder(ctrl_dir)
+
+            def collect():
+                ts = now_ms()
+                recs = []
+                for idx, rep in list(reps.items()):
+                    srv = rep.server
+                    if srv is not None:
+                        recs.append({"kind": "snapshot",
+                                     "role": "replica",
+                                     "pid": 1000 + idx, "ts_ms": ts,
+                                     "reach_query": srv.summary()})
+                recs.append({"kind": "snapshot", "role": "router",
+                             "pid": os.getpid(), "ts_ms": ts,
+                             "router": router.summary()})
+                recs.append({"kind": "snapshot", "role": "writer",
+                             "pid": os.getpid(), "ts_ms": ts,
+                             "reach_ship": shipper.summary()})
+                return recs
+
+            def spawn_hook() -> bool:
+                if len(sup.slots) >= len(rep_ports):
+                    return False
+                idx = sup.spawn()
+                # force-ship so the newcomer loads a record within one
+                # poll instead of shedding stale for a full cadence
+                fold_tick(force=True)
+                router.add_replica(f"127.0.0.1:{rep_ports[idx]}")
+                return True
+
+            def retire_hook() -> bool:
+                for idx in range(len(sup.slots) - 1, 0, -1):
+                    slot = sup.slots[idx]
+                    if slot.retired or slot.gave_up \
+                            or not sup.alive(idx):
+                        continue
+                    addr = f"127.0.0.1:{rep_ports[idx]}"
+                    return sup.retire(
+                        idx,
+                        deregister=lambda i: router.remove_replica(addr),
+                        drain_s=0.1, grace_s=2.0)
+                return False
+
+            ctrl = AutoscaleController(
+                collect, objective=objective,
+                spawn_replica=spawn_hook, retire_replica=retire_hook,
+                shipper=shipper, min_ship_interval_ms=400,
+                replicas=1, min_replicas=1, max_replicas=max_replicas,
+                breach_ticks=2, healthy_ticks=4, cooldown_s=1.5,
+                window_steps=6, sampler=sampler, flightrec=flightrec,
+                registry=registry)
+
+            def ctrl_loop() -> None:
+                while not ctrl_stop.is_set():
+                    with tracer.span("autoscale_step", cat="autoscale"):
+                        dec = ctrl.step()
+                    if dec is not None:
+                        with tracer.span(
+                                f"autoscale_{dec['decision']}",
+                                cat="autoscale"):
+                            pass
+                        log(f"autoscale: {dec['decision']} "
+                            f"[{dec['verdict']}->{dec['knob']}] "
+                            f"replicas={dec['replicas']}")
+                    ctrl_stop.wait(0.25)
+
+            t_ctrl = threading.Thread(target=ctrl_loop, daemon=True)
+            sampler.add_collector(
+                lambda rec, dt_s: rec.__setitem__("autoscale",
+                                                  ctrl.summary()))
+            sampler.start()
+
+        # curve sampler: both arms record the same shape
+        curve: list = []
+        curve_stop = threading.Event()
+        t0_box = {"t": None}
+
+        def curve_loop() -> None:
+            while not curve_stop.is_set():
+                t0 = t0_box["t"]
+                stale = None
+                for rep in list(reps.values()):
+                    srv = rep.server
+                    if srv is not None:
+                        s2 = srv.summary().get("staleness_ms")
+                        if isinstance(s2, (int, float)):
+                            stale = max(stale or 0.0, float(s2))
+                curve.append({
+                    "t_s": (round(time.monotonic() - t0, 2)
+                            if t0 else None),
+                    "replicas": len(reps),
+                    "staleness_ms": stale,
+                    "routed": router.routed, "shed": router.shed,
+                    "failovers": router.failovers,
+                    "ship_interval_ms": shipper.interval_ms})
+                curve_stop.wait(0.5)
+
+        t_writer = threading.Thread(target=writer, daemon=True)
+        t_curve = threading.Thread(target=curve_loop, daemon=True)
+        results: list = []
+        res_lock = threading.Lock()
+        pos = {"i": 0}
+        rep_finals: dict = {}
+        try:
+            t_writer.start()
+            t_curve.start()
+            if t_ctrl is not None:
+                t_ctrl.start()
+            t0 = time.monotonic()
+            t0_box["t"] = t0
+
+            def client_worker() -> None:
+                c = PubSubClient(r_host, r_port, timeout_s=60)
+                while True:
+                    with res_lock:
+                        i = pos["i"]
+                        pos["i"] += 1
+                    if i >= len(schedule):
+                        break
+                    wait = t0 + schedule[i] - time.monotonic()
+                    if wait > 0:
+                        time.sleep(wait)
+                    submit = time.perf_counter()
+                    try:
+                        data = c.request(
+                            {"type": "reach", "campaigns": qsets[i],
+                             "op": "overlap" if i % 3 == 0 else "union",
+                             "id": f"as{int(on)}-{i}"}, timeout_s=30.0)
+                    except (TimeoutError, ConnectionError, OSError) as e:
+                        data = {"error": f"transport:{e!r}"}
+                    e2e_ms = (time.perf_counter() - submit) * 1000.0
+                    with res_lock:
+                        results.append((i, e2e_ms, data))
+                c.close()
+
+            workers = [threading.Thread(target=client_worker,
+                                        daemon=True)
+                       for _ in range(clients)]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join(timeout=duration_s + 90)
+            storm_s = time.monotonic() - t0
+            # post-ramp tail: traffic stops, the writer keeps folding —
+            # the ON arm's controller must go healthy and retire
+            if on:
+                deadline = time.monotonic() + tail_s
+                while time.monotonic() < deadline:
+                    if ctrl.actions.get("scale_down"):
+                        break
+                    time.sleep(0.2)
+        finally:
+            ctrl_stop.set()
+            if t_ctrl is not None:
+                t_ctrl.join(timeout=10)
+            curve_stop.set()
+            t_curve.join(timeout=10)
+            writer_stop.set()
+            t_writer.join(timeout=10)
+            if sampler is not None:
+                sampler.close(final={"autoscale": (ctrl.summary()
+                                                   if ctrl else None)})
+            for idx, rep in list(reps.items()):
+                if rep.server is not None:
+                    rep_finals[idx] = rep.server.summary()
+            router.close()
+            sup.stop(grace_s=2.0)
+            for rep in list(reps.values()):
+                rep.close()
+            store.close()
+
+        # -- per-arm verdict -------------------------------------------
+        answered = shed = breaches = 0
+        stale_breaches = lat_breaches = 0
+        lat: list = []
+        stales: list = []
+        for _, e2e_ms, data in results:
+            if data.get("shed"):
+                shed += 1
+                breaches += 1
+                continue
+            if data.get("error"):
+                breaches += 1
+                continue
+            answered += 1
+            lat.append(e2e_ms)
+            st = data.get("staleness_ms")
+            if isinstance(st, (int, float)):
+                stales.append(float(st))
+                if st > objective_staleness_ms:
+                    stale_breaches += 1
+                    breaches += 1
+            # reported, NOT in breach_ratio: on a 1-core host replica
+            # count cannot reduce burst latency (CPU timeslices — the
+            # scaling_claim_gated caveat), so the held objective is
+            # staleness; lat_breaches shows both arms suffer bursts
+            # alike, which is the caveat made visible in the artifact
+            if e2e_ms > objective_p99_ms:
+                lat_breaches += 1
+        stales.sort()
+        lat.sort()
+        rt = router.summary()
+        arm = {
+            "sent": len(results), "answered": answered, "shed": shed,
+            "breaches": breaches, "stale_breaches": stale_breaches,
+            "lat_breaches": lat_breaches,
+            "breach_ratio": (round(breaches / len(results), 4)
+                             if results else None),
+            "staleness_p50_ms": (round(stales[len(stales) // 2], 1)
+                                 if stales else None),
+            "staleness_p99_ms": (round(stales[min(
+                len(stales) - 1, int(len(stales) * 0.99))], 1)
+                if stales else None),
+            "e2e_p50_ms": (round(lat[len(lat) // 2], 2)
+                           if lat else None),
+            "e2e_p99_ms": (round(lat[min(len(lat) - 1,
+                                         int(len(lat) * 0.99))], 2)
+                           if lat else None),
+            "storm_s": round(storm_s, 2),
+            "router": {k: rt.get(k) for k in
+                       ("routed", "answered", "shed", "failovers",
+                        "shed_ratio", "failover_p99_ms",
+                        "e2e_p50_ms", "e2e_p99_ms", "qps")},
+            "ship_interval_final_ms": shipper.interval_ms,
+            "ships": shipper.ships,
+            "curve": curve,
+        }
+        if on:
+            sup_sum = sup.summary()
+            arm["controller"] = ctrl.summary()
+            arm["decisions"] = [
+                {k: d.get(k) for k in
+                 ("decision", "verdict", "knob", "replicas", "step",
+                  "from_ms", "to_ms", "evidence") if k in d}
+                for d in ctrl.decisions]
+            arm["replicas_max"] = len(sup.slots)
+            arm["retired"] = sup_sum["retired"]
+            arm["supervisor"] = {k: sup_sum[k] for k in
+                                 ("restarts", "kills", "gave_up",
+                                  "retired")}
+            # per-role journals for `obs fleet` + CI artifacts (the
+            # controller's own journal is live via its sampler)
+            rdir = os.path.join(fleet_dir, "router")
+            os.makedirs(rdir, exist_ok=True)
+            stamp = now_ms()
+            with open(os.path.join(rdir, "metrics.jsonl"), "w",
+                      encoding="utf-8") as f:
+                f.write(json.dumps({"kind": "final", "role": "router",
+                                    "pid": os.getpid(),
+                                    "ts_ms": stamp,
+                                    "router": rt}) + "\n")
+            for idx, ssum in rep_finals.items():
+                rep_dir = os.path.join(fleet_dir, f"replica_{idx}")
+                os.makedirs(rep_dir, exist_ok=True)
+                with open(os.path.join(rep_dir, "metrics.jsonl"), "w",
+                          encoding="utf-8") as f:
+                    f.write(json.dumps({"kind": "final",
+                                        "role": "replica",
+                                        "pid": 1000 + idx,
+                                        "ts_ms": stamp,
+                                        "reach_query": ssum}) + "\n")
+            tracer.dump(os.path.join(fleet_dir,
+                                     "trace_controller.json"),
+                        run="autoscale")
+        return arm
+
+    off = _arm(False)
+    on = _arm(True)
+
+    # replica finals were closed with their processes; journal the ON
+    # arm's controller decision log + router final (written in _arm) —
+    # the `obs fleet` table over fleet_dir is the CI assertion surface
+    out = {
+        "phase": phase, "seed": seed,
+        "duration_s": duration_s, "qps0": qps0, "qps1": qps1,
+        "ramp_x": round(qps1 / qps0, 1),
+        "schedule_n": len(schedule),
+        "objective": objective, "ship0_ms": ship0_ms,
+        "off": off, "on": on,
+        "breach_ratio_off": off["breach_ratio"],
+        "breach_ratio_on": on["breach_ratio"],
+        "decisions": on["controller"]["decisions"],
+        "fleet_dir": fleet_dir,
+    }
+    if (os.cpu_count() or 1) <= 1:
+        # REACH_r03 precedent: replica latency/qps gains timeslice on
+        # 1 core (measured: burst p99 identical at 1 vs 3 replicas) —
+        # the HELD objective is staleness (cadence actuation); the
+        # p99 breach still proves the scale-up path end to end, and
+        # lat_breaches lands in both arms to keep the gate visible
+        out["caveat"] = "scaling_claim_gated: 1-core host, replica " \
+                        "latency gains timeslice; held objective is " \
+                        "staleness via ship-cadence actuation, " \
+                        "scale-up path proven but not latency-credited"
+
+    # hard gates: the OFF arm must visibly breach, the ON arm must hold
+    assert off["breach_ratio"] is not None \
+        and off["breach_ratio"] >= 0.15, off
+    assert on["breach_ratio"] is not None \
+        and on["breach_ratio"] < 0.5 * off["breach_ratio"], \
+        (on["breach_ratio"], off["breach_ratio"])
+    ctrl_sum = on["controller"]
+    assert ctrl_sum["decisions"] >= 2, ctrl_sum
+    assert ctrl_sum["scale_ups"] >= 1, ctrl_sum
+    assert ctrl_sum["ship_tunes"] >= 1, ctrl_sum
+    assert on["retired"] >= 1, on["supervisor"]
+    assert on["replicas_max"] >= 2, on["replicas_max"]
+    for d in on["decisions"]:
+        ev = d.get("evidence") or {}
+        assert ev.get("hop_p99_ms"), d
+    for arm_d in (off, on):
+        assert arm_d["answered"] + arm_d["shed"] == arm_d["sent"], arm_d
+    out["ok"] = True
+    return out
+
+
+def _autoscale_compact(asc: dict) -> dict:
+    """The rung's <= 4096 B stdout headline (full detail in --out)."""
+    on, off = asc["on"], asc["off"]
+    return {
+        "phase": asc["phase"], "ok": asc.get("ok"),
+        "ramp_x": asc["ramp_x"], "schedule_n": asc["schedule_n"],
+        "objective": asc["objective"],
+        "breach_ratio_off": asc["breach_ratio_off"],
+        "breach_ratio_on": asc["breach_ratio_on"],
+        "decisions": asc["decisions"],
+        "controller": on["controller"],
+        "replicas_max": on["replicas_max"], "retired": on["retired"],
+        "off_router": off["router"], "on_router": on["router"],
+        "ship_ms": [asc["ship0_ms"], on["ship_interval_final_ms"]],
+        **({"caveat": asc["caveat"]} if "caveat" in asc else {}),
+    }
+
+
+# ----------------------------------------------------------------------
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
@@ -1512,6 +2054,15 @@ def main() -> int:
             f"shed == {fc['sent']} sent, "
             f"{fc['supervisor']['restarts']} restarts, failover p99 "
             f"{fc['router'].get('failover_p99_ms')} ms")
+        asc = run_autoscale(workdir, duration_s=8.0, tail_s=8.0,
+                            qps0=5.0, qps1=30.0)
+        doc["autoscale"] = asc
+        print(compact_line(_autoscale_compact(asc)), flush=True)
+        log(f"autoscale ok: breach ratio {asc['breach_ratio_off']} -> "
+            f"{asc['breach_ratio_on']} under a {asc['ramp_x']}x ramp, "
+            f"{asc['decisions']} decisions, "
+            f"{asc['on']['controller']['scale_ups']} scale-ups, "
+            f"{asc['on']['retired']} retired")
     elif time.monotonic() > deadline - 120:
         doc["large"] = {"skipped": "budget"}
         doc["storm"] = {"skipped": "budget"}
@@ -1595,6 +2146,20 @@ def main() -> int:
                 f"{fc['supervisor']['restarts']} restarts, failover "
                 f"p99 {fc['router'].get('failover_p99_ms')} ms, final "
                 f"record bit-identical to the fault-free arm")
+        # ---- ISSUE 17 SLO autopilot rung -----------------------------
+        if time.monotonic() > deadline - 70:
+            doc["autoscale"] = {"skipped": "budget"}
+            ok = False
+            log("budget exhausted before the autoscale rung — recorded")
+        else:
+            asc = run_autoscale(workdir)
+            doc["autoscale"] = asc
+            print(compact_line(_autoscale_compact(asc)), flush=True)
+            log(f"autoscale ok: breach ratio {asc['breach_ratio_off']} "
+                f"-> {asc['breach_ratio_on']} under a {asc['ramp_x']}x "
+                f"ramp, {asc['decisions']} decisions, "
+                f"{asc['on']['controller']['scale_ups']} scale-ups, "
+                f"{asc['on']['retired']} retired")
 
     # regress-gate keys (obs/regress.py normalize_bench reads doc.reach)
     storm_doc = doc.get("storm") or {}
@@ -1636,8 +2201,16 @@ def main() -> int:
         doc["reach"]["router"] = {
             "failover_p99_ms": frt.get("failover_p99_ms"),
             "shed_ratio": frt.get("shed_ratio")}
+    # ISSUE 17 regress keys (advisory): the controller-on arm's breach
+    # ratio (lower=better) and how many decisions the ramp took
+    asc_doc = doc.get("autoscale") or {}
+    if asc_doc.get("ok") and "reach" in doc:
+        doc["reach"]["autoscale"] = {
+            "breach_ratio_on": asc_doc["breach_ratio_on"],
+            "breach_ratio_off": asc_doc["breach_ratio_off"],
+            "decisions": asc_doc["decisions"]}
     phases = ["small", "storm", "shed", "attribution", "cache_ab",
-              "fleet_chaos"]
+              "fleet_chaos", "autoscale"]
     if not args.smoke:
         phases += ["large", "sharded", "replica_scaleout"]
     doc["ok"] = ok and all(

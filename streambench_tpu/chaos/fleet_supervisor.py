@@ -45,8 +45,8 @@ class ReplicaSlot:
     """One supervised replica seat: the live handle plus its ledger."""
 
     __slots__ = ("idx", "handle", "attempt", "restarts",
-                 "consecutive_young_deaths", "gave_up", "spawned_at",
-                 "restart_at", "exit_codes", "kills")
+                 "consecutive_young_deaths", "gave_up", "retired",
+                 "spawned_at", "restart_at", "exit_codes", "kills")
 
     def __init__(self, idx: int):
         self.idx = idx
@@ -55,6 +55,7 @@ class ReplicaSlot:
         self.restarts = 0
         self.consecutive_young_deaths = 0
         self.gave_up = False
+        self.retired = False           # graceful scale-down, no respawn
         self.spawned_at = 0.0          # monotonic
         self.restart_at: float | None = None  # backoff deadline
         self.exit_codes: list = []
@@ -65,6 +66,7 @@ class ReplicaSlot:
                 "pid": getattr(self.handle, "pid", None),
                 "attempt": self.attempt, "restarts": self.restarts,
                 "kills": self.kills, "gave_up": self.gave_up,
+                "retired": self.retired,
                 "exit_codes": list(self.exit_codes)}
 
 
@@ -88,7 +90,7 @@ class FleetSupervisor:
                  counters: FaultCounters | None = None,
                  sampler=None, flightrec=None,
                  sleep=time.sleep, clock=time.monotonic):
-        self.spawn = spawn
+        self.spawn_fn = spawn
         self.slots = [ReplicaSlot(i) for i in range(int(n))]
         self.backoff_base_ms = max(float(backoff_base_ms), 0.0)
         self.backoff_cap_ms = max(float(backoff_cap_ms),
@@ -127,9 +129,63 @@ class FleetSupervisor:
 
     def _spawn(self, slot: ReplicaSlot) -> None:
         slot.attempt += 1
-        slot.handle = self.spawn(slot.idx, slot.attempt)
+        slot.handle = self.spawn_fn(slot.idx, slot.attempt)
         slot.spawned_at = self._clock()
         slot.restart_at = None
+
+    # -- elastic surface (ISSUE 17): the autoscaler's grow/shrink ------
+    def spawn(self) -> int:
+        """Grow the fleet by one supervised slot and spawn it now.
+        Returns the new slot index (also its spawn-callable idx)."""
+        if self._stopping:
+            raise RuntimeError("supervisor is stopping")
+        slot = ReplicaSlot(len(self.slots))
+        self.slots.append(slot)
+        self._spawn(slot)
+        self.counters.inc("spawns")
+        self._annotate("replica_spawn", idx=slot.idx,
+                       pid=getattr(slot.handle, "pid", None))
+        return slot.idx
+
+    def retire(self, idx: int, *, deregister=None,
+               drain_s: float = 0.25, grace_s: float = 5.0) -> bool:
+        """Graceful scale-down: deregister -> drain -> stop.
+
+        ``deregister(idx)`` (e.g. ``router.remove_replica``) runs FIRST
+        so no new queries route here; then the replica drains in-flight
+        work for ``drain_s`` before SIGTERM (SIGKILL after ``grace_s``).
+        A retired slot is never respawned, and ``retires`` is counted
+        separately from crash ``kills`` — scale-down is an intended
+        state change, not a fault.  Returns False when the slot is
+        already retired/given-up."""
+        slot = self.slots[idx]
+        if slot.retired or slot.gave_up:
+            return False
+        if deregister is not None:
+            deregister(idx)
+        slot.retired = True   # before the stop: step() must not respawn
+        h = slot.handle
+        pid = getattr(h, "pid", None)
+        if h is not None and h.poll() is None:
+            if drain_s > 0:
+                self._sleep(drain_s)
+            try:
+                h.terminate()
+            except OSError:
+                pass
+            deadline = self._clock() + float(grace_s)
+            while h.poll() is None and self._clock() < deadline:
+                self._sleep(0.02)
+            if h.poll() is None:
+                try:
+                    h.kill()
+                except OSError:
+                    pass
+                h.poll()
+        self.counters.inc("retires")
+        self._annotate("replica_retire", idx=idx, pid=pid,
+                       drain_s=drain_s)
+        return True
 
     def alive(self, idx: int) -> bool:
         h = self.slots[idx].handle
@@ -159,7 +215,7 @@ class FleetSupervisor:
         now = self._clock() if now is None else now
         restarted = 0
         for slot in self.slots:
-            if slot.gave_up:
+            if slot.gave_up or slot.retired:
                 continue
             if slot.restart_at is None:
                 h = slot.handle
@@ -235,7 +291,12 @@ class FleetSupervisor:
         return {"replicas": [s.summary() for s in self.slots],
                 "restarts": sum(s.restarts for s in self.slots),
                 "kills": sum(s.kills for s in self.slots),
-                "gave_up": sum(1 for s in self.slots if s.gave_up)}
+                "gave_up": sum(1 for s in self.slots if s.gave_up),
+                "retired": sum(1 for s in self.slots if s.retired),
+                "active": sum(1 for s in self.slots
+                              if not s.retired and not s.gave_up
+                              and s.handle is not None
+                              and s.handle.poll() is None)}
 
 
 def cli_spawn(ship_path: str, workdir: str, *,

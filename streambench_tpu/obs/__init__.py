@@ -71,9 +71,14 @@ Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
     server = MetricsServer(registry, port=0, refresh=sampler.collect_now)
 """
 
+from streambench_tpu.obs.autoscale import AutoscaleController  # noqa: F401
 from streambench_tpu.obs.capture import (  # noqa: F401
     CaptureManager,
     profiler_window,
+)
+from streambench_tpu.obs.diagnose import (  # noqa: F401
+    diagnose,
+    evidence_window,
 )
 from streambench_tpu.obs.clock import (  # noqa: F401
     offset_from_samples,

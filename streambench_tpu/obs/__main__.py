@@ -104,6 +104,15 @@ def build_parser() -> argparse.ArgumentParser:
                           "(default: no file, table only)")
     flt.add_argument("--json", action="store_true",
                      help="emit the summary dict instead of text")
+    flt.add_argument("--watch", action="store_true",
+                     help="re-render periodically (re-scanning a "
+                          "directory spec, so replicas the autoscaler "
+                          "spawns appear as they journal)")
+    flt.add_argument("--interval-s", type=float, default=2.0,
+                     help="--watch re-render period (default 2 s)")
+    flt.add_argument("--iterations", type=int, default=0,
+                     help="with --watch: stop after N renders "
+                          "(0 = until interrupted; CI/tests bound it)")
     reg = sub.add_parser(
         "regress",
         help="compare candidate B against baseline A under per-metric "
@@ -203,21 +212,37 @@ def main(argv: list[str] | None = None) -> int:
                 summarize_fleet,
             )
 
-            if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
-                roles = discover_roles(args.paths[0])
-                if not roles:
-                    print(f"error: no metrics.jsonl under "
-                          f"{args.paths[0]}", file=sys.stderr)
-                    return 2
-            else:
-                roles = [parse_role_spec(p) for p in args.paths]
-            coll = FleetCollector(roles, out_path=args.out)
-            records = coll.collect()
-            s = summarize_fleet(records,
-                                path=args.out or args.paths[0])
-            s["sources"] = coll.sources
-            print(json.dumps(s) if args.json else render_fleet(s))
-            return 0
+            import time as _time
+
+            is_dir = (len(args.paths) == 1
+                      and os.path.isdir(args.paths[0]))
+            n = 0
+            while True:
+                # a directory spec re-scans each iteration: replicas
+                # spawned mid-watch appear as soon as they journal
+                if is_dir:
+                    roles = discover_roles(args.paths[0])
+                    if not roles and not args.watch:
+                        print(f"error: no metrics.jsonl under "
+                              f"{args.paths[0]}", file=sys.stderr)
+                        return 2
+                else:
+                    roles = [parse_role_spec(p) for p in args.paths]
+                coll = FleetCollector(roles, out_path=args.out)
+                records = coll.collect()
+                s = summarize_fleet(records,
+                                    path=args.out or args.paths[0])
+                s["sources"] = coll.sources
+                print(json.dumps(s) if args.json else render_fleet(s),
+                      flush=True)
+                n += 1
+                if not args.watch or (args.iterations
+                                      and n >= args.iterations):
+                    return 0
+                try:
+                    _time.sleep(args.interval_s)
+                except KeyboardInterrupt:
+                    return 0
         if args.cmd == "serve":
             a = summarize_serve(load_records(args.path),
                                 path=args.path)

@@ -126,6 +126,15 @@ def summarize_fleet(records: list[dict], path: str = "") -> dict:
             # process (ISSUE 16) — one restart column covers both roles
             if r.get("event") in ("restart", "replica_restart"):
                 agg["restarts"] += 1
+            # controller decisions (ISSUE 17): a journal that carries
+            # only the event stream (no summary snapshot yet) still
+            # renders a decision count + the last verdict/knob
+            if r.get("event") == "autoscale_decision":
+                a = agg.setdefault("autoscale", {})
+                a["decisions"] = a.get("decisions", 0) + 1
+                a["last"] = {k: r.get(k) for k in
+                             ("decision", "verdict", "knob",
+                              "replicas")}
             continue
         if kind not in ("snapshot", "final"):
             continue
@@ -171,6 +180,23 @@ def summarize_fleet(records: list[dict], path: str = "") -> dict:
                                if isinstance(h, dict)
                                and h.get("suspect")),
             }
+        # autoscale controller (ISSUE 17): the controller's sampler
+        # journals rec["autoscale"] = AutoscaleController.summary();
+        # the snapshot's counts override the event-derived ones (they
+        # are authoritative) and feed the controller sub-line
+        asc = r.get("autoscale")
+        if isinstance(asc, dict):
+            a = agg.setdefault("autoscale", {})
+            for k2 in ("replicas", "decisions", "scale_ups",
+                       "scale_downs", "ship_tunes", "poll_tunes",
+                       "holds", "shed_redirects"):
+                if asc.get(k2) is not None:
+                    a[k2] = asc[k2]
+            last = asc.get("last")
+            if isinstance(last, dict):
+                a["last"] = {k2: last.get(k2) for k2 in
+                             ("decision", "verdict", "knob",
+                              "replicas")}
         # chaos fault counters (ISSUE 16): any role may journal its
         # injector's snapshot under "faults"; the net_faults column is
         # the fleet-wide message-fault evidence next to restarts
@@ -233,6 +259,21 @@ def render_fleet(s: dict) -> str:
                 f"failover p99 {_fmt(rt.get('failover_p99_ms'))} ms  "
                 f"replicas {_fmt(rt.get('replicas'))} "
                 f"({_fmt(rt.get('suspect'))} suspect)")
+        asc = a.get("autoscale")
+        if asc:
+            last = asc.get("last") or {}
+            last_s = (f"{last.get('decision')}"
+                      f"[{last.get('verdict')}->{last.get('knob')}]"
+                      if last.get("decision") else "-")
+            lines.append(
+                f"    autoscale: replicas {_fmt(asc.get('replicas'))}  "
+                f"decisions {_fmt(asc.get('decisions'))} "
+                f"(up {_fmt(asc.get('scale_ups'))}, "
+                f"down {_fmt(asc.get('scale_downs'))}, "
+                f"ship {_fmt(asc.get('ship_tunes'))})  "
+                f"holds {_fmt(asc.get('holds'))}  "
+                f"redirects {_fmt(asc.get('shed_redirects'))}  "
+                f"last {last_s}")
         fr = a.get("freshness_p99_ms")
         if fr:
             hops = "  ".join(f"{hop} {_fmt(fr.get(hop))}"

@@ -99,6 +99,14 @@ DEFAULT_TOLERANCES: dict = {
     # land relative to the storm's pacing.
     "router_failover_p99_ms": ("lower", 2.0),
     "router_shed_ratio": ("lower", 2.0),
+    # SLO autopilot (ISSUE 17): the controller-on arm's breach fraction
+    # under the seeded QPS ramp regresses UP (the autopilot's whole job
+    # is keeping it low), the decision count regresses DOWN (a
+    # controller that stopped deciding stopped controlling).  Both
+    # advisory-by-tolerance: where the ramp's bursts land vs the
+    # 1-core wall clock moves both run to run.
+    "autoscale_breach_ratio_on": ("lower", 2.0),
+    "autoscale_decisions": ("higher", 0.75),
     # sliding A/B (ISSUE 12): both arms' catchup throughput regresses
     # DOWN; generous like every timing row on the 1-core host
     "sliding_evps": ("higher", 0.5),
@@ -220,6 +228,13 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
             out["router_failover_p99_ms"] = _num(
                 rt.get("failover_p99_ms"))
             out["router_shed_ratio"] = _num(rt.get("shed_ratio"))
+        # ISSUE 17 autopilot keys (bench_reach run_autoscale rung):
+        # controller-on breach fraction + decision count
+        asc = reach.get("autoscale")
+        if isinstance(asc, dict):
+            out["autoscale_breach_ratio_on"] = _num(
+                asc.get("breach_ratio_on"))
+            out["autoscale_decisions"] = _num(asc.get("decisions"))
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -56,6 +56,11 @@ DEFAULT_RETRIES = 1
 SUSPECT_AFTER = 2
 SUSPECT_COOLDOWN_S = 5.0
 
+#: summary() reports answered-query e2e percentiles over this many
+#: trailing seconds (the autoscaler's latency evidence must decay
+#: after a burst, or a past breach would read as a live one forever)
+E2E_WINDOW_S = 5.0
+
 
 def campaign_shard(campaigns, n: int) -> int:
     """Stable shard index for a campaign set: crc32 over the sorted,
@@ -200,6 +205,8 @@ class ReachRouter:
 
         if not replicas:
             raise ValueError("router needs at least one replica")
+        self.timeout_s = float(timeout_s)
+        self.retries = retries
         self.handles = [ReplicaHandle(a, timeout_s=timeout_s,
                                       retries=retries)
                         for a in replicas]
@@ -210,6 +217,14 @@ class ReachRouter:
         self.failovers = 0
         self._fail_ring: list = []          # failover episode ms
         self._fail_ring_max = 8192
+        # answered-query e2e latency, (monotonic, ms): the fleet's
+        # front-door latency — a single serialized replica handle shows
+        # up HERE, not in any replica's own submit->reply percentiles.
+        # Stamped so summary() reports a recent window, not all-time:
+        # the autoscaler must see a burst's pressure decay, not carry
+        # it forever (ISSUE 17)
+        self._e2e_ring: list = []
+        self._e2e_ring_max = 8192
         self._id_lock = threading.Lock()
         self._next = 0
         self._routed_t0: float | None = None
@@ -242,15 +257,45 @@ class ReachRouter:
     def _order(self, campaigns) -> list:
         """Sticky primary first, then the rest by freshness; suspects
         (primary included) demoted to the end, still freshness-
-        ordered — a down fleet is retried in best-evidence order."""
-        primary = self.handles[campaign_shard(campaigns,
-                                              len(self.handles))]
-        rest = sorted((h for h in self.handles if h is not primary),
+        ordered — a down fleet is retried in best-evidence order.
+        Snapshots ``self.handles`` once: add/remove_replica swap the
+        list atomically, so an in-flight query keeps a consistent
+        view."""
+        handles = self.handles
+        primary = handles[campaign_shard(campaigns, len(handles))]
+        rest = sorted((h for h in handles if h is not primary),
                       key=ReplicaHandle.freshness_key)
         order = [primary] + rest
         live = [h for h in order if not h.suspect()]
         dead = [h for h in order if h.suspect()]
         return live + dead
+
+    # -- elastic surface (ISSUE 17): the autoscaler's registry ---------
+    def add_replica(self, addr: str) -> ReplicaHandle:
+        """Register one more replica endpoint (scale-up).  The sticky
+        shard map re-spreads over the new count on the next query; the
+        copy-and-swap keeps in-flight `_order` snapshots consistent."""
+        h = ReplicaHandle(addr, timeout_s=self.timeout_s,
+                          retries=self.retries)
+        self.handles = self.handles + [h]
+        return h
+
+    def remove_replica(self, addr: str) -> bool:
+        """Deregister an endpoint (graceful retire): new queries stop
+        routing to it immediately; its connection is closed.  Refuses
+        to empty the fleet (the router's constructor invariant);
+        returns False for an unknown address."""
+        handles = self.handles
+        keep = [h for h in handles if h.addr != str(addr)]
+        if len(keep) == len(handles):
+            return False
+        if not keep:
+            raise ValueError("router needs at least one replica")
+        self.handles = keep
+        for h in handles:
+            if h.addr == str(addr):
+                h.close()
+        return True
 
     def _route_id(self) -> str:
         with self._id_lock:
@@ -322,6 +367,10 @@ class ReachRouter:
         self._safe_reply(reply, out)
         self.answered += 1
         self._routed_t1 = time.monotonic()
+        self._e2e_ring.append(
+            (self._routed_t1, (self._routed_t1 - t0) * 1000.0))
+        if len(self._e2e_ring) > self._e2e_ring_max:
+            del self._e2e_ring[0]
         if attempts > 1:
             self.failovers += 1
             if self._c_failover is not None:
@@ -364,6 +413,15 @@ class ReachRouter:
             out["failover_p50_ms"] = round(lats[len(lats) // 2], 2)
             out["failover_p99_ms"] = round(
                 lats[min(len(lats) - 1, int(len(lats) * 0.99))], 2)
+        cutoff = time.monotonic() - E2E_WINDOW_S
+        recent = sorted(ms for t, ms in list(self._e2e_ring)
+                        if t >= cutoff)
+        if recent:
+            out["e2e_recent_n"] = len(recent)
+            out["e2e_p50_ms"] = round(recent[len(recent) // 2], 2)
+            out["e2e_p99_ms"] = round(
+                recent[min(len(recent) - 1,
+                           int(len(recent) * 0.99))], 2)
         if (self._routed_t0 is not None and self._routed_t1 is not None
                 and self._routed_t1 > self._routed_t0 and self.routed):
             out["qps"] = round(

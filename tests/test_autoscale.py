@@ -1,0 +1,432 @@
+"""SLO autopilot (ISSUE 17): AutoscaleController safety rails, the
+graceful retire path, router scale-up/down plumbing, and the obs
+surfaces — all against injected clocks (no sockets, no sleeps).
+
+Contracts pinned here:
+
+- a priming step never actuates (history must not read as a breach);
+- hysteresis: a breach must persist ``breach_ticks`` consecutive
+  windows before any knob turns;
+- per-knob cooldowns: a confirmed breach inside a cooldown is a
+  ``hold``, never an actuation (flap refusal), and a cooling top
+  verdict falls through to the runner-up knob instead of starving it;
+- bounds: max_replicas / cadence floor are refusals counted on
+  ``at_limit``, not silent clamps that journal fake decisions;
+- scale-down only after a sustained healthy streak, via the
+  supervisor's graceful retire (deregister FIRST, terminate not kill,
+  counted separately from crash kills, never respawned);
+- every journaled decision carries freshness-hop p99 evidence and is
+  mirrored to sampler/flight-recorder/instruments;
+- default-off: a controller with no hooks wired actuates nothing
+  (the byte-identical pin for controller-off runs);
+- ``obs fleet`` renders the controller sub-line, and ``--watch
+  --iterations N`` renders exactly N reports.
+"""
+
+import json
+import os
+
+from streambench_tpu.chaos.fleet_supervisor import FleetSupervisor
+from streambench_tpu.obs import AutoscaleController, MetricsRegistry
+from streambench_tpu.obs.fleet import render_fleet, summarize_fleet
+
+OBJECTIVE = {"staleness_ms": 1000, "p99_ms": 100}
+
+
+def replica_rec(pid=1000, *, staleness_ms=100.0, p99_ms=5.0,
+                hops=None):
+    rq = {"staleness_ms": staleness_ms, "p99_ms": p99_ms, "qps": 10.0,
+          "served": 50, "shed": 0, "shed_stale": 0}
+    rq["freshness"] = {"hops": {h: {"p99": v} for h, v in
+                               (hops or {"serve": staleness_ms}).items()}}
+    return {"kind": "snapshot", "role": "replica", "pid": pid,
+            "ts_ms": 1_000, "reach_query": rq}
+
+
+def router_rec(**kw):
+    rt = {"routed": 100, "answered": 100, "shed": 0, "failovers": 0,
+          "replicas": [{}]}
+    rt.update(kw)
+    return {"kind": "snapshot", "role": "router", "pid": 2,
+            "ts_ms": 1_001, "router": rt}
+
+
+def stale_recs():
+    """Staleness breach, age in the serve hop -> fold_lag/ship knob."""
+    return [replica_rec(staleness_ms=1500,
+                        hops={"fold_lag": 5, "tail_lag": 60,
+                              "serve": 1400})]
+
+
+def hot_recs():
+    """Router front-door p99 breach -> serve/replica_count knob."""
+    return [replica_rec(staleness_ms=100, p99_ms=4),
+            router_rec(e2e_p99_ms=250.0)]
+
+
+def healthy_recs():
+    return [replica_rec(staleness_ms=100, p99_ms=4)]
+
+
+class _Shipper:
+    def __init__(self, interval_ms=2000):
+        self.interval_ms = interval_ms
+
+
+def _ctrl(collect, **kw):
+    clock = {"t": 0.0}
+    kw.setdefault("objective", OBJECTIVE)
+    ctrl = AutoscaleController(collect, clock=lambda: clock["t"],
+                               sleep=lambda s: None, **kw)
+    return ctrl, clock
+
+
+# ----------------------------------------------------------------------
+# controller safety rails
+
+
+def test_priming_step_never_actuates():
+    ship = _Shipper(2000)
+    ctrl, _ = _ctrl(stale_recs, shipper=ship,
+                    min_ship_interval_ms=500, breach_ticks=1)
+    assert ctrl.step() is None            # priming: record, don't act
+    assert ship.interval_ms == 2000 and not ctrl.decisions
+    dec = ctrl.step()                     # same breach, now confirmed
+    assert dec["decision"] == "ship_faster"
+    assert (dec["from_ms"], dec["to_ms"]) == (2000, 1000)
+
+
+def test_hysteresis_requires_consecutive_breach_windows():
+    ship = _Shipper(2000)
+    ctrl, _ = _ctrl(stale_recs, shipper=ship,
+                    min_ship_interval_ms=500, breach_ticks=3)
+    assert [ctrl.step() for _ in range(3)] == [None, None, None]
+    assert ctrl.step()["decision"] == "ship_faster"
+
+
+def test_cooldown_counts_holds_then_reacts_after_expiry():
+    ship = _Shipper(2000)
+    ctrl, clock = _ctrl(stale_recs, shipper=ship,
+                        min_ship_interval_ms=500, breach_ticks=1,
+                        cooldown_s=10.0)
+    ctrl.step()
+    assert ctrl.step()["decision"] == "ship_faster"
+    clock["t"] = 1.0
+    assert ctrl.step() is None and ctrl.holds == 1   # flap refused
+    assert ship.interval_ms == 1000
+    clock["t"] = 11.0
+    assert ctrl.step()["to_ms"] == 500
+
+
+def test_cooling_top_verdict_falls_through_to_runner_up():
+    ship = _Shipper(2000)
+    spawned = []
+    ctrl, _ = _ctrl(lambda: stale_recs()
+                    + [router_rec(e2e_p99_ms=250.0)],
+                    shipper=ship, min_ship_interval_ms=500,
+                    spawn_replica=lambda: spawned.append(1) or True,
+                    max_replicas=3, breach_ticks=1, cooldown_s=60.0)
+    ctrl.step()
+    first = ctrl.step()["decision"]
+    second = ctrl.step()["decision"]      # first knob cooling
+    assert {first, second} == {"ship_faster", "scale_up"}
+    assert spawned and ship.interval_ms == 1000
+    assert ctrl.step() is None and ctrl.holds == 1   # both cooling now
+
+
+def test_bounds_are_refusals_counted_on_at_limit():
+    ctrl, _ = _ctrl(hot_recs, spawn_replica=lambda: True,
+                    replicas=2, max_replicas=2, breach_ticks=1)
+    ctrl.step(), ctrl.step()
+    assert ctrl.replicas == 2 and not ctrl.decisions
+    assert ctrl.at_limit >= 1
+    ship = _Shipper(500)
+    ctrl2, _ = _ctrl(stale_recs, shipper=ship,
+                     min_ship_interval_ms=500, breach_ticks=1)
+    ctrl2.step(), ctrl2.step()
+    assert ship.interval_ms == 500 and ctrl2.at_limit >= 1
+
+
+def test_healthy_streak_retires_with_cooldown_between():
+    retired = []
+    ctrl, clock = _ctrl(healthy_recs, replicas=3, min_replicas=1,
+                        retire_replica=lambda: retired.append(1) or True,
+                        healthy_ticks=2, breach_ticks=1,
+                        cooldown_s=10.0)
+    ctrl.step()                                        # priming
+    assert ctrl.step() is None                         # streak 1
+    dec = ctrl.step()                                  # streak 2
+    assert dec["decision"] == "scale_down" and ctrl.replicas == 2
+    clock["t"] = 1.0
+    ctrl.step()                                        # streak 1 again
+    assert ctrl.step() is None and ctrl.holds == 1     # cooling
+    clock["t"] = 20.0
+    ctrl.step()
+    assert ctrl.replicas == 1 and len(retired) == 2
+    # at the floor: healthy forever, never retires below min_replicas
+    clock["t"] = 60.0
+    for _ in range(5):
+        assert ctrl.step() is None
+    assert ctrl.replicas == 1
+
+
+def test_retire_hook_refusal_keeps_the_count():
+    ctrl, _ = _ctrl(healthy_recs, replicas=2,
+                    retire_replica=lambda: False, healthy_ticks=1,
+                    breach_ticks=1)
+    ctrl.step(), ctrl.step()
+    assert ctrl.replicas == 2 and not ctrl.decisions
+
+
+def test_shed_redirects_ride_the_failover_counter():
+    fo = {"n": 0}
+    ctrl, _ = _ctrl(lambda: healthy_recs()
+                    + [router_rec(failovers=fo["n"])])
+    ctrl.step()
+    fo["n"] = 3
+    ctrl.step()
+    fo["n"] = 3
+    ctrl.step()
+    assert ctrl.shed_redirects == 3
+    assert ctrl.summary()["shed_redirects"] == 3
+
+
+def test_default_off_no_hooks_actuates_nothing():
+    ctrl, _ = _ctrl(lambda: stale_recs()
+                    + [router_rec(e2e_p99_ms=250.0)], breach_ticks=1)
+    for _ in range(6):
+        ctrl.step()
+    s = ctrl.summary()
+    assert s["decisions"] == 0 and s["replicas"] == 1
+    assert not ctrl.actions
+
+
+def test_decisions_journal_evidence_and_mirror_everywhere():
+    notes, frames = [], []
+
+    class _Sampler:
+        def annotate(self, event, **fields):
+            notes.append((event, fields))
+
+    class _Rec:
+        def record(self, cat, **fields):
+            frames.append((cat, fields))
+
+    reg = MetricsRegistry()
+    ctrl, _ = _ctrl(hot_recs, spawn_replica=lambda: True,
+                    breach_ticks=1, sampler=_Sampler(),
+                    flightrec=_Rec(), registry=reg)
+    ctrl.step()
+    dec = ctrl.step()
+    assert dec["decision"] == "scale_up"
+    assert dec["evidence"]["hop_p99_ms"]        # hop-backed, always
+    assert dec["why"]
+    assert notes[0][0] == "autoscale_decision"
+    assert notes[0][1]["evidence"]["hop_p99_ms"]
+    assert frames[0][0] == "autoscale"
+    names = {m.name for m in reg.collect()}
+    assert {"streambench_autoscale_decisions_total",
+            "streambench_autoscale_replicas_total",
+            "streambench_autoscale_shed_redirects_total"} <= names
+    dec_ctr = reg.counter("streambench_autoscale_decisions_total")
+    rep_g = reg.gauge("streambench_autoscale_replicas_total")
+    assert dec_ctr.value == 1 and rep_g.value == 2
+
+
+# ----------------------------------------------------------------------
+# supervisor graceful retire (vs crash kill)
+
+
+class _FakeProc:
+    def __init__(self, pid=4242):
+        self.pid = pid
+        self.code = None
+        self.terminated = False
+
+    def poll(self):
+        return self.code
+
+    def kill(self):
+        self.code = -9
+
+    def terminate(self):
+        self.terminated = True
+        self.code = 0
+
+
+def _fleet(n=2, **kw):
+    clock = {"t": 0.0}
+    procs = []
+
+    def spawn(idx, attempt):
+        p = _FakeProc(pid=5000 + idx)
+        procs.append(p)
+        return p
+
+    sup = FleetSupervisor(spawn, n, clock=lambda: clock["t"],
+                          sleep=lambda s: None, **kw).start()
+    return sup, clock, procs
+
+
+def test_retire_deregisters_first_terminates_and_never_respawns():
+    sup, clock, procs = _fleet(2)
+    order = []
+    assert sup.retire(1, deregister=lambda i: order.append(("dereg", i)),
+                      drain_s=0.0) is True
+    assert order == [("dereg", 1)]
+    assert procs[1].terminated and procs[1].code == 0   # SIGTERM, not -9
+    assert not sup.alive(1) and sup.alive(0)
+    assert sup.retire(1) is False                       # idempotent
+    clock["t"] = 60.0
+    assert sup.step() == 0                              # no respawn
+    s = sup.summary()
+    assert s["retired"] == 1 and s["active"] == 1
+    assert s["kills"] == 0 and s["restarts"] == 0
+    assert sup.counters.get("retires") == 1
+
+
+def test_retire_is_not_a_crash_but_kill_is():
+    sup, clock, procs = _fleet(2)
+    sup.kill(0)
+    assert procs[0].code == -9
+    sup.retire(1, drain_s=0.0)
+    s = sup.summary()
+    assert s["kills"] == 1 and s["retired"] == 1
+
+
+def test_spawn_grows_the_fleet():
+    sup, clock, procs = _fleet(1)
+    idx = sup.spawn()
+    assert idx == 1 and len(sup.slots) == 2 and sup.alive(1)
+    assert sup.counters.get("spawns") == 1
+
+
+# ----------------------------------------------------------------------
+# router scale plumbing + the e2e latency window
+
+
+def _router():
+    from streambench_tpu.reach.router import ReachRouter
+    return ReachRouter(["127.0.0.1:7101"], host="127.0.0.1", port=0)
+
+
+def test_router_add_remove_replica():
+    import pytest
+
+    r = _router()
+    r.add_replica("127.0.0.1:7102")
+    assert [h.addr for h in r.handles] == ["127.0.0.1:7101",
+                                           "127.0.0.1:7102"]
+    assert r.remove_replica("127.0.0.1:7101") is True
+    assert [h.addr for h in r.handles] == ["127.0.0.1:7102"]
+    assert r.remove_replica("127.0.0.1:9999") is False
+    with pytest.raises(ValueError):
+        r.remove_replica("127.0.0.1:7102")   # never empty the fleet
+
+
+def test_router_e2e_percentiles_use_a_recent_window():
+    import time as _t
+
+    from streambench_tpu.reach.router import E2E_WINDOW_S
+
+    r = _router()
+    now = _t.monotonic()
+    # an old burst (outside the window) must decay out of the summary,
+    # or a past breach reads as live forever and retire never fires
+    r._e2e_ring = [(now - E2E_WINDOW_S - 1.0, 500.0)] * 50 \
+        + [(now, 5.0)] * 10
+    s = r.summary()
+    assert s["e2e_recent_n"] == 10 and s["e2e_p99_ms"] == 5.0
+
+
+# ----------------------------------------------------------------------
+# e2e: controller + supervisor + fake procs, scale up then retire
+
+
+def test_controller_scales_fleet_up_then_retires_over_fake_procs():
+    sup, clock, procs = _fleet(1, healthy_after_s=0.0)
+    hot = {"on": True}
+
+    def collect():
+        return hot_recs() if hot["on"] else healthy_recs()
+
+    ctrl, cclock = _ctrl(
+        collect,
+        spawn_replica=lambda: sup.spawn() is not None,
+        retire_replica=lambda: sup.retire(len(sup.slots) - 1,
+                                          drain_s=0.0),
+        replicas=1, max_replicas=2, breach_ticks=2, healthy_ticks=2,
+        cooldown_s=1.0)
+    ctrl.step()                     # priming
+    ctrl.step()                     # breach streak 1
+    dec = ctrl.step()               # streak 2 -> scale_up
+    assert dec["decision"] == "scale_up"
+    assert len(sup.slots) == 2 and sup.alive(1)
+    hot["on"] = False               # ramp over: fleet goes healthy
+    cclock["t"] = 10.0
+    ctrl.step()
+    dec = ctrl.step()
+    assert dec["decision"] == "scale_down"
+    assert sup.summary()["retired"] == 1 and procs[1].terminated
+    assert ctrl.replicas == 1 and sup.alive(0)
+    assert sup.summary()["kills"] == 0
+
+
+# ----------------------------------------------------------------------
+# obs surfaces
+
+
+def test_fleet_report_renders_controller_sub_line():
+    ctrl, _ = _ctrl(hot_recs, spawn_replica=lambda: True,
+                    breach_ticks=1)
+    ctrl.step(), ctrl.step()
+    recs = healthy_recs() + [
+        {"kind": "snapshot", "role": "controller", "pid": 9,
+         "ts_ms": 2_000, "autoscale": ctrl.summary()}]
+    out = render_fleet(summarize_fleet(recs))
+    assert "autoscale: replicas 2" in out
+    assert "last scale_up[serve->replica_count]" in out
+
+
+def test_fleet_decision_events_alone_still_render():
+    recs = [{"kind": "event", "event": "autoscale_decision",
+             "ts_ms": 1, "decision": "ship_faster",
+             "verdict": "fold_lag", "knob": "ship_cadence",
+             "replicas": 1}]
+    s = summarize_fleet(recs)
+    row = next(a for a in s["roles"] if a.get("autoscale"))
+    assert row["autoscale"]["decisions"] == 1
+    assert "ship_faster[fold_lag->ship_cadence]" in render_fleet(s)
+
+
+def test_obs_fleet_watch_renders_bounded_iterations(tmp_path, capsys):
+    from streambench_tpu.obs.__main__ import main
+
+    d = tmp_path / "fleet" / "replica_0"
+    os.makedirs(d)
+    with open(d / "metrics.jsonl", "w") as f:
+        f.write(json.dumps(replica_rec()) + "\n")
+    rc = main(["fleet", str(tmp_path / "fleet"), "--watch",
+               "--interval-s", "0.01", "--iterations", "2"])
+    assert rc == 0
+    assert capsys.readouterr().out.count("fleet report:") == 2
+
+
+# ----------------------------------------------------------------------
+# the seeded QPS schedule (bench rung input)
+
+
+def test_qps_ramp_schedule_is_seed_deterministic():
+    import bench_reach
+
+    a = bench_reach.qps_ramp_schedule(seed=13, duration_s=10.0,
+                                      qps0=5.0, qps1=30.0)
+    b = bench_reach.qps_ramp_schedule(seed=13, duration_s=10.0,
+                                      qps0=5.0, qps1=30.0)
+    c = bench_reach.qps_ramp_schedule(seed=14, duration_s=10.0,
+                                      qps0=5.0, qps1=30.0)
+    assert a == b and a != c
+    assert a == sorted(a) and 0.0 <= a[0] and a[-1] <= 10.0
+    # the ramp actually ramps: the back half is denser than the front
+    front = sum(1 for t in a if t < 5.0)
+    assert len(a) - front > front

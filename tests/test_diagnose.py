@@ -1,0 +1,235 @@
+"""Fleet diagnosis engine (ISSUE 17): evidence folding + the verdict
+table.
+
+``diagnose`` is pure — (evidence window, objective) -> ranked verdicts
+— so every bottleneck family is pinned table-driven over synthetic
+fleet journals:
+
+- fold-bound: staleness breach whose age sits in the serve hop (the
+  REACH_r04 hop physics: slow ship cadence ages the record WHILE
+  serving) -> ``fold_lag`` / ship-cadence knob;
+- tail-bound: the tail_lag hop dominates the breached staleness ->
+  ``tail_lag`` / poll-interval knob;
+- serve-bound: overloaded sheds (or a router e2e p99 breach) without
+  contention evidence -> ``serve`` / replica-count knob;
+- contention-bound: p99 breach, queue segment dominant, measured
+  contention ratio >= 0.5 -> ``contention`` / batch-cadence knob;
+- healthy: nothing breached -> no knob.
+
+Counter semantics are differenced: a historic shed burst folded into
+``prev`` must NOT read as a live breach.
+"""
+
+import pytest
+
+from streambench_tpu.obs.diagnose import (
+    KNOB_BATCH,
+    KNOB_POLL,
+    KNOB_REPLICAS,
+    KNOB_SHIP,
+    VERDICT_CONTENTION,
+    VERDICT_FOLD,
+    VERDICT_HEALTHY,
+    VERDICT_SERVE,
+    VERDICT_TAIL,
+    diagnose,
+    evidence_window,
+)
+
+OBJECTIVE = {"staleness_ms": 1000, "p99_ms": 100}
+
+
+def replica_rec(pid=1000, *, staleness_ms=100.0, p99_ms=5.0, qps=10.0,
+                served=50, shed=0, shed_stale=0, queue_high_water=1,
+                hops=None, contention=None, segments=None,
+                kind="snapshot"):
+    rq = {"staleness_ms": staleness_ms, "p99_ms": p99_ms, "qps": qps,
+          "served": served, "shed": shed, "shed_stale": shed_stale,
+          "queue_high_water": queue_high_water}
+    if hops is not None:
+        rq["freshness"] = {"hops": {h: {"p99": v}
+                                    for h, v in hops.items()}}
+    if contention is not None or segments is not None:
+        rq["query_obs"] = {
+            "contention": {"ratio": contention},
+            "segments": {s: {"p99": v}
+                         for s, v in (segments or {}).items()}}
+    return {"kind": kind, "role": "replica", "pid": pid,
+            "ts_ms": 1_000, "reach_query": rq}
+
+
+def router_rec(**kw):
+    rt = {"routed": 100, "answered": 100, "shed": 0, "failovers": 0,
+          "replicas": [{}, {}]}
+    rt.update(kw)
+    return {"kind": "snapshot", "role": "router", "pid": 2,
+            "ts_ms": 1_001, "router": rt}
+
+
+def top(verdicts):
+    return verdicts[0]["verdict"], verdicts[0]["knob"]
+
+
+# ----------------------------------------------------------------------
+# evidence_window folding
+
+
+def test_window_gauges_max_counters_sum_across_replicas():
+    w = evidence_window([
+        replica_rec(1000, staleness_ms=200, p99_ms=3, qps=10,
+                    served=40, shed=2, hops={"tail_lag": 20}),
+        replica_rec(1001, staleness_ms=900, p99_ms=8, qps=5,
+                    served=10, shed=1, hops={"tail_lag": 80}),
+    ])
+    assert w["replicas"] == 2
+    assert w["staleness_ms"] == 900          # worst case
+    assert w["p99_ms"] == 8
+    assert w["qps"] == 15.0                  # total work
+    assert w["served"] == 50 and w["shed"] == 3
+    assert w["hop_p99_ms"]["tail_lag"] == 80
+
+
+def test_window_latest_snapshot_wins_per_role_pid():
+    w = evidence_window([
+        replica_rec(1000, served=10),
+        replica_rec(1000, served=25),        # later record, same pid
+    ])
+    assert w["served"] == 25
+
+
+def test_window_ignores_event_kinds_and_folds_router_ship_slo():
+    w = evidence_window([
+        {"kind": "event", "event": "whatever", "ts_ms": 5,
+         "reach_query": {"served": 999}},
+        replica_rec(shed=5, shed_stale=2),
+        router_rec(shed=3, failovers=7, e2e_p99_ms=140.0),
+        {"kind": "snapshot", "role": "writer", "pid": 3, "ts_ms": 6,
+         "reach_ship": {"ships": 4, "interval_ms": 400}},
+        {"kind": "snapshot", "role": "writer", "pid": 3, "ts_ms": 7,
+         "slo": {"burn": {"60000": 0.5, "300000": 1.5}}},
+    ])
+    assert w["served"] == 50                 # event record ignored
+    assert w["shed_overloaded"] == 3         # shed - shed_stale
+    assert w["router_shed"] == 3 and w["router_failovers"] == 7
+    assert w["router_replicas"] == 2
+    # the router's front-door e2e p99 feeds the window's p99: a
+    # serialized replica handle queues at the router, invisible to any
+    # replica's own submit->reply percentiles
+    assert w["p99_ms"] == 140.0
+    assert w["ships"] == 4 and w["ship_interval_ms"] == 400
+    assert w["slo_burn_max"] == 1.5
+
+
+# ----------------------------------------------------------------------
+# the verdict table
+
+
+def test_fold_bound_staleness_breach_without_tail_dominance():
+    # REACH_r04 hop physics: 2 s cadence ages the record while serving
+    # — the growth is in the serve hop, the prescription is still the
+    # ship cadence (the age accrued upstream of the tailer)
+    w = evidence_window([replica_rec(
+        staleness_ms=1500, hops={"fold_lag": 5, "ship_wait": 3,
+                                 "tail_lag": 90, "serve": 1400})])
+    v, k = top(diagnose(w, objective=OBJECTIVE))
+    assert (v, k) == (VERDICT_FOLD, KNOB_SHIP)
+
+
+def test_tail_bound_when_tail_hop_dominates():
+    w = evidence_window([replica_rec(
+        staleness_ms=1400, hops={"fold_lag": 30, "ship_wait": 20,
+                                 "tail_lag": 1200, "serve": 150})])
+    v, k = top(diagnose(w, objective=OBJECTIVE))
+    assert (v, k) == (VERDICT_TAIL, KNOB_POLL)
+
+
+def test_serve_bound_on_overloaded_sheds_without_staleness_breach():
+    w = evidence_window([replica_rec(staleness_ms=100, shed=12,
+                                     shed_stale=0)])
+    out = diagnose(w, objective=OBJECTIVE)
+    v, k = top(out)
+    assert (v, k) == (VERDICT_SERVE, KNOB_REPLICAS)
+    assert out[0]["evidence"]["shed_overloaded"] == 12
+
+
+def test_serve_bound_on_router_e2e_p99_breach():
+    w = evidence_window([replica_rec(staleness_ms=100, p99_ms=4),
+                         router_rec(e2e_p99_ms=250.0)])
+    v, k = top(diagnose(w, objective=OBJECTIVE))
+    assert (v, k) == (VERDICT_SERVE, KNOB_REPLICAS)
+
+
+def test_contention_bound_queue_dominant_with_measured_ratio():
+    w = evidence_window([replica_rec(
+        staleness_ms=100, p99_ms=180, contention=0.8,
+        segments={"queue": 150, "batch": 5, "dispatch": 20,
+                  "reply": 2})])
+    v, k = top(diagnose(w, objective=OBJECTIVE))
+    assert (v, k) == (VERDICT_CONTENTION, KNOB_BATCH)
+
+
+def test_low_contention_ratio_falls_back_to_serve():
+    w = evidence_window([replica_rec(
+        staleness_ms=100, p99_ms=180, contention=0.1,
+        segments={"queue": 150, "batch": 5, "dispatch": 20,
+                  "reply": 2})])
+    v, k = top(diagnose(w, objective=OBJECTIVE))
+    assert (v, k) == (VERDICT_SERVE, KNOB_REPLICAS)
+
+
+def test_healthy_when_nothing_breaches():
+    w = evidence_window([replica_rec(staleness_ms=100, p99_ms=4)])
+    out = diagnose(w, objective=OBJECTIVE)
+    assert len(out) == 1
+    assert top(out) == (VERDICT_HEALTHY, None)
+    assert out[0]["score"] == 0.0
+
+
+def test_dual_breach_ranks_both_verdicts():
+    w = evidence_window([replica_rec(
+        staleness_ms=2500, shed=8, shed_stale=0,
+        hops={"fold_lag": 5, "tail_lag": 50, "serve": 2400})])
+    out = diagnose(w, objective=OBJECTIVE)
+    names = [v["verdict"] for v in out]
+    assert VERDICT_FOLD in names and VERDICT_SERVE in names
+    assert out[0]["score"] >= out[-1]["score"]
+
+
+def test_prev_differencing_historic_sheds_do_not_breach():
+    cur = evidence_window([replica_rec(staleness_ms=100, shed=12,
+                                       shed_stale=0)])
+    prev = dict(cur)                        # same cumulative counters
+    out = diagnose(cur, objective=OBJECTIVE, prev=prev)
+    assert top(out) == (VERDICT_HEALTHY, None)
+    # ... while NEW sheds since prev still breach
+    newer = evidence_window([replica_rec(staleness_ms=100, shed=20,
+                                         shed_stale=0)])
+    v, k = top(diagnose(newer, objective=OBJECTIVE, prev=prev))
+    assert (v, k) == (VERDICT_SERVE, KNOB_REPLICAS)
+
+
+def test_every_verdict_carries_measured_evidence():
+    w = evidence_window([replica_rec(
+        staleness_ms=1500, shed=5, shed_stale=1,
+        hops={"fold_lag": 5, "tail_lag": 90, "serve": 1400})])
+    for v in diagnose(w, objective=OBJECTIVE):
+        ev = v["evidence"]
+        assert ev["hop_p99_ms"]            # non-empty hop decomposition
+        assert ev["objective"] == OBJECTIVE
+        assert v["why"]
+
+
+def test_partial_objective_only_checks_named_limits():
+    w = evidence_window([replica_rec(staleness_ms=5000,
+                                     hops={"serve": 4900})])
+    # no staleness limit in the objective -> no staleness verdict
+    out = diagnose(w, objective={"p99_ms": 100})
+    assert top(out) == (VERDICT_HEALTHY, None)
+
+
+@pytest.mark.parametrize("records", [[], [{"kind": "snapshot"}],
+                                     [{"not": "a fleet record"}]])
+def test_empty_or_foreign_windows_are_healthy(records):
+    w = evidence_window(records)
+    assert top(diagnose(w, objective=OBJECTIVE)) == (VERDICT_HEALTHY,
+                                                     None)
