@@ -1964,6 +1964,186 @@ def run_autoscale(workdir: str, *, seed: int = 13,
     return out
 
 
+def run_deltaship(workdir: str, *, seed: int = 29,
+                  ladder=(20_000, 200_000, 1_000_000),
+                  k: int = 8, registers: int = 8, ticks: int = 8,
+                  touches: int = 4000, cadence_ms: int = 150) -> dict:
+    """ISSUE 18: full vs delta shipping over the SAME Zipf touch
+    journal, per campaign-count rung.
+
+    The engine path is infeasible at C=1M on this host (``make_world``
+    would intern 10M ad-id strings), so the rung drives the shipper /
+    chain-tailer surface directly: a seeded per-tick journal of
+    Zipf-touched campaign rows is folded into writer planes (min/max —
+    the exact merge algebra), then each arm ships at a paced cadence
+    from its own store and a ChainTailer folds its log.  Measured per
+    arm, steady state only (both arms ship one bootstrap base BEFORE
+    the window — the delta arm is judged on its deltas, not amortized
+    bases): ship bytes/tick, gather wall ms/tick (p50/p99), staleness
+    at the matched cadence, and the tightest sustainable cadence
+    (= ship wall p99 — an interval shorter than one ship can't hold).
+    Exit checks: both tailer views bit-identical to the writer planes
+    and to each other."""
+    import hashlib
+    import shutil
+
+    from streambench_tpu.dimensions.store import (
+        LOG_NAME,
+        DurableDimensionStore,
+    )
+    from streambench_tpu.reach.deltaship import ChainTailer, DeltaShipper
+    from streambench_tpu.reach.replica import SnapshotShipper
+
+    EMPTY = np.uint32(0xFFFFFFFF)
+    out: dict = {"phase": "deltaship", "k": k, "registers": registers,
+                 "ticks": ticks, "touches": touches,
+                 "cadence_ms": cadence_ms, "ladder": {}, "ok": False}
+
+    def digest(mins, regs):
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(mins, np.uint32).tobytes())
+        h.update(np.ascontiguousarray(regs, np.int32).tobytes())
+        return h.hexdigest()
+
+    for C in ladder:
+        rng = np.random.default_rng(seed + C)
+        # per-tick touch journal, shared by both arms: Zipf-skewed row
+        # picks (hot campaigns dominate — the realistic dirty set) +
+        # the row values that fold into them
+        journal = []
+        for _ in range(ticks):
+            idx = np.unique((rng.zipf(1.3, touches) - 1) % C).astype(
+                np.int64)
+            journal.append((
+                idx,
+                rng.integers(0, 2**32, (idx.size, k), dtype=np.uint32),
+                rng.integers(0, 30, (idx.size, registers),
+                             dtype=np.int32)))
+        camps = [f"c{i:07d}" for i in range(C)]
+        rung: dict = {"C": C, "touched_rows_mean": round(
+            float(np.mean([j[0].size for j in journal])), 1)}
+        views = {}
+        for arm in ("full", "delta"):
+            d = os.path.join(workdir, f"deltaship_{arm}_{C}")
+            shutil.rmtree(d, ignore_errors=True)
+            store = DurableDimensionStore(d)
+            cls = DeltaShipper if arm == "delta" else SnapshotShipper
+            ship = cls(store, camps, interval_ms=1)
+            tail = ChainTailer(os.path.join(d, LOG_NAME))
+            mins = np.full((C, k), EMPTY, np.uint32)
+            regs = np.zeros((C, registers), np.int32)
+            # bootstrap base OUTSIDE the measured window (both arms):
+            # the delta arm's steady state is deltas between periodic
+            # bases, and ticks << base_every would otherwise smear one
+            # base across the mean
+            ship.note_state(mins, regs, 1, watermark=0)
+            tail.poll()
+            bytes_t, ms_t, rows_t, stale_t = [], [], [], []
+            wall0 = time.monotonic()
+            for t, (idx, mrows, rrows) in enumerate(journal):
+                sched = wall0 + (t + 1) * cadence_ms / 1000.0
+                # no force (a forced ship is a BASE under delta mode —
+                # the restart-path contract); the 2 ms floor keeps the
+                # interval_ms=1 cadence gate deterministically open
+                # even when an arm has fallen behind its schedule
+                time.sleep(max(sched - time.monotonic(), 0.002))
+                mins[idx] = np.minimum(mins[idx], mrows)
+                regs[idx] = np.maximum(regs[idx], rrows)
+                shipped = ship.note_state(
+                    mins, regs, 1, watermark=t + 1,
+                    dirty_rows=(idx if arm == "delta" else None))
+                assert shipped
+                tail.poll()
+                # staleness the matched cadence actually delivers: how
+                # far behind the tick's schedule the tailer's folded
+                # view landed (a ship slower than the cadence pushes
+                # every later tick further behind)
+                stale_t.append((time.monotonic() - sched) * 1e3)
+                bytes_t.append(ship.bytes_last)
+                rows_t.append(ship.rows_last)
+                ms_t.append(ship.ship_ms_last)
+            view = tail.poll() or tail._view
+            views[arm] = digest(view["mins"], view["registers"])
+            ms_sorted = sorted(ms_t)
+            p99 = ms_sorted[min(len(ms_sorted) - 1,
+                                int(0.99 * len(ms_sorted)))]
+            rung[arm] = {
+                "bytes_per_tick": int(np.mean(bytes_t)),
+                "rows_per_tick_mean": round(float(np.mean(rows_t)), 1),
+                "ship_ms_p50": round(ms_sorted[len(ms_sorted) // 2], 3),
+                "ship_ms_p99": round(p99, 3),
+                "sustainable_cadence_ms": round(p99, 3),
+                "staleness_p99_ms": round(sorted(stale_t)[
+                    min(len(stale_t) - 1, int(0.99 * len(stale_t)))], 1),
+                "log_bytes": os.path.getsize(os.path.join(d, LOG_NAME)),
+                "ships": ship.ships,
+                "bases": getattr(ship, "bases", ship.ships),
+                "deltas": getattr(ship, "deltas", 0),
+                "tailer": tail.stats(),
+            }
+            assert views[arm] == digest(mins, regs), \
+                f"{arm} tailer view != writer planes at C={C}"
+            store.close()
+            shutil.rmtree(d, ignore_errors=True)
+        # ISSUE 18's wire-format claim, checked per rung: the delta
+        # arm must ship a FRACTION of the full arm's bytes while its
+        # tailer lands on the bit-identical planes
+        rung["bit_identical"] = views["full"] == views["delta"]
+        rung["bytes_ratio"] = round(
+            rung["full"]["bytes_per_tick"]
+            / max(rung["delta"]["bytes_per_tick"], 1), 1)
+        out["ladder"][f"c{C}"] = rung
+        log(f"deltaship C={C}: bytes/tick {rung['full']['bytes_per_tick']}"
+            f" -> {rung['delta']['bytes_per_tick']} "
+            f"({rung['bytes_ratio']}x), ship p99 "
+            f"{rung['full']['ship_ms_p99']} -> "
+            f"{rung['delta']['ship_ms_p99']} ms, bit_identical "
+            f"{rung['bit_identical']}")
+        assert rung["bit_identical"], f"arm divergence at C={C}"
+        assert rung["delta"]["deltas"] == ticks, rung["delta"]
+        if C >= 500_000:
+            # the acceptance rung: >= 10x fewer bytes, strictly
+            # tighter sustainable cadence, no staleness giveback
+            assert rung["bytes_ratio"] >= 10.0, rung["bytes_ratio"]
+            assert (rung["delta"]["sustainable_cadence_ms"]
+                    < rung["full"]["sustainable_cadence_ms"]), rung
+            assert (rung["delta"]["staleness_p99_ms"]
+                    <= rung["full"]["staleness_p99_ms"]), rung
+    # regress keys come from the SMALLEST rung — present in smoke and
+    # full artifacts alike, so CI's advisory compare is like-for-like
+    first = out["ladder"][f"c{ladder[0]}"]
+    out["ship_bytes_per_tick"] = first["delta"]["bytes_per_tick"]
+    out["ship_ms_per_tick"] = first["delta"]["ship_ms_p99"]
+    out["bytes_ratio"] = first["bytes_ratio"]
+    out["ok"] = True
+    return out
+
+
+def _deltaship_compact(ds: dict) -> dict:
+    """The rung's <= 4096 B stdout headline (full detail in --out)."""
+    return {
+        "phase": ds["phase"], "ok": ds.get("ok"),
+        "cadence_ms": ds.get("cadence_ms"),
+        "rungs": {
+            name: {
+                "bytes_ratio": r.get("bytes_ratio"),
+                "bit_identical": r.get("bit_identical"),
+                "full_bytes": (r.get("full") or {}).get("bytes_per_tick"),
+                "delta_bytes": (r.get("delta") or {}).get(
+                    "bytes_per_tick"),
+                "full_ship_p99_ms": (r.get("full") or {}).get(
+                    "ship_ms_p99"),
+                "delta_ship_p99_ms": (r.get("delta") or {}).get(
+                    "ship_ms_p99"),
+                "full_stale_p99_ms": (r.get("full") or {}).get(
+                    "staleness_p99_ms"),
+                "delta_stale_p99_ms": (r.get("delta") or {}).get(
+                    "staleness_p99_ms"),
+            } for name, r in (ds.get("ladder") or {}).items()},
+        **({"skipped": ds["skipped"]} if "skipped" in ds else {}),
+    }
+
+
 def _autoscale_compact(asc: dict) -> dict:
     """The rung's <= 4096 B stdout headline (full detail in --out)."""
     on, off = asc["on"], asc["off"]
@@ -2063,6 +2243,14 @@ def main() -> int:
             f"{asc['decisions']} decisions, "
             f"{asc['on']['controller']['scale_ups']} scale-ups, "
             f"{asc['on']['retired']} retired")
+        # ISSUE 18 delta-ship rung, smallest ladder step only: the
+        # regress keys come from this rung in full mode too, so the
+        # smoke artifact stays comparable against REACH_r07
+        ds = run_deltaship(workdir, ladder=(20_000,))
+        doc["deltaship"] = ds
+        print(compact_line(_deltaship_compact(ds)), flush=True)
+        log(f"deltaship ok: {ds['bytes_ratio']}x fewer bytes/tick at "
+            f"C=20k, bit-identical replica planes")
     elif time.monotonic() > deadline - 120:
         doc["large"] = {"skipped": "budget"}
         doc["storm"] = {"skipped": "budget"}
@@ -2160,6 +2348,20 @@ def main() -> int:
                 f"ramp, {asc['decisions']} decisions, "
                 f"{asc['on']['controller']['scale_ups']} scale-ups, "
                 f"{asc['on']['retired']} retired")
+        # ---- ISSUE 18 delta-ship C-ladder rung -----------------------
+        if time.monotonic() > deadline - 45:
+            doc["deltaship"] = {"skipped": "budget"}
+            ok = False
+            log("budget exhausted before the delta-ship rung — recorded")
+        else:
+            ds = run_deltaship(workdir)
+            doc["deltaship"] = ds
+            print(compact_line(_deltaship_compact(ds)), flush=True)
+            top = ds["ladder"]["c1000000"]
+            log(f"deltaship ok: {top['bytes_ratio']}x fewer bytes/tick "
+                f"at C=1M (ship p99 {top['full']['ship_ms_p99']} -> "
+                f"{top['delta']['ship_ms_p99']} ms), bit-identical "
+                f"replica planes at every rung")
 
     # regress-gate keys (obs/regress.py normalize_bench reads doc.reach)
     storm_doc = doc.get("storm") or {}
@@ -2209,8 +2411,17 @@ def main() -> int:
             "breach_ratio_on": asc_doc["breach_ratio_on"],
             "breach_ratio_off": asc_doc["breach_ratio_off"],
             "decisions": asc_doc["decisions"]}
+    # ISSUE 18 regress keys (advisory): delta-arm ship bytes + wall ms
+    # per tick at the smallest rung (smoke-comparable) + the full/delta
+    # bytes ratio — obs/regress reads doc.reach.deltaship
+    ds_doc = doc.get("deltaship") or {}
+    if ds_doc.get("ok") and "reach" in doc:
+        doc["reach"]["deltaship"] = {
+            "ship_bytes_per_tick": ds_doc["ship_bytes_per_tick"],
+            "ship_ms_per_tick": ds_doc["ship_ms_per_tick"],
+            "bytes_ratio": ds_doc["bytes_ratio"]}
     phases = ["small", "storm", "shed", "attribution", "cache_ab",
-              "fleet_chaos", "autoscale"]
+              "fleet_chaos", "autoscale", "deltaship"]
     if not args.smoke:
         phases += ["large", "sharded", "replica_scaleout"]
     doc["ok"] = ok and all(

@@ -273,6 +273,11 @@ class BenchmarkConfig:
     #   (python -m streambench_tpu.reach.replica --ship <dir>)
     jax_reach_ship_interval_ms: int = 1000  # replica shipping cadence:
     #   the replica staleness bound is cadence + poll when healthy
+    jax_reach_ship_delta: str = "off"      # O(ΔC) dirty-row delta
+    #   shipping (reach/deltaship; ISSUE 18): "on" ships chain-stamped
+    #   delta records between periodic full bases, "auto" enables it
+    #   at >= 4096 campaigns (below that the full gather is trivially
+    #   cheap), "off" keeps the full-plane path
     # --- query-path observability (obs/queryattr; ISSUE 11 — the
     # serving-tier mirror of jax.obs.lifecycle; default-off: reply
     # payloads stay byte-identical) ---
@@ -368,6 +373,11 @@ class BenchmarkConfig:
             raise ConfigError(
                 f"config key 'jax.decode.device' must be one of "
                 f"off/on/auto: {decode_mode!r}")
+        ship_delta = gets("jax.reach.ship.delta", "off").strip().lower()
+        if ship_delta not in ("off", "on", "auto"):
+            raise ConfigError(
+                f"config key 'jax.reach.ship.delta' must be one of "
+                f"off/on/auto: {ship_delta!r}")
         sliced_mode = gets("jax.sliding.sliced", "auto").strip().lower()
         if sliced_mode not in ("off", "on", "auto"):
             raise ConfigError(
@@ -489,6 +499,7 @@ class BenchmarkConfig:
             jax_reach_ship_dir=gets("jax.reach.ship.dir", ""),
             jax_reach_ship_interval_ms=max(
                 geti("jax.reach.ship.interval.ms", 1000), 1),
+            jax_reach_ship_delta=ship_delta,
             jax_obs_query=getb("jax.obs.query", False),
             jax_obs_fleet=getb("jax.obs.fleet", False),
             jax_obs_query_slowlog=max(

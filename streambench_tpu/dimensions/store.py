@@ -50,6 +50,15 @@ class DurableDimensionStore:
         # {"mins": [C,k] uint32, "registers": [C,R] int32,
         #  "campaigns": [...], "epoch": int, "_updated": ms} or None
         self._reach: dict | None = None
+        # delta-ship chain bookkeeping (ISSUE 18): the newest intact
+        # base record (raw parsed dict), the delta records folded on
+        # top of it in order, and the seq of the last chained record
+        # (None = no chain / chain broken — deltas are dropped until
+        # the next base).  compact() dumps base + chain verbatim so a
+        # mid-chain compaction never orphans deltas.
+        self._reach_base: dict | None = None
+        self._reach_chain: list[dict] = []
+        self._reach_seq: int | None = None
         # chaos hook (ISSUE 16): when set, every put_reach_sketches
         # line passes through ``hook(line) -> (data, intact)`` before
         # hitting the file — the ship-log fault surface.  None (the
@@ -83,7 +92,8 @@ class DurableDimensionStore:
                            watermark: int | None = None,
                            folded_ms: int | None = None,
                            submit_ms: int | None = None,
-                           origin: dict | None = None) -> None:
+                           origin: dict | None = None,
+                           seq: int | None = None) -> int:
         """Materialize the reach sketch planes (reach/; ISSUE 10) as one
         durable log record, so a reopened store can serve audience
         queries without re-folding the journal.  Latest record wins on
@@ -100,7 +110,13 @@ class DurableDimensionStore:
         ``sm`` on the wire — the writer-side hop boundaries of the
         freshness ledger), and ``origin`` names the writer's pub/sub
         endpoint + pid so replicas can ping it for the clock-offset
-        estimate (obs/clock.py)."""
+        estimate (obs/clock.py).
+
+        ``seq`` (ISSUE 18) is the delta-ship chain stamp: a base
+        record carrying one restarts the chain — subsequent
+        ``reach_delta`` records link off it via ``ps``.  Legacy
+        full-ship callers omit it.  Returns the encoded record size in
+        bytes (pre-fault-hook — what the writer produced)."""
         stamp = now_ms() if update_time_ms is None else update_time_ms
         mins = np.ascontiguousarray(mins, dtype=np.uint32)
         regs = np.ascontiguousarray(registers, dtype=np.int32)
@@ -117,7 +133,10 @@ class DurableDimensionStore:
             rec["sm"] = int(submit_ms)
         if origin is not None:
             rec["origin"] = dict(origin)
+        if seq is not None:
+            rec["seq"] = int(seq)
         data = json.dumps(rec, separators=(",", ":")) + "\n"
+        nbytes = len(data)
         intact = True
         hook = self.ship_fault_hook
         if hook is not None:
@@ -132,6 +151,55 @@ class DurableDimensionStore:
         os.fsync(self._f.fileno())
         if intact:
             self._absorb_reach(rec)
+        return nbytes
+
+    def put_reach_delta(self, row_idx: np.ndarray, rows: dict, *,
+                        epoch: int, seq: int, prev_seq: int,
+                        update_time_ms: int | None = None,
+                        watermark: int | None = None,
+                        folded_ms: int | None = None,
+                        submit_ms: int | None = None,
+                        origin: dict | None = None) -> int:
+        """Append one chain-stamped dirty-row delta record (ISSUE 18):
+        only the rows in ``row_idx`` of each plane, linked to the
+        previous ship via ``ps=prev_seq``.  ``rows`` maps wire plane
+        names (``mins`` / ``regs``) to ``[n, width]`` arrays.  Goes
+        through the same ship-fault hook as bases (PR 16's torn/
+        corrupt faults land on delta records too).  Returns the
+        encoded record size in bytes (pre-hook)."""
+        stamp = now_ms() if update_time_ms is None else update_time_ms
+        idx = np.ascontiguousarray(np.asarray(row_idx).ravel(),
+                                   dtype=np.int32)
+        mins = np.ascontiguousarray(rows["mins"], dtype=np.uint32)
+        regs = np.ascontiguousarray(rows["regs"], dtype=np.int32)
+        rec = {"kind": "reach_delta", "t": stamp, "epoch": int(epoch),
+               "seq": int(seq), "ps": int(prev_seq),
+               "k": int(mins.shape[1]) if mins.ndim == 2 else 0,
+               "r": int(regs.shape[1]) if regs.ndim == 2 else 0,
+               "idx": base64.b64encode(idx.tobytes()).decode(),
+               "mins": base64.b64encode(mins.tobytes()).decode(),
+               "regs": base64.b64encode(regs.tobytes()).decode()}
+        if watermark is not None:
+            rec["wm"] = int(watermark)
+        if folded_ms is not None:
+            rec["fm"] = int(folded_ms)
+        if submit_ms is not None:
+            rec["sm"] = int(submit_ms)
+        if origin is not None:
+            rec["origin"] = dict(origin)
+        data = json.dumps(rec, separators=(",", ":")) + "\n"
+        nbytes = len(data)
+        intact = True
+        hook = self.ship_fault_hook
+        if hook is not None:
+            data, intact = hook(data)
+        if data:
+            self._f.write(data)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        if intact:
+            self._absorb_reach_delta(rec)
+        return nbytes
 
     def _absorb_reach(self, rec: dict) -> None:
         try:
@@ -152,6 +220,42 @@ class DurableDimensionStore:
                        "folded_ms": rec.get("fm"),
                        "submit_ms": rec.get("sm"),
                        "origin": rec.get("origin")}
+        # every intact base restarts the delta chain (ISSUE 18); a
+        # legacy base without seq still loads but nothing chains off it
+        self._reach_base = rec
+        self._reach_chain = []
+        self._reach_seq = rec.get("seq")
+
+    def _absorb_reach_delta(self, rec: dict) -> None:
+        """Fold one intact delta record into the materialized view iff
+        it chains off the last absorbed record; otherwise mark the
+        chain broken so later deltas are dropped until the next base
+        (the store's view must never be half-folded)."""
+        if self._reach is None or self._reach_seq is None:
+            return
+        from streambench_tpu.reach.deltaship import (
+            decode_delta_record, merge_rows)
+        d = decode_delta_record(rec)
+        if d is None:
+            self._reach_seq = None
+            return
+        C = len(self._reach["campaigns"])
+        if (d["epoch"] != self._reach["epoch"]
+                or d["ps"] != self._reach_seq
+                or (d["idx"].size and (int(d["idx"].min()) < 0
+                                       or int(d["idx"].max()) >= C))):
+            self._reach_seq = None
+            return
+        merge_rows(self._reach, d["idx"], d["rows"])
+        if d["watermark"] is not None:
+            self._reach["watermark"] = int(d["watermark"])
+        self._reach["_updated"] = d["shipped_ms"]
+        self._reach["folded_ms"] = d["folded_ms"]
+        self._reach["submit_ms"] = d["submit_ms"]
+        if d["origin"] is not None:
+            self._reach["origin"] = d["origin"]
+        self._reach_chain.append(rec)
+        self._reach_seq = d["seq"]
 
     def reach_sketches(self) -> dict | None:
         """Latest materialized reach-sketch record (or None)."""
@@ -187,12 +291,24 @@ class DurableDimensionStore:
                 if rec.get("kind") == "reach_sketch":
                     self._absorb_reach(rec)
                     continue
+                if rec.get("kind") == "reach_delta":
+                    # must precede the (k, b) index fallback: delta
+                    # records carry "k" (plane width) but no "b"
+                    self._absorb_reach_delta(rec)
+                    continue
                 self.index[(rec["k"], rec["b"])] = {
                     **rec["a"], "_updated": rec["t"]}
                 self.latency.record(rec["k"], rec["b"], rec["t"])
 
     def compact(self) -> None:
-        """Rewrite the log with only each (key, bucket)'s latest record."""
+        """Rewrite the log with only each (key, bucket)'s latest record.
+
+        Reach records keep the newest base PLUS its subsequent delta
+        chain verbatim (ISSUE 18): "keep latest record" would orphan
+        the deltas folded on top of the base — a tailer replaying the
+        compacted log must land on the exact same folded view (seq
+        stamps and freshness fields included), so the raw records are
+        preserved, not re-synthesized from the folded planes."""
         tmp = self.path + ".compact"
         with open(tmp, "w", encoding="utf-8") as f:
             for (key, bucket), val in self.index.items():
@@ -200,26 +316,12 @@ class DurableDimensionStore:
                 rec = {"k": key, "b": bucket, "t": val["_updated"],
                        "a": aggs}
                 f.write(json.dumps(rec, separators=(",", ":")) + "\n")
-            if self._reach is not None:
-                r = self._reach
-                rec = {"kind": "reach_sketch", "t": r["_updated"],
-                       "epoch": r["epoch"], "wm": r.get("watermark", 0),
-                       "c": r["campaigns"],
-                       "k": int(r["mins"].shape[1]),
-                       "r": int(r["registers"].shape[1]),
-                       "mins": base64.b64encode(
-                           r["mins"].tobytes()).decode(),
-                       "regs": base64.b64encode(
-                           r["registers"].tobytes()).decode()}
-                # freshness stamps survive compaction (a replica
-                # tailing a just-compacted log keeps its hop evidence)
-                if r.get("folded_ms") is not None:
-                    rec["fm"] = int(r["folded_ms"])
-                if r.get("submit_ms") is not None:
-                    rec["sm"] = int(r["submit_ms"])
-                if r.get("origin") is not None:
-                    rec["origin"] = dict(r["origin"])
-                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            if self._reach_base is not None:
+                f.write(json.dumps(self._reach_base,
+                                   separators=(",", ":")) + "\n")
+                for rec in self._reach_chain:
+                    f.write(json.dumps(rec,
+                                       separators=(",", ":")) + "\n")
             f.flush()
             os.fsync(f.fileno())
         self._f.close()

@@ -416,6 +416,10 @@ def main(argv: list[str] | None = None) -> int:
             from streambench_tpu.dimensions.store import (
                 DurableDimensionStore,
             )
+            from streambench_tpu.reach.deltaship import (
+                DELTA_AUTO_MIN_CAMPAIGNS,
+                DeltaShipper,
+            )
             from streambench_tpu.reach.replica import SnapshotShipper
 
             reach_store = DurableDimensionStore(cfg.jax_reach_ship_dir)
@@ -424,7 +428,16 @@ def main(argv: list[str] | None = None) -> int:
             # replicas can ping it for the clock-offset estimate and
             # the merged fleet view can attribute the record
             s_host, s_port = reach_ps.address
-            reach_ship = SnapshotShipper(
+            # delta shipping (ISSUE 18): O(ΔC) dirty-row records
+            # between periodic bases; "auto" turns it on where the
+            # full gather actually hurts (large campaign counts)
+            dmode = cfg.jax_reach_ship_delta
+            use_delta = (dmode == "on"
+                         or (dmode == "auto"
+                             and engine.encoder.num_campaigns
+                             >= DELTA_AUTO_MIN_CAMPAIGNS))
+            ship_cls = DeltaShipper if use_delta else SnapshotShipper
+            reach_ship = ship_cls(
                 reach_store, list(engine.encoder.campaigns),
                 interval_ms=cfg.jax_reach_ship_interval_ms,
                 registry=registry,
@@ -436,9 +449,14 @@ def main(argv: list[str] | None = None) -> int:
             # picture (segments/contention with query obs on, and the
             # ISSUE 14 cache/epoch/staleness block always) under
             # "reach_query" — the block `obs report/diff` renders;
-            # summary() also refreshes the replica gauges each tick
-            def _reach_query_collect(rec, dt_s, srv=reach_srv):
+            # summary() also refreshes the replica gauges each tick;
+            # "ship" (ISSUE 18) is the writer's per-tick ship cost —
+            # what `obs fleet` renders in the ship column
+            def _reach_query_collect(rec, dt_s, srv=reach_srv,
+                                     sh=reach_ship):
                 rec["reach_query"] = srv.summary()
+                if sh is not None:
+                    rec["ship"] = sh.summary()
 
             sampler.add_collector(_reach_query_collect)
         r_host, r_port = reach_ps.address
@@ -447,7 +465,8 @@ def main(argv: list[str] | None = None) -> int:
                  if reach_cache is not None else "")
         if reach_ship is not None:
             extra += (f" ship={cfg.jax_reach_ship_dir}"
-                      f"@{cfg.jax_reach_ship_interval_ms}ms")
+                      f"@{cfg.jax_reach_ship_interval_ms}ms"
+                      f"/{reach_ship.mode}")
         print(f"reach: pubsub={r_host}:{r_port} "
               f"queue_depth={cfg.jax_reach_queue_depth} k={engine.k} "
               f"registers={engine.registers}{qobs}{extra}", flush=True)

@@ -296,6 +296,46 @@ class ReachSketchEngine(_SketchEngineBase):
         # stamped unconditionally; it only reaches the wire when
         # jax.obs.fleet is on.
         self._fold_wall_ms: int | None = None
+        # Dirty-campaign tracking (ISSUE 18): a host-side [C] bool mask
+        # unioned per fold from the already-encoded campaign columns —
+        # zero device cost, O(batch) host work — consumed by a delta
+        # shipper (wants_dirty=True) to gather only the touched rows.
+        # None until such a shipper attaches, so non-delta runs pay one
+        # None check per fold.  (The PR 9 shard-hist trick is where a
+        # device-side dirty-mask variant could ride later.)
+        self._dirty_mask: np.ndarray | None = None
+        self._join_np: np.ndarray | None = None
+
+    # -- dirty-row tracking (ISSUE 18) ---------------------------------
+    def _mark_dirty(self, ad_idx, valid=None) -> None:
+        """Union this fold's touched campaigns into the dirty mask.
+        Marking a superset (e.g. rows a later predicate zeroes out) is
+        always sound — a clean row shipped early is idempotent under
+        the min/max merge algebra."""
+        m = self._dirty_mask
+        if m is None:
+            return
+        ad = np.asarray(ad_idx).ravel()
+        if valid is not None:
+            v = np.asarray(valid).ravel().astype(bool)
+            if v.size == ad.size:
+                ad = ad[v]
+        ad = ad[(ad >= 0) & (ad < self._join_np.size)]
+        camp = self._join_np[ad]
+        camp = camp[(camp >= 0) & (camp < m.size)]
+        m[camp] = True
+
+    def _mark_dirty_packed(self, packed) -> None:
+        if self._dirty_mask is None:
+            return
+        from streambench_tpu.ops.windowcount import (
+            PACK_AD_BITS,
+            PACK_AD_MAX,
+        )
+
+        w = np.asarray(packed).ravel().astype(np.int64)
+        valid = (w >> (PACK_AD_BITS + 2)) & 1
+        self._mark_dirty(w & (PACK_AD_MAX - 1), valid)
 
     def _device_step(self, batch) -> None:
         self.state = minhash.step(
@@ -304,6 +344,8 @@ class ReachSketchEngine(_SketchEngineBase):
             jnp.asarray(batch.event_type), jnp.asarray(batch.event_time),
             jnp.asarray(batch.valid))
         self._fold_wall_ms = now_ms()
+        if self._dirty_mask is not None:
+            self._mark_dirty(batch.ad_idx, batch.valid)
 
     def _device_scan(self, ad_idx, user_idx, event_type, event_time,
                      valid) -> None:
@@ -311,11 +353,15 @@ class ReachSketchEngine(_SketchEngineBase):
             self.state, self.join_table, ad_idx, user_idx, event_type,
             event_time, valid)
         self._fold_wall_ms = now_ms()
+        if self._dirty_mask is not None:
+            self._mark_dirty(ad_idx, valid)
 
     def _device_scan_packed(self, packed, user_idx, event_time) -> None:
         self.state = minhash.scan_steps_packed(
             self.state, self.join_table, packed, user_idx, event_time)
         self._fold_wall_ms = now_ms()
+        if self._dirty_mask is not None:
+            self._mark_dirty_packed(packed)
 
     def warmup(self) -> None:
         """Base warmup + the close-time estimate program:
@@ -359,7 +405,21 @@ class ReachSketchEngine(_SketchEngineBase):
         the next cadence tick (the ISSUE 15 restart-path fix — the
         close-time forced ship's twin)."""
         self._reach_shipper = shipper
+        if getattr(shipper, "wants_dirty", False):
+            # delta shipping (ISSUE 18): host-side dirty-campaign mask
+            # + a host copy of the join table (ad -> campaign) so the
+            # per-fold union never touches the device
+            self._dirty_mask = np.zeros(self.encoder.num_campaigns,
+                                        dtype=bool)
+            self._join_np = np.asarray(self.join_table)
         self._reach_push(force_ship=True)
+
+    def planes(self) -> dict:
+        """The plane-generic shipping surface (ISSUE 18 / ROADMAP item
+        2): named state planes whose rows merge elementwise — what a
+        DeltaShipper's ``note_planes`` consumes."""
+        return {"mins": self.state.mins,
+                "registers": self.state.registers}
 
     def _reach_push(self, force_ship: bool = False) -> None:
         if self._reach_server is not None:
@@ -370,10 +430,17 @@ class ReachSketchEngine(_SketchEngineBase):
         if sh is not None and (force_ship or sh.due(self.reach_epoch)):
             # the due() pre-check keeps the watermark pull (a device
             # sync) off the not-yet-due flushes
-            sh.note_state(self.state.mins, self.state.registers,
-                          self.reach_epoch, int(self.state.watermark),
-                          force=force_ship,
-                          folded_ms=self._fold_wall_ms)
+            dirty = (np.flatnonzero(self._dirty_mask)
+                     if self._dirty_mask is not None else None)
+            shipped = sh.note_state(
+                self.state.mins, self.state.registers,
+                self.reach_epoch, int(self.state.watermark),
+                force=force_ship, folded_ms=self._fold_wall_ms,
+                dirty_rows=dirty)
+            if shipped and self._dirty_mask is not None:
+                # rows shipped (in a delta or covered by a base) are
+                # clean until the next fold touches them
+                self._dirty_mask[:] = False
 
     def _fleet_stamps(self) -> dict | None:
         """Writer-attached freshness stamps (``jax.obs.fleet``): the

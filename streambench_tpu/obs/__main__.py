@@ -31,6 +31,18 @@ import json
 import os
 import sys
 
+try:
+    import streambench_tpu  # noqa: F401
+except ModuleNotFoundError:
+    # executed by file path (python .../streambench_tpu/obs/__main__.py)
+    # from a cwd where the package isn't importable: python put obs/ on
+    # sys.path, not the repo root — self-locate it.  (`python -m` from
+    # a foreign cwd without an install still needs PYTHONPATH or the
+    # `streambench-obs` entry point — the interpreter fails before any
+    # package code runs.)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
 from streambench_tpu.obs.report import (
     load_records,
     render_attribution,

@@ -197,6 +197,17 @@ def summarize_fleet(records: list[dict], path: str = "") -> dict:
                 a["last"] = {k2: last.get(k2) for k2 in
                              ("decision", "verdict", "knob",
                               "replicas")}
+        # shipper cost (ISSUE 18): the writer's sampler journals
+        # rec["ship"] = SnapshotShipper/DeltaShipper.summary() — the
+        # per-tick bytes/rows/ms evidence the delta path is judged by,
+        # rendered as a ship sub-line under the writer row
+        sp = r.get("ship")
+        if isinstance(sp, dict):
+            agg["ship"] = {k2: sp.get(k2) for k2 in
+                           ("mode", "ships", "bytes_per_tick",
+                            "rows_per_tick", "ship_ms_per_tick",
+                            "bytes_total", "bases", "deltas",
+                            "cutovers")}
         # chaos fault counters (ISSUE 16): any role may journal its
         # injector's snapshot under "faults"; the net_faults column is
         # the fleet-wide message-fault evidence next to restarts
@@ -274,6 +285,19 @@ def render_fleet(s: dict) -> str:
                 f"holds {_fmt(asc.get('holds'))}  "
                 f"redirects {_fmt(asc.get('shed_redirects'))}  "
                 f"last {last_s}")
+        sp = a.get("ship")
+        if sp:
+            chain = ""
+            if sp.get("deltas") is not None:
+                chain = (f"  bases {_fmt(sp.get('bases'))}  "
+                         f"deltas {_fmt(sp.get('deltas'))}  "
+                         f"cutovers {_fmt(sp.get('cutovers'))}")
+            lines.append(
+                f"    ship[{sp.get('mode') or 'full'}]: "
+                f"ships {_fmt(sp.get('ships'))}  "
+                f"bytes/tick {_fmt(sp.get('bytes_per_tick'))}  "
+                f"rows/tick {_fmt(sp.get('rows_per_tick'))}  "
+                f"ms/tick {_fmt(sp.get('ship_ms_per_tick'))}{chain}")
         fr = a.get("freshness_p99_ms")
         if fr:
             hops = "  ".join(f"{hop} {_fmt(fr.get(hop))}"

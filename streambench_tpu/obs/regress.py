@@ -121,6 +121,14 @@ DEFAULT_TOLERANCES: dict = {
     "sketch_p99_err": ("lower", 0.5),
     "sketch_salsa_evps": ("higher", 0.5),
     "sketch_fixed_evps": ("higher", 0.5),
+    # delta shipping (ISSUE 18): writer-side ship cost per cadence
+    # tick.  bytes/tick is near-deterministic for a fixed journal
+    # (encoded size of the touched rows: tight-ish); ship wall ms is
+    # 1-core wall timing (generous); the full/delta bytes ratio is the
+    # headline O(C)->O(ΔC) claim and regresses DOWN.
+    "ship_bytes_per_tick": ("lower", 0.5),
+    "ship_ms_per_tick": ("lower", 2.0),
+    "ship_bytes_ratio": ("higher", 0.5),
 }
 
 
@@ -235,6 +243,14 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
             out["autoscale_breach_ratio_on"] = _num(
                 asc.get("breach_ratio_on"))
             out["autoscale_decisions"] = _num(asc.get("decisions"))
+        # ISSUE 18 delta-ship keys (bench_reach run_deltaship rung):
+        # the delta arm's per-tick ship cost + the full/delta ratio
+        ds = reach.get("deltaship")
+        if isinstance(ds, dict):
+            out["ship_bytes_per_tick"] = _num(
+                ds.get("ship_bytes_per_tick"))
+            out["ship_ms_per_tick"] = _num(ds.get("ship_ms_per_tick"))
+            out["ship_bytes_ratio"] = _num(ds.get("bytes_ratio"))
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -277,6 +277,10 @@ class ShardedReachEngine(ReachSketchEngine):
         self.state = minhash.ReachState(mins, regs, wm,
                                         self.state.dropped)
         self._fold_wall_ms = now_ms()
+        if self._dirty_mask is not None:
+            # dirty union from the UNPADDED columns (ISSUE 18): pad
+            # rows are invalid by construction and must not mark
+            self._mark_dirty(batch.ad_idx, batch.valid)
 
     def _device_scan(self, ad_idx, user_idx, event_type, event_time,
                      valid) -> None:
@@ -289,6 +293,8 @@ class ShardedReachEngine(ReachSketchEngine):
         self.state = minhash.ReachState(mins, regs, wm,
                                         self.state.dropped)
         self._fold_wall_ms = now_ms()
+        if self._dirty_mask is not None:
+            self._mark_dirty(ad_idx, valid)
 
     def _device_scan_packed(self, packed, user_idx, event_time) -> None:
         fn = _build_reach_scan(self.mesh, packed=True)
@@ -300,6 +306,8 @@ class ShardedReachEngine(ReachSketchEngine):
         self.state = minhash.ReachState(mins, regs, wm,
                                         self.state.dropped)
         self._fold_wall_ms = now_ms()
+        if self._dirty_mask is not None:
+            self._mark_dirty_packed(packed)
 
     # -- queries next to the shards ------------------------------------
     def query_callable(self):

@@ -91,7 +91,17 @@ class SnapshotShipper:
     cadence).  Attached via ``ReachSketchEngine.attach_shipper``; the
     engine calls :meth:`note_state` from its flush-cadence push path,
     so the writer is never blocked by readers — shipping is one host
-    gather + one appended line, and only at the cadence."""
+    gather + one appended line, and only at the cadence.
+
+    This is the FULL-plane path — O(C) gather + bytes per tick.  The
+    O(ΔC) dirty-row path (ISSUE 18) is :class:`~streambench_tpu.reach.
+    deltaship.DeltaShipper`, a drop-in subclass selected by
+    ``jax.reach.ship.delta``."""
+
+    #: engines enable host-side dirty-row tracking for shippers that
+    #: declare this (deltaship.DeltaShipper overrides to True)
+    wants_dirty = False
+    mode = "full"
 
     def __init__(self, store, campaigns: list[str],
                  interval_ms: int = 1000, registry=None,
@@ -102,16 +112,35 @@ class SnapshotShipper:
         self.ships = 0
         self._last_ship = 0.0      # monotonic
         self._last_epoch: int | None = None
+        # per-tick ship cost evidence (ISSUE 18): what the gather +
+        # encode actually cost, per record and cumulative — the obs
+        # surface the delta path is judged against
+        self.bytes_last = 0
+        self.rows_last = 0
+        self.ship_ms_last = 0.0
+        self.bytes_total = 0
+        self.rows_total = 0
+        self.ship_ms_total = 0.0
         # fleet origin metadata (ISSUE 15): the writer's pub/sub
         # endpoint + pid, stamped into every shipped record so a
         # replica can (a) ping it for the clock-offset estimate and
         # (b) attribute the record in the merged fleet view
         self.origin = dict(origin) if origin else None
         self._g_ships = None
+        self._g_bytes = self._g_rows = self._g_ms = None
         if registry is not None:
             self._g_ships = registry.counter(
                 "streambench_reach_ship_total",
                 "reach snapshot records shipped to the replica log")
+            self._g_bytes = registry.gauge(
+                "streambench_ship_bytes_per_tick",
+                "encoded bytes of the last shipped record")
+            self._g_rows = registry.gauge(
+                "streambench_ship_rows_per_tick",
+                "plane rows carried by the last shipped record")
+            self._g_ms = registry.gauge(
+                "streambench_ship_ms_per_tick",
+                "wall ms of the last ship (gather + encode + append)")
 
     def due(self, epoch: int) -> bool:
         """Would a ship happen now?  (The engine checks this BEFORE
@@ -123,7 +152,8 @@ class SnapshotShipper:
 
     def note_state(self, mins, registers, epoch: int,
                    watermark: int = 0, force: bool = False,
-                   folded_ms: int | None = None) -> bool:
+                   folded_ms: int | None = None,
+                   dirty_rows=None) -> bool:
         """Maybe ship; returns True when a record was written.
         ``force`` bypasses the cadence — the writer's close-time ship
         AND the restart-path ship (engine restore / shipper re-attach
@@ -132,29 +162,58 @@ class SnapshotShipper:
 
         ``folded_ms``: wall stamp of the last fold into these planes
         (the engine's ``_fold_wall_ms``) — the fold-anchored end of the
-        freshness ledger; the ship-submit stamp is taken here."""
+        freshness ledger; the ship-submit stamp is taken here.
+
+        ``dirty_rows`` (ISSUE 18): the rows touched since the last
+        ship.  Ignored here — the full-plane path always ships all of
+        C; the DeltaShipper subclass is the consumer."""
         now = time.monotonic()
         epoch = int(epoch)
         if (not force and self._last_epoch == epoch
                 and (now - self._last_ship) * 1000.0 < self.interval_ms):
             return False
+        t0 = time.perf_counter()
         submit_ms = now_ms()
-        self.store.put_reach_sketches(
-            np.asarray(mins), np.asarray(registers), self.campaigns,
+        mins = np.asarray(mins)
+        nbytes = self.store.put_reach_sketches(
+            mins, np.asarray(registers), self.campaigns,
             epoch, watermark=int(watermark),
             folded_ms=(int(folded_ms) if folded_ms is not None
                        else submit_ms),
             submit_ms=submit_ms, origin=self.origin)
+        self._mark_shipped(now, epoch, int(nbytes or 0),
+                           int(mins.shape[0]),
+                           (time.perf_counter() - t0) * 1e3)
+        return True
+
+    def _mark_shipped(self, now: float, epoch: int, nbytes: int,
+                      rows_n: int, ship_ms: float) -> None:
+        """One record hit the log: advance the cadence gate and the
+        per-tick cost evidence (counters + gauges)."""
         self._last_ship = now
         self._last_epoch = epoch
         self.ships += 1
+        self.bytes_last, self.rows_last = nbytes, rows_n
+        self.ship_ms_last = ship_ms
+        self.bytes_total += nbytes
+        self.rows_total += rows_n
+        self.ship_ms_total += ship_ms
         if self._g_ships is not None:
             self._g_ships.inc()
-        return True
+        if self._g_bytes is not None:
+            self._g_bytes.set(nbytes)
+            self._g_rows.set(rows_n)
+            self._g_ms.set(ship_ms)
 
     def summary(self) -> dict:
         return {"ships": self.ships, "interval_ms": self.interval_ms,
-                "epoch": self._last_epoch}
+                "epoch": self._last_epoch, "mode": self.mode,
+                "bytes_per_tick": self.bytes_last,
+                "rows_per_tick": self.rows_last,
+                "ship_ms_per_tick": round(self.ship_ms_last, 3),
+                "bytes_total": self.bytes_total,
+                "rows_total": self.rows_total,
+                "ship_ms_total": round(self.ship_ms_total, 3)}
 
 
 class ShipLogTailer:
@@ -216,12 +275,19 @@ class ReachReplica:
         from streambench_tpu.dimensions.pubsub import PubSubServer
         from streambench_tpu.obs import MetricsRegistry
 
+        # lazy: deltaship imports this module (SnapshotShipper)
+        from streambench_tpu.reach.deltaship import ChainTailer
+
         self.ship_path = ship_path
         self.poll_ms = max(int(poll_ms), 1)
         self.max_staleness_ms = int(max_staleness_ms)
         self.registry = registry if registry is not None \
             else MetricsRegistry()
-        self._tailer = ShipLogTailer(ship_path)
+        # delta-aware chain tailer (ISSUE 18): folds dirty-row delta
+        # records between bases, resyncs from the newest base on any
+        # gap/damage; over a base-only (full-ship) log it behaves
+        # exactly like the legacy ShipLogTailer
+        self._tailer = ChainTailer(ship_path)
         self._depth = depth
         self._batch = batch
         self._cache_capacity = int(cache_capacity)
@@ -328,8 +394,11 @@ class ReachReplica:
                     queryattr=self._queryattr, spans=self._spans,
                     flightrec=self._flightrec)
             prev = self.server.epoch
+            # jnp.array (copy=True): the chain tailer owns and mutates
+            # its folded plane arrays across polls — the served planes
+            # must never alias them
             self.server.update_state(
-                jnp.asarray(rec["mins"]), jnp.asarray(rec["registers"]),
+                jnp.array(rec["mins"]), jnp.array(rec["registers"]),
                 rec["epoch"], shipped_ms=rec["shipped_ms"],
                 freshness=self._freshness(rec, now_ms()))
             self.plane_loads += 1
@@ -365,6 +434,9 @@ class ReachReplica:
             "plane_loads": self.plane_loads,
             "epoch_loads": self.epoch_loads,
             "shed_before_load": self.shed_before_load,
+            # chain-tailer evidence (ISSUE 18): bases/deltas applied,
+            # gaps + damaged records survived, resyncs taken
+            "tailer": self._tailer.stats(),
         }
         if self.fleet:
             out["fleet"] = True

@@ -246,6 +246,52 @@ def test_store_replay_compact_and_torn_tail(tmp_path):
     assert st3.get("c4", 30_000)["clicks:SUM"] == 2
 
 
+def test_compact_mid_delta_chain_keeps_base_and_chain(tmp_path):
+    """ISSUE 18: compaction fired mid-delta-chain must keep the newest
+    base AND every subsequent delta verbatim — rewriting the base alone
+    would orphan the chain for any tailer re-reading the log."""
+    import time
+
+    from streambench_tpu.reach.deltaship import ChainTailer, DeltaShipper
+
+    d = str(tmp_path / "store")
+    store = DurableDimensionStore(d)
+    ship = DeltaShipper(store, ["c0", "c1", "c2"], interval_ms=1,
+                        base_every=100)
+    rng = np.random.default_rng(31)
+    mins = np.full((3, 4), 0xFFFFFFFF, np.uint32)
+    regs = np.zeros((3, 4), np.int32)
+    for t in range(4):          # base + 3 deltas
+        i = rng.integers(0, 3)
+        mins[i] = np.minimum(mins[i], rng.integers(
+            0, 2**32, 4, dtype=np.uint32))
+        regs[i] = np.maximum(regs[i], rng.integers(
+            0, 30, 4, dtype=np.int32))
+        assert ship.note_state(mins, regs, 1, watermark=t,
+                               dirty_rows=np.array([i]))
+        time.sleep(0.002)
+    store.compact()
+    log = os.path.join(d, "dimensions.log")
+    kinds = [json.loads(ln)["kind"] for ln in open(log)
+             if "reach" in ln]
+    assert kinds == ["reach_sketch"] + ["reach_delta"] * 3
+    # the compacted log replays to the same folded view...
+    store.close()
+    re = DurableDimensionStore(d)
+    rv = re.reach_sketches()
+    assert np.array_equal(rv["mins"], mins)
+    assert np.array_equal(rv["registers"], regs)
+    assert rv["watermark"] == 3
+    # ...and a fresh tailer folds the preserved chain bit-identically
+    tail = ChainTailer(log)
+    view = tail.poll()
+    assert np.array_equal(view["mins"], mins)
+    assert np.array_equal(view["registers"], regs)
+    st = tail.stats()
+    assert st["bases_loaded"] == 1 and st["deltas_folded"] == 3
+    re.close()
+
+
 # ----------------------------------------------------------------- pubsub
 def test_pubsub_subscribe_publish_unsubscribe():
     srv = PubSubServer().start()
