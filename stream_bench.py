@@ -114,6 +114,13 @@ OBS_QUERY = os.environ.get("OBS_QUERY", "") not in ("", "0", "false", "no")
 # forwards to replicas (--fleet) so replies decompose their age into
 # fold_lag/ship_wait/tail_lag/serve hops.
 OBS_FLEET = os.environ.get("OBS_FLEET", "") not in ("", "0", "false", "no")
+# Multi-tenant host (engine/tenants, obs layer 9): TENANTS="a:exact,
+# b:session,c:reach" runs N topologies in one process with tenant=
+# metric namespaces + the device-time blame matrix; ADMISSION=1 arms
+# the measurement-actuated admission controller (defer/shed the
+# aggressor tenant when its dispatches burn a victim's SLO budget).
+TENANTS = os.environ.get("TENANTS", "")
+ADMISSION = os.environ.get("ADMISSION", "") not in ("", "0", "false", "no")
 
 PID_DIR = os.path.join(WORKDIR, "pids")
 LOG_DIR = os.path.join(WORKDIR, "logs")
@@ -326,6 +333,8 @@ def op_setup() -> None:
         "jax.obs.capture.oneshot": OBS_CAPTURE,
         "jax.obs.query": OBS_QUERY,
         "jax.obs.fleet": OBS_FLEET,
+        "jax.tenants": TENANTS,
+        "jax.admission.enabled": ADMISSION,
     })
     log(f"wrote {CONF_FILE}")
     try:
@@ -490,12 +499,14 @@ def op_start_jax_processing() -> None:
     # Wait until the engine has pre-compiled and printed its ready marker,
     # so a following START_LOAD measures the stream, not XLA compilation.
     # Only look at log bytes written by THIS instance (the log appends).
+    # The multi-tenant host prints "tenants up:" instead of "engine up:".
     deadline = time.monotonic() + 300
     while time.monotonic() < deadline:
         try:
             with open(logpath) as f:
                 f.seek(log_start)
-                if "engine up:" in f.read():
+                txt = f.read()
+                if "engine up:" in txt or "tenants up:" in txt:
                     return
         except FileNotFoundError:
             pass

@@ -305,6 +305,27 @@ class BenchmarkConfig:
     #   fold_lag/ship_wait/tail_lag/serve hops summing to staleness_ms,
     #   with the writer clock offset estimated over the pub/sub ping
     #   verb and never applied past the jitter threshold)
+    # --- multi-tenant host + admission control (engine/tenants +
+    # obs/tenancy + obs/admission; ISSUE 19 — default-off: without
+    # jax.tenants the single-engine path is byte-identical) ---
+    jax_tenants: str = ""                  # "name:kind,..." tenant spec
+    #   (kinds: exact/hll/sliding/session/reach/hllx).  Non-empty runs
+    #   the MultiTenantHost: every tenant gets its own engine + a
+    #   tenant= labeled view over one shared registry, and the
+    #   DeviceTimeLedger attributes device time per tenant
+    jax_admission_enabled: bool = False    # measurement-actuated
+    #   admission control: defer/shed an aggressor tenant's ingest
+    #   when the blame matrix says its dispatches burn a victim
+    #   tenant's SLO budget (priming + hysteresis + cooldowns;
+    #   decisions journaled with evidence)
+    jax_admission_breach_ticks: int = 2    # consecutive breaching
+    #   controller steps before a gate goes up (hysteresis)
+    jax_admission_healthy_ticks: int = 4   # consecutive healthy steps
+    #   before every gate is released
+    jax_admission_escalate_ticks: int = 6  # defer-gate steps without
+    #   recovery before escalating defer -> shed
+    jax_admission_cooldown_s: float = 3.0  # min seconds between gate
+    #   changes (breaches inside it count as holds, never actions)
 
     raw: Mapping[str, Any] = dataclasses.field(default_factory=dict, repr=False)
 
@@ -505,6 +526,16 @@ class BenchmarkConfig:
             jax_obs_query_slowlog=max(
                 geti("jax.obs.query.slowlog", 128), 1),
             jax_obs_query_sample=max(geti("jax.obs.query.sample", 1), 1),
+            jax_tenants=gets("jax.tenants", ""),
+            jax_admission_enabled=getb("jax.admission.enabled", False),
+            jax_admission_breach_ticks=max(
+                geti("jax.admission.breach.ticks", 2), 1),
+            jax_admission_healthy_ticks=max(
+                geti("jax.admission.healthy.ticks", 4), 1),
+            jax_admission_escalate_ticks=max(
+                geti("jax.admission.escalate.ticks", 6), 1),
+            jax_admission_cooldown_s=max(
+                getf("jax.admission.cooldown.s", 3.0), 0.0),
             raw=dict(conf),
         )
 

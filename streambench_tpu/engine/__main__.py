@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the fork's count-based barrier-aligned window "
                         "mode (window.size / map.partitions) over the "
                         "broker topic, then exit")
+    p.add_argument("--tenants", default=None,
+                   help="run the multi-tenant host instead of one engine: "
+                        "\"name:kind,...\" (kinds: exact/hll/sliding/"
+                        "session/reach/hllx; README \"Multi-tenant "
+                        "operation\").  Every tenant tails the shared "
+                        "topic with its own engine + tenant= metric "
+                        "namespace; overrides jax.tenants")
     return p
 
 
@@ -100,6 +107,15 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     mapping, campaigns = load_mapping(cfg, args.workdir)
+
+    # multi-tenant host (obs layer 9): N topologies, one process, one
+    # device — delegates the whole run like --microbatch does (the
+    # host owns its engines, sinks and obs wiring)
+    if args.tenants or cfg.jax_tenants:
+        from streambench_tpu.engine.tenants import run_tenants_cli
+
+        return run_tenants_cli(cfg, args, mapping, campaigns)
+
     if cfg.redis_host == ":inprocess:":
         redis = as_redis(make_store())
     else:

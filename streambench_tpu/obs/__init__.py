@@ -57,6 +57,16 @@ recorder).  Four pieces, all stdlib, all default-off:
   slow-query log, and the ingest-contention ratio
   (``streambench_reach_contention_ratio``) computed from the span
   ring's ingest dispatch spans
+- ``tenancy``   — multi-tenant observability (obs layer 9,
+  ``jax.tenants``): tenant-scoped ``TenantRegistry`` views over one
+  shared registry (every instrument carries ``tenant=``) and the
+  ``DeviceTimeLedger`` blame matrix — victim wait ∩ aggressor
+  device-busy, with a tested partition invariant
+- ``admission`` — measurement-actuated admission control
+  (``jax.admission.enabled``): defer/shed an aggressor tenant's
+  ingest when the blame matrix says its dispatches are burning a
+  victim's SLO budget (priming/hysteresis/cooldowns, journaled
+  evidence-carrying decisions, default-off)
 
 Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
 > 0 and/or ``jax.metrics.port`` >= 0); embed via::
@@ -71,6 +81,7 @@ Enable on the engine CLI via config keys (``jax.metrics.interval.ms``
     server = MetricsServer(registry, port=0, refresh=sampler.collect_now)
 """
 
+from streambench_tpu.obs.admission import AdmissionController  # noqa: F401
 from streambench_tpu.obs.autoscale import AutoscaleController  # noqa: F401
 from streambench_tpu.obs.capture import (  # noqa: F401
     CaptureManager,
@@ -112,6 +123,10 @@ from streambench_tpu.obs.sampler import (  # noqa: F401
 )
 from streambench_tpu.obs.slo import SloTracker  # noqa: F401
 from streambench_tpu.obs.spans import SpanTracer  # noqa: F401
+from streambench_tpu.obs.tenancy import (  # noqa: F401
+    DeviceTimeLedger,
+    TenantRegistry,
+)
 from streambench_tpu.obs.xfer import (  # noqa: F401
     ShardSkew,
     TransferLedger,

@@ -42,6 +42,7 @@ VERDICT_FOLD = "fold_lag"
 VERDICT_TAIL = "tail_lag"
 VERDICT_SERVE = "serve"
 VERDICT_CONTENTION = "contention"
+VERDICT_TENANT = "tenant_interference"
 VERDICT_HEALTHY = "healthy"
 
 #: knob names (what the controller actuates)
@@ -49,12 +50,14 @@ KNOB_SHIP = "ship_cadence"
 KNOB_POLL = "poll_interval"
 KNOB_REPLICAS = "replica_count"
 KNOB_BATCH = "batch_cadence"
+KNOB_ADMISSION = "admission"
 
 KNOB_FOR = {
     VERDICT_FOLD: KNOB_SHIP,
     VERDICT_TAIL: KNOB_POLL,
     VERDICT_SERVE: KNOB_REPLICAS,
     VERDICT_CONTENTION: KNOB_BATCH,
+    VERDICT_TENANT: KNOB_ADMISSION,
     VERDICT_HEALTHY: None,
 }
 
@@ -96,6 +99,8 @@ def evidence_window(records: list) -> dict:
     router = None
     ship = None
     slo = None
+    multitenant = None
+    slo_tenants: dict = {}
     ts = 0
     for r in records:
         if not isinstance(r, dict):
@@ -117,6 +122,17 @@ def evidence_window(records: list) -> dict:
         sl = r.get("slo")
         if isinstance(sl, dict):
             slo = sl
+        # multi-tenant evidence (ISSUE 19): the host's blame-matrix
+        # block and the nested per-tenant SLO blocks — latest wins,
+        # per tenant for the SLO map
+        mt = r.get("multitenant")
+        if isinstance(mt, dict):
+            multitenant = mt
+        st = r.get("slo_tenants")
+        if isinstance(st, dict):
+            for name, b in st.items():
+                if isinstance(b, dict):
+                    slo_tenants[str(name)] = b
     w: dict = {"ts_ms": ts, "replicas": len(rq_by),
                "staleness_ms": None, "p99_ms": None, "qps": 0.0,
                "served": 0, "shed": 0, "shed_stale": 0,
@@ -169,6 +185,23 @@ def evidence_window(records: list) -> dict:
                  if isinstance(b, (int, float))]
         if burns:
             w["slo_burn_max"] = max(burns)
+    if slo_tenants:
+        w["tenant_burn"] = {}
+        w["tenant_in_breach"] = []
+        for name in sorted(slo_tenants):
+            b = slo_tenants[name]
+            vals = [v for wins in (b.get("burn") or {}).values()
+                    if isinstance(wins, dict)
+                    for v in wins.values()
+                    if isinstance(v, (int, float))]
+            w["tenant_burn"][name] = max(vals) if vals else 0.0
+            if b.get("in_breach"):
+                w["tenant_in_breach"].append(name)
+    if multitenant is not None:
+        w["blame_offdiag_ratio"] = _num(
+            multitenant.get("offdiag_ratio"))
+        w["blame_matrix_ms"] = dict(
+            multitenant.get("matrix_ms") or {})
     return w
 
 
@@ -276,6 +309,43 @@ def diagnose(window: dict, *, objective: dict,
                 "why": (f"{d_over} overloaded sheds / p99 "
                         f"{p99} vs {p99_limit} ms without contention "
                         "evidence: serving capacity, not freshness"),
+                "evidence": evidence})
+
+    # -- tenant interference: the admission knob ------------------------
+    t_burn = dict(window.get("tenant_burn") or {})
+    in_breach = list(window.get("tenant_in_breach") or ())
+    matrix = window.get("blame_matrix_ms") or {}
+    victims = in_breach or sorted(
+        (t for t, b in t_burn.items() if b >= 1.0),
+        key=lambda t: -t_burn[t])
+    if victims and matrix:
+        # the highest-burn breaching victim with cross-tenant blame
+        # evidence names the aggressor; a tenant burning its own budget
+        # (empty off-diagonal row) stays with the capacity verdicts
+        victim = max(victims, key=lambda t: t_burn.get(t, 1.0))
+        row = matrix.get(victim) or {}
+        best = None
+        for aggressor, ms in row.items():
+            if aggressor == victim or not isinstance(ms, (int, float)):
+                continue
+            if ms > 0 and (best is None or ms > best[1]):
+                best = (aggressor, ms)
+        if best is not None:
+            aggressor, blame_ms = best
+            burn = t_burn.get(victim, 1.0)
+            evidence = dict(evidence)
+            evidence["tenant_burn"] = t_burn
+            evidence["blame_row_ms"] = row
+            evidence["blame_offdiag_ratio"] = window.get(
+                "blame_offdiag_ratio")
+            out.append({
+                "verdict": VERDICT_TENANT, "knob": KNOB_ADMISSION,
+                "score": round(1.0 + burn + min(blame_ms / 1e3, 2.0), 3),
+                "victim": victim, "aggressor": aggressor,
+                "why": (f"tenant {victim!r} burns its budget at "
+                        f"{burn}x while {aggressor!r} held the device "
+                        f"for {blame_ms} ms of its measured wait — "
+                        "gate the aggressor's ingest"),
                 "evidence": evidence})
 
     if not out:

@@ -220,6 +220,56 @@ def summarize_fleet(records: list[dict], path: str = "") -> dict:
         if isinstance(clock, dict):
             agg["clock"] = {k: clock.get(k) for k in
                             ("offset_ms", "uncertainty_ms", "applied")}
+        # multi-tenant host (ISSUE 19): the host's sampler journals a
+        # per-tenant block (rec["tenants"]), per-tenant burn gauges
+        # (rec["slo_tenants"]), the device-time ledger's blame matrix
+        # (rec["multitenant"]) and the admission controller's summary
+        # (rec["admission"]) — folded into one tenant sub-table under
+        # the host row, last snapshot wins like the other columns
+        tn = r.get("tenants")
+        if isinstance(tn, dict):
+            tens = agg.setdefault("tenants", {})
+            for name, t in tn.items():
+                if not isinstance(t, dict):
+                    continue
+                row = tens.setdefault(name, {})
+                row["kind"] = t.get("kind")
+                for k2 in ("events", "events_per_s", "queued_batches",
+                           "folded_batches", "dropped_batches"):
+                    if t.get(k2) is not None:
+                        row[k2] = t[k2]
+        st = r.get("slo_tenants")
+        if isinstance(st, dict):
+            tens = agg.setdefault("tenants", {})
+            for name, s in st.items():
+                if not isinstance(s, dict):
+                    continue
+                row = tens.setdefault(name, {})
+                fast = [b.get("fast") for b in (s.get("burn") or
+                                                {}).values()
+                        if isinstance(b, dict)
+                        and isinstance(b.get("fast"), (int, float))]
+                if fast:
+                    row["burn_fast"] = round(max(fast), 2)
+                row["in_breach"] = s.get("in_breach")
+        mt = r.get("multitenant")
+        if isinstance(mt, dict):
+            tens = agg.setdefault("tenants", {})
+            for name in (mt.get("tenants") or ()):
+                row = tens.setdefault(name, {})
+                row["busy_ms"] = (mt.get("busy_ms") or {}).get(name)
+                row["wait_ms"] = (mt.get("wait_ms") or {}).get(name)
+            agg["blame"] = {
+                "matrix_ms": mt.get("matrix_ms"),
+                "offdiag_ratio": mt.get("offdiag_ratio"),
+                "partition_ok": (mt.get("partition") or {}).get("ok"),
+            }
+        adm = r.get("admission")
+        if isinstance(adm, dict):
+            agg["admission"] = {k2: adm.get(k2) for k2 in
+                                ("defers", "sheds", "releases", "holds",
+                                 "batches_deferred", "batches_shed",
+                                 "gates", "last")}
     rows = []
     for agg in by_role.values():
         rates = agg.pop("_rates")
@@ -310,6 +360,47 @@ def render_fleet(s: dict) -> str:
                 f"    clock offset {_fmt(clock.get('offset_ms'))} ms "
                 f"+-{_fmt(clock.get('uncertainty_ms'))} "
                 f"({'applied' if clock.get('applied') else 'NOT applied'})")
+        tens = a.get("tenants")
+        if tens:
+            adm = a.get("admission") or {}
+            gates = adm.get("gates") or {}
+            lines.append(
+                f"    {'tenant':<8} {'kind':<8} {'events':>10} "
+                f"{'folded':>7} {'queued':>7} {'busy ms':>11} "
+                f"{'wait ms':>11} {'burn':>6} {'gate':>6}")
+            for name in sorted(tens):
+                t = tens[name]
+                gate = gates.get(name)
+                if isinstance(gate, dict):
+                    gate = gate.get("mode")
+                lines.append(
+                    f"    {name:<8} {t.get('kind') or '-':<8} "
+                    f"{_fmt(t.get('events')):>10} "
+                    f"{_fmt(t.get('folded_batches')):>7} "
+                    f"{_fmt(t.get('queued_batches')):>7} "
+                    f"{_fmt(t.get('busy_ms')):>11} "
+                    f"{_fmt(t.get('wait_ms')):>11} "
+                    f"{_fmt(t.get('burn_fast')):>6} "
+                    f"{gate or '-':>6}")
+            bl = a.get("blame")
+            if bl and bl.get("offdiag_ratio") is not None:
+                ok = bl.get("partition_ok")
+                lines.append(
+                    f"    blame offdiag {_fmt(bl['offdiag_ratio'])}  "
+                    f"partition {'ok' if ok else 'FAIL' if ok is False else '-'}")
+            if adm:
+                last = adm.get("last") or {}
+                last_s = (f"{last.get('decision')}"
+                          f"[{last.get('tenant')}->"
+                          f"{last.get('victim')}]"
+                          if last.get("decision") else "-")
+                lines.append(
+                    f"    admission: defers {_fmt(adm.get('defers'))}  "
+                    f"sheds {_fmt(adm.get('sheds'))}  "
+                    f"releases {_fmt(adm.get('releases'))}  "
+                    f"deferred {_fmt(adm.get('batches_deferred'))}  "
+                    f"shed {_fmt(adm.get('batches_shed'))}  "
+                    f"last {last_s}")
     return "\n".join(lines)
 
 
@@ -356,8 +447,16 @@ def merge_traces(inputs: list, run: str = "fleet") -> dict:
             float(wall0) if isinstance(wall0, (int, float)) else None))
     known = [w for _, _, _, w in docs if w is not None]
     base = min(known) if known else 0.0
-    for role, path, doc, wall0 in docs:
+    # tenant lanes (ISSUE 19): N tenants in ONE process dump N trace
+    # files sharing one real pid, which would merge their lanes and
+    # let the last process_name win.  When a later file claims a pid
+    # an earlier file already used, remap its events onto a synthetic
+    # pid (deterministic per file order) so every role/tenant keeps a
+    # named lane of its own.
+    claimed: dict = {}
+    for fi, (role, path, doc, wall0) in enumerate(docs):
         shift_us = ((wall0 - base) * 1000.0) if wall0 is not None else 0.0
+        remap: dict = {}
         pids = set()
         for ev in doc.get("traceEvents", []):
             if not isinstance(ev, dict):
@@ -365,6 +464,13 @@ def merge_traces(inputs: list, run: str = "fleet") -> dict:
             out = dict(ev)
             if out.get("ph") == "X":
                 out["ts"] = round(float(out.get("ts", 0)) + shift_us, 3)
+            pid = out.get("pid")
+            if pid is not None:
+                if pid not in remap:
+                    owner = claimed.setdefault(pid, fi)
+                    remap[pid] = (pid if owner == fi
+                                  else pid * 1000 + fi)
+                out["pid"] = remap[pid]
             pids.add(out.get("pid"))
             events.append(out)
         for pid in sorted(p for p in pids if p is not None):

@@ -300,6 +300,20 @@ class MetricsRegistry:
         return self._get(StreamingHistogram, name, help, labels,
                          lo=lo, hi=hi, growth=growth)
 
+    def predeclare(self, kind: str, name: str, help: str = "",
+                   label_sets: "list[dict | None] | None" = None,
+                   **kw) -> None:
+        """Eagerly create an instrument family (one instrument per
+        label set) so a scrape BEFORE the first feed returns it with
+        zero samples instead of omitting the family — the lazy-
+        instrument gap: per-format/per-tenant instruments created at
+        first dispatch are invisible to early Prometheus scrapes, and
+        harnesses end up polling the endpoint until they appear."""
+        maker = {"counter": self.counter, "gauge": self.gauge,
+                 "histogram": self.histogram}[kind]
+        for labels in (label_sets or [None]):
+            maker(name, help, labels=labels, **kw)
+
     def collect(self) -> list:
         with self._lock:
             return list(self._metrics.values())

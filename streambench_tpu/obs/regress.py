@@ -129,6 +129,16 @@ DEFAULT_TOLERANCES: dict = {
     "ship_bytes_per_tick": ("lower", 0.5),
     "ship_ms_per_tick": ("lower", 2.0),
     "ship_bytes_ratio": ("higher", 0.5),
+    # multi-tenant admission (ISSUE 19, baseline MTEN_r01): the
+    # admission-ON arm's victim breach fraction under the seeded flash
+    # crowd regresses UP (the controller's whole job), as does the
+    # blame matrix's off-diagonal share in the OFF arm (more of the
+    # victim's wait attributed to other tenants).  Advisory-by-
+    # tolerance: both are wall-timing on the 1-core host — where
+    # queries land relative to the aggressor's fold dispatches moves
+    # run to run.
+    "tenant_victim_breach_ratio": ("lower", 2.0),
+    "tenant_blame_offdiag_ratio": ("lower", 2.0),
 }
 
 
@@ -251,6 +261,15 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
                 ds.get("ship_bytes_per_tick"))
             out["ship_ms_per_tick"] = _num(ds.get("ship_ms_per_tick"))
             out["ship_bytes_ratio"] = _num(ds.get("bytes_ratio"))
+    # ISSUE 19 multi-tenant keys (bench_multitenant MTEN_r01 schema):
+    # the admission-ON arm's victim breach fraction + the OFF arm's
+    # blame-matrix off-diagonal share
+    mt = doc.get("multitenant")
+    if isinstance(mt, dict):
+        out["tenant_victim_breach_ratio"] = _num(
+            mt.get("victim_breach_ratio_on"))
+        out["tenant_blame_offdiag_ratio"] = _num(
+            mt.get("blame_offdiag_ratio"))
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -107,6 +107,13 @@ def summarize(records: list[dict], path: str = "") -> dict:
         # serving-tier obs (layer 5, jax.obs.query): newest per-query
         # attribution block the reach collector journals
         "reach_query": last_block("reach_query"),
+        # multi-tenant host (layer 9): per-tenant namespaces, burn
+        # gauges, the device-time blame matrix, and the admission
+        # controller's decision counters
+        "tenants": last_block("tenants"),
+        "slo_tenants": last_block("slo_tenants"),
+        "multitenant": last_block("multitenant"),
+        "admission": last_block("admission"),
         "faults": last.get("faults") or {},
         "stages": stages,
         "annotations": [{k: r.get(k) for k in ("event", "uptime_ms")}
@@ -246,6 +253,43 @@ def render_report(s: dict) -> str:
                 f"(queue wait {_fmt(cont.get('queue_wait_ms'))} ms, "
                 f"ingest overlap {_fmt(cont.get('ingest_overlap_ms'))} "
                 "ms)")
+    tn = s.get("tenants")
+    if tn:
+        mt = s.get("multitenant") or {}
+        slo_t = s.get("slo_tenants") or {}
+        busy = mt.get("busy_ms") or {}
+        wait = mt.get("wait_ms") or {}
+        lines.append("  tenants (disjoint namespaces, one device):")
+        lines.append(f"    {'tenant':<8} {'kind':<8} {'events':>10} "
+                     f"{'folded':>7} {'busy ms':>11} {'wait ms':>11} "
+                     f"{'burn':>6}")
+        for name in sorted(tn):
+            t = tn[name] if isinstance(tn[name], dict) else {}
+            fast = [b.get("fast")
+                    for b in ((slo_t.get(name) or {}).get("burn")
+                              or {}).values()
+                    if isinstance(b, dict)
+                    and isinstance(b.get("fast"), (int, float))]
+            lines.append(
+                f"    {name:<8} {t.get('kind') or '-':<8} "
+                f"{_fmt(t.get('events')):>10} "
+                f"{_fmt(t.get('folded_batches')):>7} "
+                f"{_fmt(busy.get(name)):>11} "
+                f"{_fmt(wait.get(name)):>11} "
+                f"{_fmt(round(max(fast), 2) if fast else None):>6}")
+        if mt.get("offdiag_ratio") is not None:
+            ok = (mt.get("partition") or {}).get("ok")
+            lines.append(
+                f"    blame offdiag {_fmt(mt['offdiag_ratio'])}  "
+                f"partition {'ok' if ok else 'FAIL' if ok is False else '-'}")
+        adm = s.get("admission")
+        if adm:
+            lines.append(
+                f"    admission: defers {_fmt(adm.get('defers'))}  "
+                f"sheds {_fmt(adm.get('sheds'))}  "
+                f"releases {_fmt(adm.get('releases'))}  "
+                f"deferred {_fmt(adm.get('batches_deferred'))}  "
+                f"shed {_fmt(adm.get('batches_shed'))}")
     if s["faults"]:
         lines.append("  faults:")
         for k in sorted(s["faults"]):
@@ -550,6 +594,17 @@ def render_diff(a: dict, b: dict) -> str:
         emit("reach contention",
              (qa.get("contention") or {}).get("ratio"),
              (qb.get("contention") or {}).get("ratio"))
+    ta, tb = a.get("tenants") or {}, b.get("tenants") or {}
+    for name in sorted(set(ta) | set(tb)):
+        emit(f"tenant {name} events", (ta.get(name) or {}).get("events"),
+             (tb.get(name) or {}).get("events"))
+    ma, mb = a.get("multitenant") or {}, b.get("multitenant") or {}
+    if ma or mb:
+        wa, wb = ma.get("wait_ms") or {}, mb.get("wait_ms") or {}
+        for name in sorted(set(wa) | set(wb)):
+            emit(f"tenant {name} wait ms", wa.get(name), wb.get(name))
+        emit("blame offdiag ratio", ma.get("offdiag_ratio"),
+             mb.get("offdiag_ratio"))
     fault_keys = sorted(set(a["faults"]) | set(b["faults"]))
     for k in fault_keys:
         emit(f"fault {k}", a["faults"].get(k, 0), b["faults"].get(k, 0))

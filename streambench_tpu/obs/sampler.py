@@ -239,7 +239,8 @@ class MetricsSampler:
 
     def __init__(self, path: str, interval_ms: int = 1000,
                  registry=None, max_bytes: int = 0,
-                 role: "str | None" = None):
+                 role: "str | None" = None,
+                 tenant: "str | None" = None):
         self.path = path
         self.interval_ms = max(int(interval_ms), 1)
         self.registry = registry
@@ -248,8 +249,14 @@ class MetricsSampler:
         # ("writer"/"replica"), so the FleetCollector can merge many
         # roles' journals into one attributed stream.  pid is stamped
         # unconditionally — it costs one int per record and makes any
-        # journal self-identifying.
+        # journal self-identifying.  ``tenant`` (ISSUE 19) is the same
+        # idea one level down: a sampler journaling for exactly one
+        # tenant's topology stamps that name next to role/pid.  A
+        # multi-tenant host journaling for all tenants at once leaves
+        # it None and nests per-tenant blocks inside each record
+        # instead (``rec["tenants"][name]``).
         self.role = role
+        self.tenant = tenant
         self._pid = os.getpid()
         # journal size cap (``jax.metrics.max.bytes``; 0 = unbounded):
         # a record that would push past it rotates metrics.jsonl to
@@ -301,6 +308,8 @@ class MetricsSampler:
                    "pid": self._pid}
             if self.role is not None:
                 rec["role"] = self.role
+            if self.tenant is not None:
+                rec["tenant"] = self.tenant
             self._seq += 1
             for fn in self._collectors:
                 fn(rec, dt_s)
@@ -318,6 +327,8 @@ class MetricsSampler:
                "pid": self._pid}
         if self.role is not None:
             rec["role"] = self.role
+        if self.tenant is not None:
+            rec["tenant"] = self.tenant
         rec.update(fields)
         self._write(rec)
 

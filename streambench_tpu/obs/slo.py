@@ -59,7 +59,18 @@ class SloTracker:
                  budget: float = 0.01, fast_s: float = 30.0,
                  slow_s: float = 180.0, use_lifecycle: bool = False,
                  annotate=None, flightrec=None, capture=None,
-                 queryattr=None, clock=time.monotonic):
+                 queryattr=None, tenant: "str | None" = None,
+                 clock=time.monotonic):
+        # multi-tenant (ISSUE 19): a tracker scoped to one tenant is
+        # built over that tenant's TenantRegistry view — its gauges and
+        # get-or-create histograms pick up the ``tenant=`` label from
+        # the view, so N trackers over one shared registry never share
+        # an instrument.  ``tenant`` here only steers the JOURNAL shape
+        # (the per-tenant block nests under
+        # ``rec["slo_tenants"][name]`` instead of claiming the
+        # process-wide ``rec["slo"]`` key) and stamps breach events
+        # with the tenant name.
+        self.tenant = tenant
         self.p99_ms = max(int(p99_ms), 0)
         self.rate_evps = max(int(rate_evps), 0)
         # jax.reach.slo.p99.ms — reach-serving latency objective: a
@@ -188,6 +199,15 @@ class SloTracker:
                 "slow": round(self._window_burn(self.slow_s, 5, 6), 3)}
         return out
 
+    def fast_burn(self) -> float:
+        """Worst fast-window burn across this tracker's objectives —
+        the scalar the admission controller's ``burns()`` callable
+        reports per tenant (fast window: admission wants onset, the
+        two-window breach verdict stays the pass/fail arbiter)."""
+        burns = self.burn_rates()
+        vals = [wins.get("fast", 0.0) for wins in burns.values()]
+        return max(vals) if vals else 0.0
+
     # ------------------------------------------------------------------
     def collect(self, rec: dict, dt_s: float) -> None:
         """Sampler-collector hook: append one sample, recompute burns,
@@ -233,6 +253,8 @@ class SloTracker:
             self._c_breach.inc()
             fields = {"burn": burns, "bad_windows": bad,
                       "total_windows": total}
+            if self.tenant is not None:
+                fields["tenant"] = self.tenant
             if self.reach_p99_ms and self.queryattr is not None:
                 # per-segment burn attribution: the breach event says
                 # where the slow queries' time went
@@ -254,20 +276,26 @@ class SloTracker:
                 except Exception:
                     pass   # capture failure must not kill the tick
         elif not breaching and self._in_breach:
+            rcv = ({"burn": burns} if self.tenant is None
+                   else {"burn": burns, "tenant": self.tenant})
             if self.annotate is not None:
                 try:
-                    self.annotate("slo_recovered", burn=burns)
+                    self.annotate("slo_recovered", **rcv)
                 except Exception:
                     pass
             if self.flightrec is not None:
-                self.flightrec.record("slo_recovered", burn=burns)
+                self.flightrec.record("slo_recovered", **rcv)
         self._in_breach = breaching
-        rec["slo"] = {"burn": burns, "bad_windows": bad,
-                      "total_windows": total, "breaches": self.breaches,
-                      "in_breach": breaching}
+        block = {"burn": burns, "bad_windows": bad,
+                 "total_windows": total, "breaches": self.breaches,
+                 "in_breach": breaching}
         if self.reach_p99_ms:
-            rec["slo"]["bad_reach"] = r_bad
-            rec["slo"]["total_reach"] = r_total
+            block["bad_reach"] = r_bad
+            block["total_reach"] = r_total
+        if self.tenant is None:
+            rec["slo"] = block
+        else:
+            rec.setdefault("slo_tenants", {})[self.tenant] = block
 
     # ------------------------------------------------------------------
     def verdict(self) -> dict:
@@ -293,6 +321,8 @@ class SloTracker:
             "breaches": self.breaches,
             "pass": self.breaches == 0 and not self._in_breach,
         }
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
         if self._reach_hist is not None:
             r_total = self._reach_hist.count
             out["bad_reach"] = r_total - self._reach_hist.count_le(

@@ -68,6 +68,13 @@ class TransferLedger:
     async hot path.  0 disables timing entirely (byte accounting only).
     """
 
+    #: wire formats the engines can dispatch — pre-declared at
+    #: construction so a scrape before the first dispatch already
+    #: returns every per-format family with zero samples (the lazy-
+    #: instrument scrape gap; an unlisted format still get-or-creates
+    #: its instruments lazily at its first dispatch)
+    KNOWN_FORMATS = ("packed", "unpacked", "devdecode")
+
     def __init__(self, registry=None, sample_every: int = 32):
         self.sample_every = max(int(sample_every), 0)
         self.dispatches = 0
@@ -89,6 +96,8 @@ class TransferLedger:
             self._c_sampled = registry.counter(
                 "streambench_xfer_sampled_total",
                 "dispatch payloads whose transfer was timed (1/N)")
+            for fmt in self.KNOWN_FORMATS:
+                self._instruments(fmt)
 
     # ------------------------------------------------------------------
     def _instruments(self, fmt: str) -> tuple:

@@ -1,0 +1,364 @@
+"""Multi-tenant observability (obs layer 9, ISSUE 19): tenant-scoped
+registry views, the shared-device time ledger + blame matrix, the
+measurement-actuated admission controller, and the MultiTenantHost
+that wires them — label isolation, the partition conservation law on
+synthetic spans, structural controller safety against a fake clock,
+the default-off byte-identity pin, and the 3-tenant engine-CLI smoke.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from streambench_tpu.config import default_config, write_local_conf
+from streambench_tpu.datagen import gen
+from streambench_tpu.io.fakeredis import FakeRedisStore
+from streambench_tpu.io.journal import FileBroker
+from streambench_tpu.io.redis_schema import as_redis
+from streambench_tpu.obs import MetricsRegistry
+from streambench_tpu.obs.admission import AdmissionController
+from streambench_tpu.obs.tenancy import DeviceTimeLedger, TenantRegistry
+from streambench_tpu.utils.ids import make_ids
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MS = 1_000_000  # ns per ms
+
+
+# ----------------------------------------------------------------------
+# tenant-scoped registry views
+def test_tenant_views_are_disjoint_namespaces():
+    reg = MetricsRegistry()
+    a = TenantRegistry(reg, "alpha")
+    b = TenantRegistry(reg, "beta")
+    ca = a.counter("streambench_events_total")
+    cb = b.counter("streambench_events_total")
+    assert ca is not cb          # same family, disjoint instruments
+    ca.inc(7)
+    cb.inc(2)
+    assert ca.value == 7 and cb.value == 2
+    # collect() is label-filtered per view; the shared exposition
+    # carries BOTH tenants with the label doing the namespacing
+    assert {m.labels.get("tenant") for m in a.collect()} == {"alpha"}
+    assert {m.labels.get("tenant") for m in b.collect()} == {"beta"}
+    body = a.render_prometheus()
+    assert 'tenant="alpha"' in body and 'tenant="beta"' in body
+
+
+def test_cross_tenant_label_bleed_raises():
+    reg = MetricsRegistry()
+    view = TenantRegistry(reg, "alpha")
+    with pytest.raises(ValueError):
+        view.counter("streambench_events_total",
+                     labels={"tenant": "beta"})
+    with pytest.raises(ValueError):
+        TenantRegistry(reg, "")
+
+
+def test_predeclared_tenant_family_scrapes_before_first_touch():
+    # the lazy-instrument gap: a scrape BEFORE any event must already
+    # carry the tenant-labeled family with zero samples (the fix that
+    # let CI drop its poll-until-appears loop)
+    reg = MetricsRegistry()
+    view = TenantRegistry(reg, "alpha")
+    view.predeclare("counter", "streambench_events_total",
+                    "events folded")
+    body = reg.render_prometheus()
+    assert 'streambench_events_total{tenant="alpha"} 0' in body
+
+
+# ----------------------------------------------------------------------
+# blame matrix + partition invariant on synthetic spans
+def test_blame_matrix_attributes_overlap_and_partitions():
+    led = DeviceTimeLedger()
+    # beta busy [0, 100) ms and [200, 300) ms; alpha busy [500, 510) ms
+    led.note_busy("beta", 0, 100 * MS)
+    led.note_busy("beta", 200 * MS, 300 * MS)
+    led.note_busy("alpha", 500 * MS, 510 * MS)
+    led.declare("gamma")
+    # gamma waits [50, 250) ms: 50 ms inside beta's first window,
+    # 50 ms inside its second, 0 inside alpha's
+    led.note_wait("gamma", 50 * MS, 250 * MS)
+    # beta also waits on itself [250, 260) ms — diagonal mass
+    led.note_wait("beta", 250 * MS, 260 * MS)
+    m = led.blame_matrix()
+    assert m["tenants"] == ["alpha", "beta", "gamma"]
+    assert m["matrix_ms"]["gamma"]["beta"] == 100.0
+    assert m["matrix_ms"]["gamma"]["alpha"] == 0.0
+    assert m["matrix_ms"]["beta"]["beta"] == 10.0
+    assert m["wait_ms"]["gamma"] == 200.0
+    # offdiag = gamma->beta 100; diag = beta->beta 10
+    assert m["offdiag_ratio"] == round(100.0 / 110.0, 4)
+    assert led.aggressor_for("gamma") == ("beta", 100.0)
+    # no cross-tenant evidence for alpha -> controller must not act
+    assert led.aggressor_for("alpha") is None
+
+    # conservation law: attributed busy == sampler-measured busy
+    ok = led.partition_check({"beta": 200 * MS, "alpha": 10 * MS,
+                              "gamma": 0})
+    assert ok["ok"] and ok["rel_err"] == 0.0
+    # a sampler total the ledger never saw fails the check loudly
+    bad = led.partition_check({"beta": 400 * MS, "alpha": 10 * MS,
+                               "gamma": 0})
+    assert not bad["ok"]
+
+
+def test_busy_sink_feeds_the_owning_tenant():
+    led = DeviceTimeLedger()
+    sink = led.busy_sink("beta")
+    sink(10 * MS, 30 * MS)
+    assert led.busy_ns["beta"] == 20 * MS
+    assert led.tenants() == ["beta"]
+
+
+# ----------------------------------------------------------------------
+# admission controller: structural safety against a fake clock
+def _controller(burn_seq, ledger=None, **kw):
+    """Controller over a scripted burn series and a canned ledger."""
+    if ledger is None:
+        ledger = DeviceTimeLedger()
+        ledger.note_busy("beta", 0, 100 * MS)
+        ledger.note_wait("gamma", 10 * MS, 60 * MS)   # beta blames 50ms
+    it = iter(burn_seq)
+    state = {"now": 0.0}
+
+    def burns():
+        return {"gamma": next(it)}
+
+    def clock():
+        return state["now"]
+
+    kw.setdefault("breach_burn", 1.0)
+    ctl = AdmissionController(ledger, burns, clock=clock, **kw)
+    return ctl, state
+
+
+def test_priming_step_never_actuates():
+    ctl, _ = _controller([99.0, 99.0, 99.0], breach_ticks=1,
+                         cooldown_s=0.0)
+    assert ctl.step() is None            # priming: history is not a breach
+    dec = ctl.step()
+    assert dec is None or dec["decision"] == "defer"
+
+
+def test_hysteresis_requires_consecutive_breaches():
+    ctl, _ = _controller([0.0, 9.0, 0.0, 9.0, 9.0, 9.0],
+                         breach_ticks=2, cooldown_s=0.0)
+    ctl.step()                           # prime
+    assert ctl.step() is None            # breach tick 1
+    assert ctl.step() is None            # healthy resets the streak
+    assert ctl.step() is None            # breach tick 1 again
+    dec = ctl.step()                     # breach tick 2 -> gate
+    assert dec["decision"] == "defer"
+    assert dec["tenant"] == "beta" and dec["victim"] == "gamma"
+    assert dec["blame_ms"] == 50.0 and dec["burn"] == 9.0
+    assert ctl.admit("beta") == "defer"
+    assert ctl.admit("gamma") == "admit"   # the victim is never gated
+
+
+def test_no_cross_tenant_evidence_means_no_actuation():
+    led = DeviceTimeLedger()
+    led.note_busy("gamma", 0, 100 * MS)
+    led.note_wait("gamma", 10 * MS, 60 * MS)   # waits only on itself
+    ctl, _ = _controller([9.0] * 6, ledger=led, breach_ticks=1,
+                         cooldown_s=0.0)
+    ctl.step()
+    for _ in range(4):
+        assert ctl.step() is None
+    assert ctl.gates() == {}
+
+
+def test_cooldown_counts_holds_then_acts_after_expiry():
+    ctl, state = _controller([9.0] * 8, breach_ticks=1, cooldown_s=5.0,
+                             escalate_ticks=1)
+    ctl.step()                           # prime
+    dec = ctl.step()
+    assert dec["decision"] == "defer"    # first act is never cooled
+    assert ctl.step() is None            # escalation due, inside cooldown
+    holds0 = ctl.holds
+    assert holds0 >= 1
+    state["now"] = 10.0                  # cooldown expired
+    dec = ctl.step()
+    assert dec["decision"] == "shed" and dec.get("escalated")
+    assert ctl.admit("beta") == "shed"
+
+
+def test_release_on_sustained_health_journals_evidence():
+    ctl, _ = _controller([9.0, 9.0, 0.0, 0.0, 0.0],
+                         breach_ticks=1, healthy_ticks=3,
+                         cooldown_s=0.0)
+    ctl.step()
+    assert ctl.step()["decision"] == "defer"
+    assert ctl.step() is None            # healthy 1
+    assert ctl.step() is None            # healthy 2
+    dec = ctl.step()                     # healthy 3 -> release
+    assert dec["decision"] == "release" and dec["released"] == ["beta"]
+    assert ctl.admit("beta") == "admit"
+    s = ctl.summary()
+    assert s["defers"] == 1 and s["releases"] == 1
+    assert s["last"]["decision"] == "release"
+
+
+def test_deferred_and_shed_batches_are_counted():
+    ctl, _ = _controller([0.0], cooldown_s=0.0)
+    ctl.note_deferred("beta", 3)
+    ctl.note_shed("beta", 2)
+    s = ctl.summary()
+    assert s["batches_deferred"] == 3 and s["batches_shed"] == 2
+
+
+# ----------------------------------------------------------------------
+# host: default-off byte-identity pin + in-process tenant journal
+def _world(seed=11, n=10):
+    rng = random.Random(seed)
+    campaigns = make_ids(n, rng)
+    ads = make_ids(n * 10, rng)
+    mapping = {a: campaigns[i // 10] for i, a in enumerate(ads)}
+    src = gen.EventSource(ads=ads, user_ids=make_ids(200, rng),
+                          page_ids=make_ids(20, rng), rng=rng)
+    ts = [1_700_000_000_000 + 10 * i for i in range(512)]
+    lines = [s.encode() for s in src.events_at(ts)]
+    return campaigns, mapping, lines
+
+
+def _run_host(monkeypatch, lines, mapping, campaigns, **host_kw):
+    import itertools
+
+    from streambench_tpu.engine import tenants as tmod
+    from streambench_tpu.io import redis_schema
+
+    # window/list UUIDs come from a pid-scoped random-prefix counter;
+    # pin it so both arms mint the identical ID sequence
+    monkeypatch.setattr(
+        redis_schema, "_ID_STATE",
+        {"pid": os.getpid(), "prefix": "00" * 8,
+         "counter": itertools.count()})
+    # ... and freeze the writeback wall-clock stamp for the same reason
+    # (pipeline.py imported the symbol at module load, so patch both)
+    from streambench_tpu.engine import pipeline as pmod
+    monkeypatch.setattr(redis_schema, "now_ms", lambda: 1_700_000_000_000)
+    monkeypatch.setattr(pmod, "now_ms", lambda: 1_700_000_000_000)
+
+    # pin the pure-Python store: its dict state is directly dumpable,
+    # and both arms use the identical implementation
+    stores = []
+    monkeypatch.setattr(
+        tmod, "make_store",
+        lambda: stores.append(FakeRedisStore()) or stores[-1])
+    cfg = default_config(jax_batch_size=256)
+    host = tmod.MultiTenantHost(cfg, [{"name": "solo", "kind": "exact"}],
+                                mapping, campaigns=campaigns,
+                                registry=MetricsRegistry(), **host_kw)
+    host.warmup()
+    host.offer("solo", lines)
+    while host.step():
+        pass
+    host.close(final=True)
+    (store,) = stores
+    return {"strings": store._strings, "hashes": store._hashes,
+            "sets": store._sets, "lists": store._lists}
+
+
+def test_admission_default_off_is_byte_identical(monkeypatch):
+    campaigns, mapping, lines = _world()
+    plain = _run_host(monkeypatch, lines, mapping, campaigns,
+                      admission=False)
+    # an armed-but-idle controller (threshold unreachably high) must
+    # leave the sink byte-identical to the default-off path
+    armed = _run_host(monkeypatch, lines, mapping, campaigns,
+                      admission=True,
+                      admission_kw={"breach_burn": 1e9})
+    dump = lambda d: json.dumps(d, sort_keys=True, default=sorted)
+    assert dump(plain) == dump(armed)
+
+
+def test_host_journals_disjoint_tenant_blocks(tmp_path):
+    from streambench_tpu.engine.tenants import MultiTenantHost
+    from streambench_tpu.obs import MetricsSampler
+
+    campaigns, mapping, lines = _world()
+    registry = MetricsRegistry()
+    sampler = MetricsSampler(str(tmp_path / "metrics.jsonl"),
+                             interval_ms=50, registry=registry,
+                             role="host")
+    cfg = default_config(jax_batch_size=256)
+    host = MultiTenantHost(
+        cfg, [{"name": "alpha", "kind": "exact"},
+              {"name": "beta", "kind": "session"}],
+        mapping, campaigns=campaigns, registry=registry,
+        sampler=sampler, sample_every=1)
+    host.warmup()
+    host.offer("alpha", lines)
+    host.offer("beta", lines)
+    while host.step():
+        pass
+    host.flush_all()
+    summary = host.close()
+    sampler.close(final={"multitenant": summary["multitenant"]})
+
+    assert summary["tenants"]["alpha"]["events"] == len(lines)
+    assert summary["tenants"]["beta"]["events"] == len(lines)
+    assert summary["multitenant"]["partition"]["ok"], \
+        summary["multitenant"]["partition"]
+    # every tenant-labeled instrument belongs to exactly one namespace
+    tenants_seen = {m.labels["tenant"] for m in registry.collect()
+                    if "tenant" in m.labels}
+    assert tenants_seen == {"alpha", "beta"}
+    recs = [json.loads(l) for l in
+            open(tmp_path / "metrics.jsonl", encoding="utf-8")]
+    final = next(r for r in recs if r.get("kind") == "final")
+    blocks = [r["tenants"] for r in recs if isinstance(r.get("tenants"),
+                                                       dict)]
+    assert blocks and all(set(b) == {"alpha", "beta"} for b in blocks)
+    assert final["multitenant"]["partition"]["ok"]
+
+
+# ----------------------------------------------------------------------
+# the 3-tenant engine-CLI smoke (the CI leg runs this same shape)
+def test_tenants_cli_smoke(tmp_path):
+    wd = str(tmp_path)
+    conf = os.path.join(wd, "conf.yaml")
+    write_local_conf(conf, {
+        "redis.host": ":inprocess:",
+        "kafka.topic": "ad-events",
+        "jax.batch.size": 256,
+        "jax.scan.batches": 2,
+        "jax.flush.interval.ms": 100,
+        "jax.metrics.interval.ms": 50,
+        "jax.metrics.port": -1,
+    })
+    cfg = default_config()
+    broker = FileBroker(os.path.join(wd, "broker"))
+    gen.do_setup(as_redis(FakeRedisStore()), cfg, broker=broker,
+                 events_num=6000, rng=random.Random(17), workdir=wd,
+                 topic="ad-events")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONUNBUFFERED": "1"}
+    p = subprocess.run(
+        [sys.executable, "-m", "streambench_tpu.engine",
+         "--confPath", conf, "--workdir", wd,
+         "--brokerDir", os.path.join(wd, "broker"),
+         "--tenants", "alpha:exact,beta:session,gamma:reach",
+         "--catchup"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=240)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [l for l in p.stdout.splitlines() if l.strip()]
+    assert any(l.startswith("tenants up: alpha,beta,gamma")
+               for l in lines), p.stdout
+    stats = json.loads(lines[-1])
+    assert stats["engine"] == "multitenant"
+    assert set(stats["tenants"]) == {"alpha", "beta", "gamma"}
+    # every tenant tails the same topic: same events folded each
+    assert len({t["events"] for t in stats["tenants"].values()}) == 1
+    assert stats["tenants"]["alpha"]["events"] > 0
+    assert stats["partition_ok"] is True
+    # the journal's snapshots carry disjoint tenant namespaces
+    recs = [json.loads(l) for l in
+            open(os.path.join(wd, "metrics.jsonl"), encoding="utf-8")]
+    blocks = [r["tenants"] for r in recs
+              if isinstance(r.get("tenants"), dict)]
+    assert blocks and set(blocks[-1]) == {"alpha", "beta", "gamma"}
