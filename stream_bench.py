@@ -56,6 +56,30 @@ CHECKPOINT_DIR = os.environ.get("CHECKPOINT_DIR", "")
 # firehose, stream-bench.sh:107-115).  Errors loudly if confluent-kafka
 # is absent — no silent fallback.
 KAFKA_BROKERS = os.environ.get("KAFKA_BROKERS", "")
+# Hermetic fake-Kafka opt-in (io.fakekafka; ISSUE 20): KAFKA_FAKE=1
+# routes make_broker through the recorded-protocol fake instead of
+# requiring confluent-kafka.  Under the harness the fake always runs as
+# a standalone TCP broker process (START_KAFKA/STOP_KAFKA, the
+# FakeRedisServer lifecycle): the generator produces and the engine
+# consumes over a real socket.  KAFKA_BROKERS picks the address
+# (default 127.0.0.1:9092).  KAFKA_FAULT_* knobs arm seeded
+# broker-surface chaos in the broker process (see
+# streambench_tpu.io.fakekafka --help; ROBUSTNESS.md "Kafka edge").
+KAFKA_FAKE = os.environ.get("KAFKA_FAKE", "") not in ("", "0", "false", "no")
+KAFKA_HOST, KAFKA_PORT = "127.0.0.1", 9092
+if KAFKA_BROKERS:
+    _first = KAFKA_BROKERS.split(",")[0].strip()
+    _h, _, _p = _first.partition(":")
+    KAFKA_HOST = _h or KAFKA_HOST
+    if _p:
+        try:
+            KAFKA_PORT = int(_p)
+        except ValueError:
+            pass
+# the bootstrap written to localConf: an explicit KAFKA_BROKERS wins;
+# KAFKA_FAKE alone points at the START_KAFKA broker's default address
+KAFKA_BOOTSTRAP = (KAFKA_BROKERS or
+                   (f"{KAFKA_HOST}:{KAFKA_PORT}" if KAFKA_FAKE else ""))
 # Engine tuning knobs forwarded into localConf (jax.* keys): batches per
 # device dispatch, window ring slots, parallel encode threads.
 SCAN_BATCHES = int(os.environ.get("SCAN_BATCHES", "8"))
@@ -297,7 +321,8 @@ def op_setup() -> None:
     sys.path.insert(0, REPO_ROOT)
     from streambench_tpu.config import write_local_conf
     write_local_conf(CONF_FILE, {
-        "kafka.bootstrap": KAFKA_BROKERS,
+        "kafka.bootstrap": KAFKA_BOOTSTRAP,
+        "kafka.fake": KAFKA_FAKE,
         "kafka.brokers": ["localhost"],
         "zookeeper.servers": ["localhost"],
         "kafka.port": 9092,
@@ -424,6 +449,89 @@ def op_stop_redis() -> None:
     stop_if_needed("redis")
 
 
+# ----------------------------------------------------------------------
+# fake-Kafka broker lifecycle (ISSUE 20): the Redis half's twin — spawn
+# or adopt a standalone io.fakekafka TCP broker with the same
+# pid+starttime pidfile and external-adoption marker semantics
+# ----------------------------------------------------------------------
+
+#: KAFKA_FAULT_* env -> io.fakekafka CLI fault flags (the CI faulted
+#: rung arms the broker's seeded chaos through these)
+_KAFKA_FAULT_FLAGS = (
+    ("KAFKA_FAULT_SEED", "--fault-seed"),
+    ("KAFKA_FAULT_PRODUCE_RATE", "--fault-produce-rate"),
+    ("KAFKA_FAULT_CONSUME_RATE", "--fault-consume-rate"),
+    ("KAFKA_FAULT_CONN_DROP_RATE", "--fault-conn-drop-rate"),
+    ("KAFKA_FAULT_DR_FAIL_RATE", "--fault-dr-fail-rate"),
+    ("KAFKA_FAULT_OPS", "--fault-ops"),
+    ("KAFKA_FAULT_DOWN", "--fault-down"),
+)
+
+
+def _external_kafka_marker() -> str:
+    return os.path.join(PID_DIR, "kafka.external")
+
+
+def _kafka_alive(timeout_s: float = 1.0) -> bool:
+    """Liveness ping against KAFKA_HOST:KAFKA_PORT (no spawn)."""
+    sys.path.insert(0, REPO_ROOT)
+    from streambench_tpu.io.fakekafka import ping
+    return ping(KAFKA_HOST, KAFKA_PORT, timeout_s=timeout_s)
+
+
+def op_start_kafka() -> None:
+    # Same adopt-or-spawn contract as op_start_redis: a broker already
+    # serving at the address (started by the user or a parallel
+    # harness) is adopted via ping + marker file and never stopped; the
+    # spawn path owns its process via the pid+starttime pidfile.
+    if running_pid("kafka") is None and _kafka_alive():
+        os.makedirs(PID_DIR, exist_ok=True)
+        with open(_external_kafka_marker(), "w") as f:
+            f.write(f"{KAFKA_HOST}:{KAFKA_PORT}\n")
+        log(f"kafka already serving at {KAFKA_HOST}:{KAFKA_PORT} "
+            "(external; adopted via ping, will not be stopped)")
+    else:
+        try:
+            os.remove(_external_kafka_marker())
+        except FileNotFoundError:
+            pass
+        args = ["--host", KAFKA_HOST, "--port", str(KAFKA_PORT)]
+        for env_name, flag in _KAFKA_FAULT_FLAGS:
+            v = os.environ.get(env_name, "")
+            if v:
+                args += [flag, v]
+        start_if_needed("kafka", _py("streambench_tpu.io.fakekafka",
+                                     *args))
+    _wait_kafka()
+
+
+def _wait_kafka(timeout_s: float = 15.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not _kafka_alive():
+        pid = running_pid("kafka")
+        if pid is not None and not _alive(pid):
+            raise SystemExit("fake kafka broker died during startup; "
+                             f"see {os.path.join(LOG_DIR, 'kafka.log')}")
+        if time.monotonic() > deadline:
+            raise SystemExit("fake kafka broker did not come up at "
+                             f"{KAFKA_HOST}:{KAFKA_PORT}")
+        time.sleep(0.1)
+
+
+def op_stop_kafka() -> None:
+    marker = _external_kafka_marker()
+    if os.path.exists(marker):
+        try:
+            with open(marker) as f:
+                where = f.read().strip()
+        finally:
+            os.remove(marker)
+        log(f"external kafka at {where} left running "
+            "(not started by this harness)")
+        return
+    stop_if_needed("kafka")
+
+
 def op_start_load() -> None:
     start_if_needed("load", _datagen("-r", "-t", str(LOAD)))
 
@@ -540,12 +648,18 @@ def op_jax_test() -> None:
     except OSError:
         pass
     op_start_redis()
+    if KAFKA_FAKE:
+        # broker process up BEFORE the engine/generator: both connect
+        # to it over TCP (conf carries kafka.fake + the bootstrap)
+        op_start_kafka()
     op_start_jax_processing()
     op_start_load()
     log(f"sleeping {TEST_TIME:.0f}s")
     time.sleep(TEST_TIME)
     op_stop_load()
     op_stop_jax_processing()
+    if KAFKA_FAKE:
+        op_stop_kafka()
     op_stop_redis()
     # A composite test that produced load but measured NOTHING is a
     # failure (observed: a stale hung engine from a crashed previous run
@@ -732,7 +846,7 @@ def _clean_broker_dir() -> None:
 
 
 def op_stop_all() -> None:
-    for name in ("load", "engine", "redis"):
+    for name in ("load", "engine", "kafka", "redis"):
         stop_if_needed(name)
     _clean_broker_dir()
 
@@ -741,6 +855,8 @@ OPS: dict[str, object] = {
     "SETUP": op_setup,
     "START_REDIS": op_start_redis,
     "STOP_REDIS": op_stop_redis,
+    "START_KAFKA": op_start_kafka,
+    "STOP_KAFKA": op_stop_kafka,
     "START_LOAD": op_start_load,
     "STOP_LOAD": op_stop_load,
     "START_JAX_PROCESSING": op_start_jax_processing,

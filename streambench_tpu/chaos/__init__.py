@@ -16,9 +16,11 @@ See ROBUSTNESS.md for the failure model.  The pieces:
   at the replica-process level (crash-kill, backoff restart, give-up)
 - ``verify``     — the executable at-least-once bound
   (:func:`check_at_least_once`), the strict exactly-once check
-  (:func:`check_exactly_once`, ``jax.sink.exactly_once`` runs), and
-  the fleet invariants (:func:`check_fleet_accounting`,
-  :func:`check_staleness_bound`, :func:`check_fleet_convergence`)
+  (:func:`check_exactly_once`, ``jax.sink.exactly_once`` runs), the
+  fleet invariants (:func:`check_fleet_accounting`,
+  :func:`check_staleness_bound`, :func:`check_fleet_convergence`),
+  and the broker-edge delivery ledger (:func:`check_kafka_edge`:
+  ``consumed == delivered + redelivered``, ``delivered == sent``)
 """
 
 from streambench_tpu.chaos.fleet_supervisor import (  # noqa: F401
@@ -44,9 +46,11 @@ from streambench_tpu.chaos.supervisor import (  # noqa: F401
 from streambench_tpu.chaos.verify import (  # noqa: F401
     ChaosVerdict,
     FleetVerdict,
+    KafkaEdgeVerdict,
     check_at_least_once,
     check_exactly_once,
     check_fleet_accounting,
+    check_kafka_edge,
     check_fleet_convergence,
     check_staleness_bound,
     durable_epoch_at,

@@ -260,6 +260,7 @@ class FaultInjector:
         self._journal_idx = 0
         self._net_idx = 0
         self._ship_idx = 0
+        self._kafka_idx = 0
 
     def sink_fault(self) -> str | None:
         with self._lock:
@@ -306,6 +307,25 @@ class FaultInjector:
         kind = self.plan.ship_faults.get(i)
         if kind is not None:
             self.counters.inc("ship_faults")
+        return kind
+
+    # -- broker surface (ISSUE 20) -------------------------------------
+    def kafka_fault(self) -> str | None:
+        """One per-broker-op draw for the fake Kafka cluster.  Down
+        windows outrank the rolled kind (an outage is not a
+        probability, the partition-window precedent)."""
+        with self._lock:
+            i = self._kafka_idx
+            self._kafka_idx += 1
+        for start, end in self.plan.kafka_down:
+            if start <= i < end:
+                self.counters.inc("chaos_kafka_faults")
+                self.counters.inc("chaos_kafka_down")
+                return "down"
+        kind = self.plan.kafka_faults.get(i)
+        if kind is not None:
+            self.counters.inc("chaos_kafka_faults")
+            self.counters.inc(f"chaos_kafka_{kind}")
         return kind
 
     @property

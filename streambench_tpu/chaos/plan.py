@@ -47,6 +47,21 @@ Fleet surfaces (ISSUE 16; see ``chaos.netchaos`` for the proxy and
   front of the NEXT ship — late, out of order).  Beyond ``ship_ops``
   the surface runs clean, so the writer's close-time forced ship is
   always delivered intact and post-heal convergence is provable.
+
+Broker surface (ISSUE 20; see ``io.fakekafka`` for the cluster that
+executes these draws):
+
+- **kafka** — per broker-operation index (appends and fetches share one
+  op counter, so fault placement is a pure function of the plan and the
+  op sequence): ``produce`` (transient produce error, record rejected),
+  ``consume`` (transient fetch error after any delivered records),
+  ``dr_fail`` (the record is rejected and the producer learns it from a
+  FAILED delivery report, not an exception), ``conn_drop`` (the broker
+  drops the consumer's connection — the reconnect resumes from the last
+  *returned* batch, so un-checkpointed records arrive twice:
+  redelivery, Kafka's honest at-least-once shape).  ``kafka_down``
+  windows additionally fail EVERY broker op in an index range — the
+  broker-down outage, ``sink_outage``'s peer.
 """
 
 from __future__ import annotations
@@ -69,6 +84,9 @@ CRASH_KINDS = ("batch", "flush", "checkpoint")
 # Fleet surfaces (ISSUE 16): pub/sub transport + ship-log append.
 NET_KINDS = ("drop", "delay", "dup", "torn")
 SHIP_FAULT_KINDS = ("torn", "corrupt", "delayed")
+# Broker surface (ISSUE 20): the fake Kafka cluster's per-op faults.
+# "down" is not drawn per-op — it comes from kafka_down windows.
+KAFKA_KINDS = ("produce", "consume", "dr_fail", "conn_drop")
 
 
 class EngineCrash(RuntimeError):
@@ -101,6 +119,10 @@ class FaultPlan:
     net_delay_ms: int = 0                                # "delay" hold time
     partition_windows: tuple = ()                        # ((start, len), ...)
     ship_faults: dict = field(default_factory=dict)      # ship idx -> kind
+    # broker surface (ISSUE 20); empty on every pre-kafka plan, so old
+    # plans stay bit-identical under the same seed
+    kafka_faults: dict = field(default_factory=dict)     # op idx -> kind
+    kafka_down: tuple = ()                               # ((start, end), ...)
 
     @classmethod
     def zeros(cls) -> "FaultPlan":
@@ -125,7 +147,13 @@ class FaultPlan:
                  net_msgs: int = 0,
                  partition_windows: tuple = (),
                  ship_rate: float = 0.0,
-                 ship_ops: int = 0) -> "FaultPlan":
+                 ship_ops: int = 0,
+                 kafka_produce_rate: float = 0.0,
+                 kafka_consume_rate: float = 0.0,
+                 kafka_dr_fail_rate: float = 0.0,
+                 kafka_conn_drop_rate: float = 0.0,
+                 kafka_ops: int = 0,
+                 kafka_down: tuple = ()) -> "FaultPlan":
         """Roll a deterministic plan from ``seed``.
 
         ``sink_rate``/``journal_rate`` are per-operation fault
@@ -154,6 +182,14 @@ class FaultPlan:
         the legacy surfaces' draws, so plans with the fleet knobs at
         their defaults are bit-identical to pre-fleet plans under the
         same seed (the ``sink_partial_rate`` precedent).
+
+        Broker surface (ISSUE 20, all default-off): the ``kafka_*_rate``
+        knobs roll one fault decision per broker op over the first
+        ``kafka_ops`` ops (cumulative thresholds, same guarantees as the
+        net draws); ``kafka_down=((start, end), ...)`` fails every
+        broker op whose index falls in a window.  Kafka draws happen
+        LAST, after the fleet draws, so plans with the kafka knobs at
+        their defaults are bit-identical to pre-kafka plans.
         """
         rng = random.Random(seed)
         sink: dict[int, str] = {}
@@ -201,16 +237,34 @@ class FaultPlan:
             if rng.random() < ship_rate:
                 ship[i] = rng.choice(SHIP_FAULT_KINDS)
         windows = tuple((int(s), int(n)) for s, n in partition_windows)
+        # broker draws LAST (bit-identity for pre-kafka plans): same
+        # cumulative-threshold scheme as the net draws
+        kafka: dict[int, str] = {}
+        krates = (("produce", kafka_produce_rate),
+                  ("consume", kafka_consume_rate),
+                  ("dr_fail", kafka_dr_fail_rate),
+                  ("conn_drop", kafka_conn_drop_rate))
+        for i in range(kafka_ops):
+            roll = rng.random()
+            lo = 0.0
+            for kind, rate in krates:
+                if rate and roll < lo + rate:
+                    kafka[i] = kind
+                    break
+                lo += rate
+        kdown = tuple((int(s), int(e)) for s, e in kafka_down)
         return cls(seed=seed, sink_faults=sink, journal_faults=journal,
                    crashes=crash_script, net_faults=net,
                    net_delay_ms=int(net_delay_ms),
-                   partition_windows=windows, ship_faults=ship)
+                   partition_windows=windows, ship_faults=ship,
+                   kafka_faults=kafka, kafka_down=kdown)
 
     @property
     def is_zero(self) -> bool:
         return not (self.sink_faults or self.journal_faults
                     or self.crashes or self.net_faults
-                    or self.partition_windows or self.ship_faults)
+                    or self.partition_windows or self.ship_faults
+                    or self.kafka_faults or self.kafka_down)
 
 
 class CrashScheduler:

@@ -439,3 +439,103 @@ def check_fleet_convergence(ship_path: str, replica_epochs,
             v.ok = False
             v.divergent = True
     return v
+
+
+# ---------------------------------------------------------------------
+# broker-edge invariants (ISSUE 20)
+# ---------------------------------------------------------------------
+
+@dataclass
+class KafkaEdgeVerdict:
+    """The broker-edge delivery ledger, balanced or not.
+
+    The accounting identity a faulted broker run must satisfy::
+
+        consumed == delivered + redelivered      (no uncounted duplicate,
+                                                  no silent drop at the
+                                                  consumer)
+        delivered == sent                        (every acked produce
+                                                  reached the engine
+                                                  exactly once)
+
+    where ``sent`` is the producers' acked-record count
+    (``kafka_produced``), ``consumed`` every record the broker handed
+    up, ``delivered`` the unique records returned to the engine, and
+    ``redelivered`` the reconnect duplicates the reader counted and
+    filtered.  ``windows`` optionally folds in an oracle window-count
+    verdict (:func:`check_at_least_once` / :func:`check_exactly_once`)
+    so one ``ok`` covers socket-to-Redis.
+    """
+
+    ok: bool
+    sent: int = 0
+    delivered: int = 0
+    redelivered: int = 0
+    consumed: int = 0
+    produce_retries: int = 0
+    consume_retries: int = 0
+    broker_down_ms: int = 0
+    violations: list = field(default_factory=list)
+    windows: "ChaosVerdict | None" = None
+    repro: str | None = None
+
+    def summary(self) -> str:
+        s = (f"kafka edge verdict: ok={self.ok} sent={self.sent} "
+             f"delivered={self.delivered} redelivered={self.redelivered} "
+             f"consumed={self.consumed} "
+             f"produce_retries={self.produce_retries} "
+             f"consume_retries={self.consume_retries} "
+             f"broker_down_ms={self.broker_down_ms} "
+             f"violations={self.violations}")
+        if self.windows is not None:
+            s += "\n" + self.windows.summary()
+        if self.repro:
+            s += "\n" + self.repro
+        return s
+
+
+def check_kafka_edge(counters, *, sent: int | None = None,
+                     require_redeliveries: bool = False,
+                     windows: "ChaosVerdict | None" = None,
+                     repro: str | None = None) -> KafkaEdgeVerdict:
+    """Assert the broker edge's delivery accounting from one counter
+    snapshot (the ``KafkaBroker``-shared :class:`~streambench_tpu.
+    metrics.FaultCounters`, or a plain snapshot dict).
+
+    ``sent`` overrides the producer-acked count when the ground truth
+    comes from elsewhere (the broker log length, the generator's event
+    count); ``require_redeliveries`` makes a faulted sweep prove its
+    conn-drop faults actually exercised the redelivery path.  Pass the
+    run's oracle window verdict as ``windows`` to fold end-to-end count
+    correctness into the same ``ok``.
+    """
+    snap = counters.snapshot() if hasattr(counters, "snapshot") \
+        else dict(counters)
+    v = KafkaEdgeVerdict(
+        ok=True,
+        sent=int(snap.get("kafka_produced", 0) if sent is None else sent),
+        delivered=int(snap.get("kafka_delivered", 0)),
+        redelivered=int(snap.get("kafka_redeliveries", 0)),
+        consumed=int(snap.get("kafka_consumed", 0)),
+        produce_retries=int(snap.get("kafka_produce_retries", 0)),
+        consume_retries=int(snap.get("kafka_consume_retries", 0)),
+        broker_down_ms=int(snap.get("kafka_broker_down_ms", 0)),
+        windows=windows, repro=repro)
+    if v.consumed != v.delivered + v.redelivered:
+        v.ok = False
+        v.violations.append(
+            f"consumed({v.consumed}) != delivered({v.delivered}) "
+            f"+ redelivered({v.redelivered})")
+    if v.delivered != v.sent:
+        v.ok = False
+        v.violations.append(
+            f"delivered({v.delivered}) != sent({v.sent})")
+    if require_redeliveries and v.redelivered <= 0:
+        v.ok = False
+        v.violations.append(
+            "redeliveries required but none observed (the conn-drop "
+            "faults never exercised the redelivery path)")
+    if windows is not None and not windows.ok:
+        v.ok = False
+        v.violations.append("oracle window-count check failed")
+    return v

@@ -66,6 +66,12 @@ class BenchmarkConfig:
     # env var here (the reference's firehose IS Kafka,
     # stream-bench.sh:107-115).
     kafka_bootstrap: str = ""              # kafka.bootstrap
+    # Hermetic-broker opt-in (new key): route make_broker to the fake
+    # Kafka cluster (io.fakekafka) instead of the file journal — with an
+    # empty bootstrap the in-process cluster, with host:port a
+    # FakeKafkaServer process (START_KAFKA).  Default-off: the file
+    # journal stays byte-identical.
+    kafka_fake: bool = False               # kafka.fake
     process_hosts: int = 1                 # :20
     process_cores: int = 4                 # :21
     storm_workers: int = 1                 # :24
@@ -445,6 +451,7 @@ class BenchmarkConfig:
             kafka_topic=gets("kafka.topic", "test1"),
             kafka_partitions=geti("kafka.partitions", 1),
             kafka_bootstrap=gets("kafka.bootstrap", ""),
+            kafka_fake=getb("kafka.fake", False),
             process_hosts=geti("process.hosts", 1),
             process_cores=geti("process.cores", 4),
             storm_workers=geti("storm.workers", 1),

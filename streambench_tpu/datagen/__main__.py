@@ -75,7 +75,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     broker = make_broker(cfg.kafka_bootstrap_servers,
-                         args.brokerDir or f"{args.workdir}/broker")
+                         args.brokerDir or f"{args.workdir}/broker",
+                         fake=cfg.kafka_fake)
 
     def redis():
         if cfg.redis_host == ":inprocess:":

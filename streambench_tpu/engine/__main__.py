@@ -131,7 +131,8 @@ def main(argv: list[str] | None = None) -> int:
 
         broker = make_broker(cfg.kafka_bootstrap_servers,
                              args.brokerDir
-                             or os.path.join(args.workdir, "broker"))
+                             or os.path.join(args.workdir, "broker"),
+                             fake=cfg.kafka_fake)
         merged, results = run_microbatch(
             cfg, broker, mapping, campaigns=campaigns, redis=redis,
             engine=args.engine, checkpoint_dir=args.checkpointDir)
@@ -187,7 +188,8 @@ def main(argv: list[str] | None = None) -> int:
 
     broker = make_broker(cfg.kafka_bootstrap_servers,
                          args.brokerDir
-                         or os.path.join(args.workdir, "broker"))
+                         or os.path.join(args.workdir, "broker"),
+                         fake=cfg.kafka_fake)
     broker.create_topic(cfg.kafka_topic)
     # Dead-letter queue (off by default): malformed events are journaled
     # to <topic>-deadletter instead of only bumping bad_lines, so they
@@ -363,6 +365,17 @@ def main(argv: list[str] | None = None) -> int:
             role="writer")
         sampler.add_collector(engine_collector(
             engine, reader=reader, runner=runner, registry=registry))
+        # Kafka delivery ledger (ISSUE 20): when the broker is the
+        # Kafka adapter its shared FaultCounters carry the
+        # produced/delivered/redelivered accounting — journal it under
+        # rec["kafka"] and mirror the headline instruments (predeclared
+        # inside the collector, scrape-gap rule)
+        if getattr(broker, "counters", None) is not None:
+            from streambench_tpu.obs import kafka_collector
+
+            sampler.add_collector(kafka_collector(
+                broker.counters, lag=getattr(reader, "lag", None),
+                registry=registry))
         if devmem is not None:
             sampler.add_collector(devmem.collect)
         # jax.obs.capture.*: bounded triggered profiler capture — SLO
@@ -544,6 +557,12 @@ def main(argv: list[str] | None = None) -> int:
         "dropped": engine.dropped, "wall_s": round(stats.wall_s, 2),
         "faults": stats.faults,
     }
+    if getattr(broker, "counters", None) is not None:
+        ksnap = {k[len("kafka_"):]: v
+                 for k, v in broker.counters.snapshot().items()
+                 if k.startswith("kafka_")}
+        if ksnap:
+            stats_line["kafka"] = ksnap
     if occupancy is not None:
         # the MEASURED busy ratio (sampled block_until_ready, not the
         # old pipelined-minus-encode estimate) + the steady-state

@@ -30,6 +30,14 @@ host that makes that shape *observable and governable* (obs layer 9):
   lost), a shed gate drops its oldest batch (counted per tenant).
   Default-off: without the flag the fold loop never calls into
   admission at all.
+- a defer gate also *actuates upstream* when the tenant's reader
+  supports it (the Kafka adapter's ``pause()``/``resume()``): the
+  paused consumer stops fetching, so the aggressor's backlog
+  accumulates IN THE BROKER — measured by the per-tenant
+  ``streambench_kafka_consumer_lag`` gauge — instead of ballooning the
+  host queue.  Release (or escalation to shed) resumes the consumer.
+  Readers without ``pause`` (FileBroker) just keep the old
+  queue-backlog behavior.
 
 Round-robin fairness note, stated honestly: on one CPU core the
 "device" and the host loop share the core, so a flash crowd on one
@@ -158,7 +166,8 @@ class MultiTenantHost:
         if admission:
             self.admission = AdmissionController(
                 self.ledger, self._burns, registry=registry,
-                sampler=sampler, **(admission_kw or {}))
+                sampler=sampler, lags=self.reader_lags,
+                **(admission_kw or {}))
         if sampler is not None:
             sampler.add_collector(self._host_collector())
 
@@ -234,6 +243,20 @@ class MultiTenantHost:
             sub["queued_batches"] = len(t.queue)
             sub["folded_batches"] = t.folded_batches
             sub["dropped_batches"] = t.dropped_batches
+            lag_fn = getattr(t.reader, "lag", None)
+            if lag_fn is not None:
+                try:
+                    lag = int(lag_fn())
+                except Exception:
+                    lag = None
+                if lag is not None:
+                    sub["consumer_lag"] = lag
+                    sub["reader_paused"] = bool(
+                        getattr(t.reader, "paused", False))
+                    t.view.gauge(
+                        "streambench_kafka_consumer_lag",
+                        "broker log end minus this consumer's position"
+                        " (records not yet fetched)").set(lag)
             if t.serve is not None:
                 sub["reach_query"] = t.serve.summary()
             if t.slo is not None:
@@ -263,6 +286,23 @@ class MultiTenantHost:
         return {t.name: t.slo.fast_burn()
                 for t in self._tenants.values() if t.slo is not None}
 
+    def reader_lags(self) -> dict:
+        """``{tenant: broker-side consumer lag}`` for every tenant
+        whose reader can measure it (the Kafka adapter's ``lag()``).
+        The admission controller journals this map with every gate
+        decision — the broker-backlog evidence the defer actuator is
+        judged by."""
+        out: dict = {}
+        for t in self._tenants.values():
+            lag_fn = getattr(t.reader, "lag", None)
+            if lag_fn is None:
+                continue
+            try:
+                out[t.name] = int(lag_fn())
+            except Exception:
+                pass
+        return out
+
     # -- ingest --------------------------------------------------------
     def tenants(self) -> list[str]:
         return list(self._tenants)
@@ -284,7 +324,7 @@ class MultiTenantHost:
         queue.  Returns total lines moved."""
         moved = 0
         for t in self._tenants.values():
-            if t.reader is None:
+            if t.reader is None or getattr(t.reader, "paused", False):
                 continue
             lines = t.reader.poll(max_records)
             if lines:
@@ -292,10 +332,28 @@ class MultiTenantHost:
                 moved += len(lines)
         return moved
 
+    def _sync_reader_gates(self) -> None:
+        """Mirror admission gates onto pausable readers: a defer gate
+        pauses the tenant's consumer (backlog accumulates broker-side,
+        not in the host queue); anything else — admit, release, or a
+        shed escalation (which must keep consuming to keep shedding) —
+        resumes it."""
+        for t in self._tenants.values():
+            r = t.reader
+            if r is None or not hasattr(r, "pause"):
+                continue
+            want = self.admission.admit(t.name) == "defer"
+            if want and not getattr(r, "paused", False):
+                r.pause()
+            elif not want and getattr(r, "paused", False):
+                r.resume()
+
     def step(self) -> int:
         """One round-robin fold pass: at most one queued batch per
         tenant, admission-gated.  Returns batches folded."""
         folded = 0
+        if self.admission is not None:
+            self._sync_reader_gates()
         for t in self._tenants.values():
             if not t.queue:
                 continue
@@ -437,7 +495,8 @@ def run_tenants_cli(cfg, args, mapping, campaigns) -> int:
 
     broker = make_broker(cfg.kafka_bootstrap_servers,
                          args.brokerDir
-                         or os.path.join(args.workdir, "broker"))
+                         or os.path.join(args.workdir, "broker"),
+                         fake=cfg.kafka_fake)
     broker.create_topic(cfg.kafka_topic)
     registry = MetricsRegistry()
     sampler = None
@@ -465,6 +524,17 @@ def run_tenants_cli(cfg, args, mapping, campaigns) -> int:
         })
     for name in host.tenants():
         host.tenant(name).reader = broker.reader(cfg.kafka_topic)
+    if (sampler is not None
+            and getattr(broker, "counters", None) is not None):
+        from streambench_tpu.obs import kafka_collector
+
+        # one broker-level ledger block per tick (the per-tenant lag
+        # gauges live in each tenant's collector); host-level lag is
+        # the WORST tenant's — the admission actuator's headline
+        sampler.add_collector(kafka_collector(
+            broker.counters,
+            lag=lambda: max(host.reader_lags().values(), default=0),
+            registry=registry))
     host.warmup()
     if sampler is not None:
         sampler.start()
@@ -543,6 +613,12 @@ def run_tenants_cli(cfg, args, mapping, campaigns) -> int:
         stats_line["admission"] = {
             k: adm[k] for k in ("defers", "sheds", "releases", "holds",
                                 "batches_deferred", "batches_shed")}
+    if getattr(broker, "counters", None) is not None:
+        ksnap = {k[len("kafka_"):]: v
+                 for k, v in broker.counters.snapshot().items()
+                 if k.startswith("kafka_")}
+        if ksnap:
+            stats_line["kafka"] = ksnap
     print(json.dumps(stats_line), flush=True)
     if server is not None:
         server.close()

@@ -118,6 +118,7 @@ from streambench_tpu.obs.registry import (  # noqa: F401
 from streambench_tpu.obs.sampler import (  # noqa: F401
     MetricsSampler,
     engine_collector,
+    kafka_collector,
     rss_bytes,
     rss_sample,
 )

@@ -65,9 +65,15 @@ class AdmissionController:
                  breach_ticks: int = 2, healthy_ticks: int = 4,
                  escalate_ticks: int = 6, cooldown_s: float = 3.0,
                  sampler=None, flightrec=None, registry=None,
-                 clock=time.monotonic):
+                 lags=None, clock=time.monotonic):
         self.ledger = ledger
         self.burns = burns
+        # optional callable -> {tenant: broker-side consumer lag}; when
+        # wired (the multi-tenant host's reader_lags over the Kafka
+        # adapter) every journaled decision carries the lag map, so a
+        # defer gate's broker-backlog effect is evidence IN the
+        # decision, not a separate scrape to correlate
+        self.lags = lags
         self.breach_burn = float(breach_burn)
         self.breach_ticks = max(int(breach_ticks), 1)
         self.healthy_ticks = max(int(healthy_ticks), 1)
@@ -155,6 +161,14 @@ class AdmissionController:
                "victim": victim, "burn": round(float(burn), 3),
                "blame_ms": round(float(blame_ms), 3),
                "step": self.steps, "ts_ms": now_ms()}
+        if self.lags is not None:
+            try:
+                lag = {str(k): int(v)
+                       for k, v in (self.lags() or {}).items()}
+            except Exception:
+                lag = {}
+            if lag:
+                dec["lag"] = lag
         dec.update(extra)
         self._journal(dec)
         return dec

@@ -270,6 +270,15 @@ def summarize_fleet(records: list[dict], path: str = "") -> dict:
                                 ("defers", "sheds", "releases", "holds",
                                  "batches_deferred", "batches_shed",
                                  "gates", "last")}
+        # Kafka delivery ledger (ISSUE 20): any role ingesting through
+        # the Kafka adapter journals rec["kafka"] (kafka_collector) —
+        # last snapshot wins, rendered as a sub-line under the row
+        kf = r.get("kafka")
+        if isinstance(kf, dict):
+            agg["kafka"] = {k2: kf.get(k2) for k2 in
+                            ("produced", "delivered", "redeliveries",
+                             "produce_retries", "consume_retries",
+                             "broker_down_ms", "consumer_lag")}
     rows = []
     for agg in by_role.values():
         rates = agg.pop("_rates")
@@ -348,6 +357,15 @@ def render_fleet(s: dict) -> str:
                 f"bytes/tick {_fmt(sp.get('bytes_per_tick'))}  "
                 f"rows/tick {_fmt(sp.get('rows_per_tick'))}  "
                 f"ms/tick {_fmt(sp.get('ship_ms_per_tick'))}{chain}")
+        kf = a.get("kafka")
+        if kf:
+            lines.append(
+                f"    kafka: produced {_fmt(kf.get('produced'))}  "
+                f"delivered {_fmt(kf.get('delivered'))}  "
+                f"redeliveries {_fmt(kf.get('redeliveries'))}  "
+                f"retries {_fmt(kf.get('produce_retries'))}/"
+                f"{_fmt(kf.get('consume_retries'))}  "
+                f"lag {_fmt(kf.get('consumer_lag'))}")
         fr = a.get("freshness_p99_ms")
         if fr:
             hops = "  ".join(f"{hop} {_fmt(fr.get(hop))}"

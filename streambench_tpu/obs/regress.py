@@ -139,6 +139,16 @@ DEFAULT_TOLERANCES: dict = {
     # run to run.
     "tenant_victim_breach_ratio": ("lower", 2.0),
     "tenant_blame_offdiag_ratio": ("lower", 2.0),
+    # Kafka ingest edge (ISSUE 20): broker-surface delivery accounting
+    # from the adapter's shared ledger.  Redeliveries/retries regress
+    # UP (more faults surviving to the reader means the broker edge got
+    # flakier for the same plan); consumer lag regresses UP (a consumer
+    # that stopped draining).  All advisory-by-tolerance: fault
+    # placement is plan-seeded but the op interleaving under wall-clock
+    # pacing moves counts run to run.
+    "kafka_redeliveries": ("lower", 2.0),
+    "kafka_produce_retries": ("lower", 2.0),
+    "kafka_consumer_lag": ("lower", 2.0),
 }
 
 
@@ -270,6 +280,13 @@ def normalize_bench(doc: dict, path: str = "") -> dict:
             mt.get("victim_breach_ratio_on"))
         out["tenant_blame_offdiag_ratio"] = _num(
             mt.get("blame_offdiag_ratio"))
+    # ISSUE 20 kafka-edge keys (engine stats line / metrics summary
+    # "kafka" block: the adapter ledger kafka_collector journals)
+    kf = doc.get("kafka")
+    if isinstance(kf, dict):
+        out["kafka_redeliveries"] = _num(kf.get("redeliveries"))
+        out["kafka_produce_retries"] = _num(kf.get("produce_retries"))
+        out["kafka_consumer_lag"] = _num(kf.get("consumer_lag"))
     return {k: v for k, v in out.items() if v is not None}
 
 
@@ -308,6 +325,12 @@ def normalize_metrics(records: list, path: str = "") -> dict:
             out["device_busy_ratio"] = _num(rs["device_busy_ratio"])
         if isinstance(rs.get("slo"), dict):
             out["slo_pass"] = bool(rs["slo"].get("pass"))
+    # ISSUE 20: the kafka_collector's broker-edge ledger block
+    kf = s.get("kafka")
+    if isinstance(kf, dict):
+        out["kafka_redeliveries"] = _num(kf.get("redeliveries"))
+        out["kafka_produce_retries"] = _num(kf.get("produce_retries"))
+        out["kafka_consumer_lag"] = _num(kf.get("consumer_lag"))
     return {k: v for k, v in out.items() if v is not None}
 
 

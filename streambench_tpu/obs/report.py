@@ -114,6 +114,10 @@ def summarize(records: list[dict], path: str = "") -> dict:
         "slo_tenants": last_block("slo_tenants"),
         "multitenant": last_block("multitenant"),
         "admission": last_block("admission"),
+        # Kafka delivery ledger (ISSUE 20): the broker-edge accounting
+        # block the kafka_collector journals (produced/delivered/
+        # redeliveries/retries + consumer lag)
+        "kafka": last_block("kafka"),
         "faults": last.get("faults") or {},
         "stages": stages,
         "annotations": [{k: r.get(k) for k in ("event", "uptime_ms")}
@@ -290,6 +294,20 @@ def render_report(s: dict) -> str:
                 f"releases {_fmt(adm.get('releases'))}  "
                 f"deferred {_fmt(adm.get('batches_deferred'))}  "
                 f"shed {_fmt(adm.get('batches_shed'))}")
+    kf = s.get("kafka")
+    if kf:
+        lines.append(
+            "  kafka edge (broker delivery ledger):")
+        lines.append(
+            f"    produced {_fmt(kf.get('produced'))}  "
+            f"delivered {_fmt(kf.get('delivered'))}  "
+            f"redeliveries {_fmt(kf.get('redeliveries'))}  "
+            f"lag {_fmt(kf.get('consumer_lag'))}")
+        lines.append(
+            f"    produce retries {_fmt(kf.get('produce_retries'))}  "
+            f"consume retries {_fmt(kf.get('consume_retries'))}  "
+            f"dr failures {_fmt(kf.get('dr_failures'))}  "
+            f"backoff ms {_fmt(kf.get('broker_down_ms'))}")
     if s["faults"]:
         lines.append("  faults:")
         for k in sorted(s["faults"]):

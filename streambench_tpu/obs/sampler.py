@@ -225,6 +225,63 @@ def engine_collector(engine, reader=None, runner=None, registry=None):
     return collect
 
 
+def kafka_collector(counters, lag=None, registry=None):
+    """Collector over the Kafka adapter's shared delivery ledger.
+
+    ``counters`` is the :class:`FaultCounters` a
+    :class:`~streambench_tpu.io.kafka.KafkaBroker` threads through
+    every writer/reader it hands out (``kafka_produced``,
+    ``kafka_delivered``, ``kafka_redeliveries``, retry/backoff
+    counters); ``lag`` is an optional callable returning the
+    broker-side consumer lag in records.  Each tick lands the ledger
+    under ``rec["kafka"]`` (prefix stripped) and mirrors the headline
+    instruments into ``registry``.  The instrument family is
+    predeclared up front — the scrape-gap rule: a Prometheus scrape
+    BEFORE the first fault must see zeroed series, not a missing
+    family.
+    """
+    reg = registry
+    if reg is not None:
+        reg.predeclare(
+            "counter", "streambench_kafka_redeliveries_total",
+            "records the broker re-sent after a connection drop and "
+            "the reader filtered (duplicates never reach the engine)")
+        reg.predeclare(
+            "counter", "streambench_kafka_produce_retries_total",
+            "transient produce errors retried with capped backoff")
+        reg.predeclare(
+            "counter", "streambench_kafka_broker_down_ms_total",
+            "milliseconds spent in retry backoff against a faulted "
+            "broker")
+        reg.predeclare(
+            "gauge", "streambench_kafka_consumer_lag",
+            "broker log end minus the consumer's position (records "
+            "not yet fetched)")
+
+    def collect(rec: dict, dt_s: float) -> None:
+        snap = counters.snapshot()
+        blk = {k[len("kafka_"):]: v for k, v in snap.items()
+               if k.startswith("kafka_")}
+        if lag is not None:
+            try:
+                blk["consumer_lag"] = int(lag())
+            except Exception:
+                pass
+        rec["kafka"] = blk
+        if reg is not None:
+            reg.counter("streambench_kafka_redeliveries_total"
+                        ).set_total(blk.get("redeliveries", 0))
+            reg.counter("streambench_kafka_produce_retries_total"
+                        ).set_total(blk.get("produce_retries", 0))
+            reg.counter("streambench_kafka_broker_down_ms_total"
+                        ).set_total(blk.get("broker_down_ms", 0))
+            if "consumer_lag" in blk:
+                reg.gauge("streambench_kafka_consumer_lag"
+                          ).set(blk["consumer_lag"])
+
+    return collect
+
+
 class MetricsSampler:
     """The sampling thread + jsonl writer.
 

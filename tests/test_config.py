@@ -23,6 +23,8 @@ zookeeper.port: 2181
 redis.host: "redishost"
 kafka.topic: "ad-events"
 kafka.partitions: 4
+kafka.bootstrap: "kafkahost:9092"
+kafka.fake: true
 process.hosts: 1
 process.cores: 4
 storm.workers: 1
@@ -49,6 +51,9 @@ def test_reference_yaml_roundtrip(tmp_path):
     assert c.redis_host == "redishost"
     assert c.kafka_topic == "ad-events"
     assert c.kafka_partitions == 4
+    assert c.kafka_bootstrap == "kafkahost:9092"
+    assert c.kafka_bootstrap_servers == "kafkahost:9092"
+    assert c.kafka_fake is True
     assert c.process_hosts == 1 and c.process_cores == 4
     assert c.storm_workers == 1 and c.storm_ackers == 2
     assert c.spark_batchtime == 2000
@@ -64,6 +69,10 @@ def test_reference_yaml_roundtrip(tmp_path):
 
 def test_defaults_match_reference_conf():
     c = default_config()
+    # kafka adapter default-off: empty bootstrap + no fake -> make_broker
+    # stays on the file journal (pinned in test_kafka_contract)
+    assert c.kafka_bootstrap == "" and c.kafka_bootstrap_servers is None
+    assert c.kafka_fake is False
     assert c.window_size == 5000
     assert c.events_num == 10_000_000
     assert c.redis_hashtable == "t1"

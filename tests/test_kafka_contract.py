@@ -4,16 +4,20 @@
 cluster via confluent-kafka) must be interchangeable behind the same
 reader/writer/broker surface — the reference swaps its Kafka source for a
 file source the same way (``FileBasedDataSource`` vs the Kafka consumer,
-``AdvertisingTopologyNative.java:88-99``).  KafkaBroker rows run only when
-the client library AND a live broker (STREAMBENCH_KAFKA_BROKERS) exist;
-the guard behavior itself is always tested.
+``AdvertisingTopologyNative.java:88-99``).  Real-KafkaBroker rows run
+only when the client library AND a live broker
+(STREAMBENCH_KAFKA_BROKERS) exist; the guard behavior itself is always
+tested.  The fake rows (ISSUE 20) run ``KafkaBroker`` for REAL — same
+adapter code, confluent surface served by ``io.fakekafka`` — once
+through the in-process injection seam (``kafka.use_clients``) and once
+over a live TCP broker thread, so the contract executes in every image.
 """
 
 import os
 
 import pytest
 
-from streambench_tpu.io import kafka
+from streambench_tpu.io import fakekafka, kafka
 from streambench_tpu.io.journal import FileBroker
 
 
@@ -30,7 +34,39 @@ def _kafka_broker(tmp_path):
     return kafka.KafkaBroker(brokers)
 
 
-BROKERS = [_file_broker, _kafka_broker]
+#: TCP broker threads started by a row, stopped by the autouse fixture
+_SERVERS: list = []
+
+
+def _fake_inproc_broker(tmp_path):
+    # the injection seam itself is under test: install the fake client
+    # bundle module-wide and let KafkaBroker resolve Producer/Consumer/
+    # AdminClient through ``_clients()`` exactly as the real path would
+    kafka.use_clients(fakekafka.clients(fakekafka.FakeCluster()))
+    return kafka.KafkaBroker(fakekafka.INPROC)
+
+
+def _fake_tcp_broker(tmp_path):
+    # a real socket between adapter and broker: the FakeKafkaServer
+    # thread speaks the record protocol the standalone START_KAFKA
+    # process serves
+    srv = fakekafka.FakeKafkaServer()
+    srv.start()
+    _SERVERS.append(srv)
+    return kafka.KafkaBroker(f"{srv.host}:{srv.port}",
+                             clients=fakekafka.clients())
+
+
+@pytest.fixture(autouse=True)
+def _reset_fake_kafka():
+    yield
+    kafka.use_clients(None)
+    while _SERVERS:
+        _SERVERS.pop().stop()
+
+
+BROKERS = [_file_broker, _kafka_broker, _fake_inproc_broker,
+           _fake_tcp_broker]
 
 
 @pytest.mark.parametrize("make", BROKERS)
